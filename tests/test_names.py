@@ -1,0 +1,62 @@
+"""Golden tests for world-name sanitization (world_names.rs:107-172)."""
+
+import pytest
+
+from worldql_server_tpu.utils.names import (
+    GLOBAL_WORLD,
+    SanitizeError,
+    SanitizeErrorKind,
+    sanitize_world_name,
+)
+
+VALID = [
+    ("world", "world"),
+    ("WORLD", "WORLD"),
+    ("world_1_2_3", "world_1_2_3"),
+    ("world one", "world_one"),
+    ("chat/server_1", "chat_fs_server_1"),
+    ("chat\\server_2", "chat_bs_server_2"),
+    ("chat:server_3", "chat_cl_server_3"),
+    ("chat@server_4", "chat_at_server_4"),
+    ("a" * 63, "a" * 63),
+]
+
+
+@pytest.mark.parametrize("name,expected", VALID)
+def test_sanitize_valid(name, expected):
+    assert sanitize_world_name(name) == expected
+
+
+INVALID = [
+    (GLOBAL_WORLD, SanitizeErrorKind.IS_GLOBAL_WORLD),
+    ("", SanitizeErrorKind.ZERO_LENGTH),
+    ("0world", SanitizeErrorKind.INVALID_START),
+    ("_world", SanitizeErrorKind.INVALID_START),
+    ("/world", SanitizeErrorKind.INVALID_START),
+    ("\\world", SanitizeErrorKind.INVALID_START),
+    (":world", SanitizeErrorKind.INVALID_START),
+    ("@world", SanitizeErrorKind.INVALID_START),
+    (" world", SanitizeErrorKind.INVALID_START),
+    ("[world", SanitizeErrorKind.INVALID_START),
+    ("]world", SanitizeErrorKind.INVALID_START),
+    ("world (two)", SanitizeErrorKind.INVALID_CHARS),
+    ("world&three", SanitizeErrorKind.INVALID_CHARS),
+    ("world*four", SanitizeErrorKind.INVALID_CHARS),
+    ("world-four", SanitizeErrorKind.INVALID_CHARS),
+    ("a" * 64, SanitizeErrorKind.TOO_LONG),
+]
+
+
+@pytest.mark.parametrize("name,kind", INVALID)
+def test_sanitize_invalid(name, kind):
+    with pytest.raises(SanitizeError) as exc:
+        sanitize_world_name(name)
+    assert exc.value.kind == kind
+
+
+def test_replacement_expansion_can_exceed_length():
+    # 60 chars pre-replacement, but ':' expands to '_cl_' -> 63+ chars.
+    name = "a" * 59 + ":" * 2
+    with pytest.raises(SanitizeError) as exc:
+        sanitize_world_name(name)
+    assert exc.value.kind == SanitizeErrorKind.TOO_LONG

@@ -1,0 +1,188 @@
+"""Golden tests for spatial quantization.
+
+Expected values are the reference's own test tables
+(cube_area.rs:102-175, world_region.rs:145-362, round.rs:28-77), which
+pin the asymmetric conventions: max-corner cube labeling with 0→+size,
+floor-style region labeling with exact negative multiples shifting a
+full region down, and table borders returning themselves.
+"""
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.spatial.quantize import (
+    clamp_region_coord,
+    clamp_region_coord_batch,
+    clamp_table_size,
+    coord_clamp,
+    coord_clamp_batch,
+    cube_coords,
+    cube_coords_batch,
+    region_coords,
+    table_bounds,
+)
+from worldql_server_tpu.utils.rounding import round_by_multiple
+
+COORD_CLAMP_10 = [
+    (0.0, 10), (0.1, 10), (5.0, 10), (9.99999, 10), (10.0, 10), (10.1, 20),
+    (-0.1, -10), (-5.0, -10), (-9.99999, -10), (-10.0, -10), (-10.1, -20),
+    (-20.0, -20),
+]
+
+COORD_CLAMP_8 = [
+    (0.0, 8), (0.1, 8), (5.0, 8), (9.99999, 16), (10.0, 16), (10.1, 16),
+    (-0.1, -8), (-5.0, -8), (-9.99999, -16), (-10.0, -16), (-10.1, -16),
+    (-20.0, -24),
+]
+
+
+@pytest.mark.parametrize("value,expected", COORD_CLAMP_10)
+def test_coord_clamp_10(value, expected):
+    assert coord_clamp(value, 10) == expected
+
+
+@pytest.mark.parametrize("value,expected", COORD_CLAMP_8)
+def test_coord_clamp_8(value, expected):
+    assert coord_clamp(value, 8) == expected
+
+
+FROM_VECTOR3 = [
+    ((0.0, 0.0, 0.0), (10, 10, 10)),
+    ((0.1, 0.3, 2.5), (10, 10, 10)),
+    ((3.0, 4.0, 5.0), (10, 10, 10)),
+    ((9.1, 9.9, 9.9), (10, 10, 10)),
+    ((18.0, 12.5, 16.7), (20, 20, 20)),
+    ((-3.0, -8.0, -1.3), (-10, -10, -10)),
+    ((-6.0, -0.3, -9.9), (-10, -10, -10)),
+    ((-12.0, -19.9, -13.5), (-20, -20, -20)),
+    ((25.0, -13.2, 0.0), (30, -20, 10)),
+    ((25.0, -13.2, -0.1), (30, -20, -10)),
+]
+
+
+@pytest.mark.parametrize("vec,expected", FROM_VECTOR3)
+def test_cube_coords(vec, expected):
+    assert cube_coords(*vec, size=10) == expected
+
+
+def test_cube_coords_batch_matches_scalar():
+    rng = np.random.default_rng(1234)
+    pos = rng.uniform(-1e4, 1e4, size=(4096, 3))
+    # Sprinkle exact multiples, zeros and negative zeros.
+    pos[:32] = np.round(pos[:32] / 16.0) * 16.0
+    pos[32:40] = 0.0
+    pos[40:48] = -0.0
+
+    for size in (10, 8, 16):
+        batch = cube_coords_batch(pos, size)
+        for i in range(0, len(pos), 97):
+            assert tuple(batch[i]) == cube_coords(*pos[i], size=size), pos[i]
+
+
+CLAMP_REGION = [
+    (0.0, 16, 0), (0.1, 16, 0), (15.0, 16, 0), (16.0, 16, 16),
+    (31.9, 16, 16), (32.0, 16, 32), (0.0, 256, 0), (0.1, 256, 0),
+    (128.0, 256, 0), (255.9, 256, 0), (256.0, 256, 256),
+    (511.9, 256, 256), (512.0, 256, 512),
+    (-0.1, 16, -16), (-1.0, 16, -16), (-15.0, 16, -16), (-16.0, 16, -32),
+    (-31.9, 16, -32), (-32.0, 16, -48), (-32.1, 16, -48),
+    (-1.0, 256, -256), (-128.0, 256, -256), (-255.9, 256, -256),
+    (-256.0, 256, -512),
+]
+
+
+@pytest.mark.parametrize("value,size,expected", CLAMP_REGION)
+def test_clamp_region_coord(value, size, expected):
+    assert clamp_region_coord(value, size) == expected
+
+
+def test_clamp_region_coord_batch_matches_scalar():
+    values = np.array([v for v, _size, _expected in CLAMP_REGION])
+    rng = np.random.default_rng(7)
+    extra = rng.uniform(-5e3, 5e3, size=2048)
+    for size in (16, 256):
+        allv = np.concatenate([values, extra])
+        batch = clamp_region_coord_batch(allv, size)
+        for v, got in zip(allv, batch):
+            assert got == clamp_region_coord(float(v), size), (v, size)
+
+
+CLAMP_TABLE = [
+    (0, 1024, 0), (1, 1024, 0), (256, 1024, 0), (1024, 1024, 1024),
+    (1800, 1024, 1024), (2047, 1024, 1024), (2048, 1024, 2048),
+    (-1, 1024, -1024), (-45, 1024, -1024), (-687, 1024, -1024),
+    (-1023, 1024, -1024), (-1024, 1024, -1024), (-1025, 1024, -2048),
+]
+
+
+@pytest.mark.parametrize("value,size,expected", CLAMP_TABLE)
+def test_clamp_table_size(value, size, expected):
+    assert clamp_table_size(value, size) == expected
+
+
+MC_CHUNK = (16, 256, 16)
+
+REGION_CONVERSION = [
+    ((0.0, 0.0, 0.0), (0, 0, 0)),
+    ((10.2, 84.1, 15.9), (0, 0, 0)),
+    ((10.2, 486.5, 15.9), (0, 256, 0)),
+    ((1925.0, 54.0, 93.0), (1920, 0, 80)),
+    ((-0.01, -0.01, -0.01), (-16, -256, -16)),
+    ((-15.9, -255.9, -15.9), (-16, -256, -16)),
+    ((-50.0, -8.4, -17.6), (-64, -256, -32)),
+    ((-1925.0, -478.3, -85.6), (-1936, -512, -96)),
+    ((-45.0, 22.0, -1023.0), (-48, 0, -1024)),
+]
+
+
+@pytest.mark.parametrize("vec,expected", REGION_CONVERSION)
+def test_region_coords(vec, expected):
+    assert region_coords(*vec, *MC_CHUNK) == expected
+
+
+TABLE_BOUNDS = [
+    ((0.0, 0.0, 0.0), ((0, 1024), (0, 1024), (0, 1024))),
+    ((1925.0, 54.0, 93.0), ((1024, 2048), (0, 1024), (0, 1024))),
+    ((2049.0, 54.0, 93.0), ((2048, 3072), (0, 1024), (0, 1024))),
+    ((-0.01, -0.01, -0.01), ((-1024, 0), (-1024, 0), (-1024, 0))),
+    ((-1.0, -1.0, -1.0), ((-1024, 0), (-1024, 0), (-1024, 0))),
+    ((-1023.9, -1023.9, -1023.9), ((-1024, 0), (-1024, 0), (-1024, 0))),
+    ((-67.0, -1025.0, -586.0), ((-1024, 0), (-2048, -1024), (-1024, 0))),
+    ((-45.0, 22.0, -1004.0), ((-1024, 0), (0, 1024), (-1024, 0))),
+    ((-45.0, 22.0, -1025.0), ((-1024, 0), (0, 1024), (-2048, -1024))),
+    ((-45.0, 22.0, 1015.0), ((-1024, 0), (0, 1024), (0, 1024))),
+]
+
+
+@pytest.mark.parametrize("vec,expected", TABLE_BOUNDS)
+def test_table_bounds(vec, expected):
+    region = region_coords(*vec, *MC_CHUNK)
+    bounds = tuple(table_bounds(c, 1024) for c in region)
+    assert bounds == expected
+
+
+ROUND_CASES = [
+    ((0.0, 10.0), 10.0), ((-0.0, 10.0), 10.0), ((0.1, 10.0), 10.0),
+    ((1.0, 10.0), 10.0), ((5.0, 10.0), 10.0), ((9.9999, 10.0), 10.0),
+    ((10.0, 10.0), 10.0), ((10.0001, 10.0), 20.0), ((15.0, 10.0), 20.0),
+    ((20.0, 10.0), 20.0),
+    ((0.0, 8.0), 8.0), ((2.0, 8.0), 8.0), ((7.0, 8.0), 8.0),
+    ((8.0, 8.0), 8.0), ((9.0, 8.0), 16.0), ((15.0, 8.0), 16.0),
+    ((16.0, 8.0), 16.0),
+    ((-1.0, 10.0), 0.0), ((-5.0, 10.0), 0.0), ((-9.9999, 10.0), 0.0),
+    ((-10.0, 10.0), -10.0), ((-10.0001, 10.0), -10.0), ((-15.0, 10.0), -10.0),
+    ((-20.0, 10.0), -20.0),
+    ((-2.0, 8.0), 0.0), ((-8.0, 8.0), -8.0), ((-15.0, 8.0), -8.0),
+    ((-16.0, 8.0), -16.0),
+    ((5.0, 0.0), 5.0),
+]
+
+
+@pytest.mark.parametrize("args,expected", ROUND_CASES)
+def test_round_by_multiple(args, expected):
+    assert round_by_multiple(*args) == expected
+
+
+def test_coord_clamp_batch_negative_zero():
+    out = coord_clamp_batch(np.array([-0.0, 0.0]), 10)
+    assert list(out) == [10, 10]

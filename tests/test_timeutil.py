@@ -1,0 +1,27 @@
+"""parse_epoch_millis semantics (reference: utils/time.rs:6-16 — u64 parse)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from worldql_server_tpu.utils import parse_epoch_millis
+
+
+def test_parses_exact_millis():
+    ts = parse_epoch_millis("1645000000123")
+    assert ts == datetime(2022, 2, 16, 8, 26, 40, 123000, tzinfo=timezone.utc)
+    assert ts.microsecond == 123000  # exact, no float drift
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "-1000", " 5 ", "1_000", "1.5", "abc", "+10", str(2**64)]
+)
+def test_rejects_non_u64(bad):
+    with pytest.raises(ValueError):
+        parse_epoch_millis(bad)
+
+
+def test_large_exact():
+    # 1-4 us float drift would show here with naive /1000.0 division.
+    ts = parse_epoch_millis("35331730553994")
+    assert ts.microsecond == 994000

@@ -1,0 +1,18 @@
+"""worldql-server-tpu — a TPU-native real-time spatial message broker.
+
+A from-scratch rebuild of the capabilities of WorldQL server
+(reference: Liborsaf/worldql_server, Rust/tokio): clients connect over
+ZeroMQ / WebSocket / HTTP, speak a FlatBuffers ``Message`` protocol,
+subscribe to cubic regions of named 3-D worlds, broadcast
+position-scoped (``LocalMessage``) and world-scoped (``GlobalMessage``)
+events, and persist positioned ``Record``s in a region-sharded store.
+
+Unlike the reference's per-message HashMap hot path
+(worldql_server/src/subscriptions/area_map.rs, processing/local_message.rs),
+the subscription/query engine here is a batched spatial-hash engine that
+executes on TPU via JAX/XLA behind a swappable ``SpatialBackend``
+interface, with entity positions held in device-resident SoA buffers and
+worlds/cells shardable across a ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+from .quantize import (
+    clamp_region_coord,
+    clamp_region_coord_batch,
+    clamp_table_size,
+    coord_clamp,
+    coord_clamp_batch,
+    cube_coords,
+    cube_coords_batch,
+    region_coords,
+    region_coords_batch,
+    table_bounds,
+)
+
+__all__ = [
+    "coord_clamp",
+    "coord_clamp_batch",
+    "cube_coords",
+    "cube_coords_batch",
+    "clamp_region_coord",
+    "clamp_region_coord_batch",
+    "clamp_table_size",
+    "region_coords",
+    "region_coords_batch",
+    "table_bounds",
+]

@@ -1,0 +1,160 @@
+"""Spatial quantization: f64 positions → integer cube / region labels.
+
+Two distinct grids exist, with deliberately different conventions, both
+matching the reference bit-for-bit:
+
+* **Subscription cubes** (``coord_clamp``): cubes are labeled by their
+  *max corner*, sign-symmetric so positive and negative space never
+  share a cube, and exact 0.0 maps to ``+size``
+  (worldql_server/src/subscriptions/cube_area.rs:23-44).
+
+* **DB regions** (``clamp_region_coord``): regions are labeled by a
+  floor-style corner; 0.0 maps to 0, and negative coordinates always
+  round *away* from zero — including exact negative multiples, which
+  shift one full region further down (e.g. -16 @ size 16 → -32)
+  (worldql_server/src/database/world_region.rs:93-110).
+
+Scalar functions are the semantic reference; ``*_batch`` variants are
+vectorized numpy float64 used on the request hot path. Quantization
+always runs host-side in f64 — the device only ever sees integer cell
+labels, so TPU fast-math can never perturb grid assignment.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..utils.rounding import round_by_multiple
+
+# region: scalar reference implementations
+
+
+def coord_clamp(coord: float, size: int) -> int:
+    """Quantize one subscription-cube coordinate (cube_area.rs:23-44)."""
+    abs_coord = abs(coord)
+    multiplier = -1 if coord < 0.0 else 1
+
+    # Exact non-zero multiples label their own cube (Rust `coord as i64`
+    # truncates toward zero).
+    if math.fmod(abs_coord, float(size)) == 0.0 and coord != 0.0:
+        return int(coord)
+
+    rounded = round_by_multiple(abs_coord, float(size))
+    if rounded > coord:
+        result = int(rounded)
+    else:
+        result = int(rounded) + size
+
+    return result * multiplier
+
+
+def cube_coords(x: float, y: float, z: float, size: int) -> tuple[int, int, int]:
+    """Vector3 → CubeArea (cube_area.rs:50-56)."""
+    return (coord_clamp(x, size), coord_clamp(y, size), coord_clamp(z, size))
+
+
+def clamp_region_coord(c: float, region_size: int) -> int:
+    """Quantize one DB-region coordinate (world_region.rs:93-110)."""
+    if c == 0.0:
+        return 0
+
+    if c >= 0.0:
+        ci = int(c)  # truncate toward zero
+        return ci - ci % region_size  # ci >= 0: python % == trunc %
+    # Negative: reflect, quantize, negate. Exact negative multiples land
+    # one region further down — reference-exact behavior.
+    return -clamp_region_coord(-c + float(region_size), region_size)
+
+
+def region_coords(
+    x: float, y: float, z: float, sx: int, sy: int, sz: int
+) -> tuple[int, int, int]:
+    """Vector3 → WorldRegion coords (world_region.rs:18-35)."""
+    return (
+        clamp_region_coord(x, sx),
+        clamp_region_coord(y, sy),
+        clamp_region_coord(z, sz),
+    )
+
+
+def clamp_table_size(c: int, table_size: int) -> int:
+    """Snap a region coord to its containing table's min corner
+    (world_region.rs:112-129). Note: unlike regions, exact negative
+    table borders return themselves."""
+    rem = math.fmod(c, table_size)  # trunc-style remainder, like Rust %
+    if rem == 0:
+        return c
+
+    if c >= 0:
+        return c - c % table_size
+    return -clamp_table_size(-c + table_size, table_size)
+
+
+def table_bounds(region_coord: int, table_size: int) -> tuple[int, int]:
+    """(min, max) extent of the table containing a region coordinate
+    (world_region.rs:38-59)."""
+    lo = clamp_table_size(region_coord, table_size)
+    return (lo, lo + table_size)
+
+
+# endregion
+
+# region: vectorized batch implementations
+
+
+def coord_clamp_batch(coords: np.ndarray, size: int) -> np.ndarray:
+    """Vectorized ``coord_clamp`` over a float64 array → int64 array."""
+    c = np.asarray(coords, dtype=np.float64)
+    size_f = float(size)
+
+    abs_c = np.abs(c)
+    multiplier = np.where(c < 0.0, -1, 1).astype(np.int64)
+
+    exact = (np.fmod(abs_c, size_f) == 0.0) & (c != 0.0)
+
+    # round_by_multiple(abs_c, size) with the 0→size special case.
+    rounded = np.ceil(abs_c / size_f) * size_f
+    rounded = np.where(abs_c == 0.0, size_f, rounded)
+
+    result = np.where(rounded > c, rounded.astype(np.int64), rounded.astype(np.int64) + size)
+    result = result * multiplier
+
+    return np.where(exact, c.astype(np.int64), result)
+
+
+def cube_coords_batch(positions: np.ndarray, size: int) -> np.ndarray:
+    """[N, 3] float64 positions → [N, 3] int64 cube labels."""
+    pos = np.asarray(positions, dtype=np.float64)
+    return coord_clamp_batch(pos, size)
+
+
+def clamp_region_coord_batch(coords: np.ndarray, region_size: int) -> np.ndarray:
+    """Vectorized ``clamp_region_coord`` → int64 array."""
+    c = np.asarray(coords, dtype=np.float64)
+
+    def _positive(v: np.ndarray) -> np.ndarray:
+        vi = v.astype(np.int64)  # truncation toward zero for v >= 0
+        return vi - vi % np.int64(region_size)
+
+    pos_result = _positive(np.maximum(c, 0.0))
+    neg_result = -_positive(-c + float(region_size))
+
+    result = np.where(c >= 0.0, pos_result, neg_result)
+    return np.where(c == 0.0, np.int64(0), result)
+
+
+def region_coords_batch(
+    positions: np.ndarray, sx: int, sy: int, sz: int
+) -> np.ndarray:
+    """[N, 3] float64 positions → [N, 3] int64 region labels."""
+    pos = np.asarray(positions, dtype=np.float64)
+    out = np.empty(pos.shape, dtype=np.int64)
+    out[..., 0] = clamp_region_coord_batch(pos[..., 0], sx)
+    out[..., 1] = clamp_region_coord_batch(pos[..., 1], sy)
+    out[..., 2] = clamp_region_coord_batch(pos[..., 2], sz)
+    return out
+
+
+# endregion

@@ -1,0 +1,11 @@
+from .names import GLOBAL_WORLD, SanitizeError, sanitize_world_name
+from .rounding import round_by_multiple
+from .timeutil import parse_epoch_millis
+
+__all__ = [
+    "GLOBAL_WORLD",
+    "SanitizeError",
+    "sanitize_world_name",
+    "round_by_multiple",
+    "parse_epoch_millis",
+]

@@ -1,0 +1,72 @@
+"""World-name sanitization.
+
+World names become schema identifiers in the record store, so this is a
+security-critical gate. Semantics match the reference
+(worldql_server/src/utils/world_names.rs:54-87): names must start with a
+letter, may contain ``[A-Za-z0-9_ /\\:@]``, are at most 63 chars *after*
+replacement, and the characters space, ``/``, ``\\``, ``:`` and ``@``
+are rewritten to ``_``, ``_fs_``, ``_bs_``, ``_cl_`` and ``_at_``.
+The literal world ``@global`` is a reserved sentinel and never valid as
+a storage/subscription world name.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+
+GLOBAL_WORLD = "@global"
+
+_MAX_NAME_LENGTH = 63
+
+_VALID_START = re.compile(r"[A-Za-z]")
+_VALID_CHARS = re.compile(r"[A-Za-z0-9_ /\\:@]*\Z")
+
+_REPLACEMENTS = (
+    (" ", "_"),
+    ("/", "_fs_"),
+    ("\\", "_bs_"),
+    (":", "_cl_"),
+    ("@", "_at_"),
+)
+
+
+class SanitizeErrorKind(enum.Enum):
+    IS_GLOBAL_WORLD = "is global world"
+    ZERO_LENGTH = "world name must be 1 or more characters long"
+    INVALID_START = "must start with a-z or A-Z"
+    INVALID_CHARS = "contains invalid characters"
+    TOO_LONG = "world name is too long"
+
+
+class SanitizeError(ValueError):
+    def __init__(self, kind: SanitizeErrorKind):
+        super().__init__(kind.value)
+        self.kind = kind
+
+
+def sanitize_world_name(world_name: str) -> str:
+    """Validate and normalise a world name, or raise :class:`SanitizeError`.
+
+    The length check runs on the *replaced* name, matching the reference
+    (world_names.rs:76-84), so e.g. 20 colons expand past the limit.
+    """
+    if world_name == GLOBAL_WORLD:
+        raise SanitizeError(SanitizeErrorKind.IS_GLOBAL_WORLD)
+
+    if not world_name:
+        raise SanitizeError(SanitizeErrorKind.ZERO_LENGTH)
+
+    if not _VALID_START.match(world_name[0]):
+        raise SanitizeError(SanitizeErrorKind.INVALID_START)
+
+    if not _VALID_CHARS.match(world_name):
+        raise SanitizeError(SanitizeErrorKind.INVALID_CHARS)
+
+    for src, dst in _REPLACEMENTS:
+        world_name = world_name.replace(src, dst)
+
+    if len(world_name) > _MAX_NAME_LENGTH:
+        raise SanitizeError(SanitizeErrorKind.TOO_LONG)
+
+    return world_name

@@ -1,0 +1,20 @@
+"""Ceil-to-multiple rounding used by the subscription-cube quantizer.
+
+Semantics match the reference (worldql_server/src/utils/round.rs:1-13),
+including the special case that exact zero rounds *up* to ``multiple``.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def round_by_multiple(n: float, multiple: float) -> float:
+    if multiple == 0.0:
+        return n
+
+    # Special case: 0 rounds up to the multiple.
+    if n == 0.0:
+        return multiple
+
+    return math.ceil(n / multiple) * multiple

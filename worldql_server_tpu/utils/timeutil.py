@@ -1,0 +1,31 @@
+"""Epoch-millisecond parsing for RecordRead "after" filters.
+
+Matches the reference (worldql_server/src/utils/time.rs:6-16): the
+parameter is a stringified *unsigned* integer count of milliseconds
+since the Unix epoch; anything else (sign, whitespace, separators)
+raises ``ValueError``.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+
+_EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+
+# u64::MAX — the reference parses with .parse::<u64>()
+_U64_MAX = 2**64 - 1
+
+
+def parse_epoch_millis(value: str) -> datetime:
+    if not value.isdigit():  # rejects '', signs, whitespace, '_'
+        raise ValueError(f"invalid epoch millis: {value!r}")
+
+    millis = int(value)
+    if millis > _U64_MAX:
+        raise ValueError(f"epoch millis out of range: {value!r}")
+
+    secs, ms = divmod(millis, 1000)
+    try:
+        return _EPOCH + timedelta(seconds=secs, milliseconds=ms)
+    except OverflowError as exc:
+        raise ValueError(f"epoch millis out of range: {value!r}") from exc
