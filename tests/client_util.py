@@ -1,0 +1,120 @@
+"""Minimal WorldQL clients for tests and manual driving.
+
+Speak the real wire protocol over real sockets — the same path an
+external game plugin would use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import uuid as uuid_mod
+
+import zmq
+import zmq.asyncio
+from websockets.asyncio.client import connect as ws_connect
+
+from worldql_server_tpu.protocol import (
+    Instruction,
+    Message,
+    deserialize_message,
+    serialize_message,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class WsClient:
+    """WebSocket client: server assigns our UUID (websocket.rs:51-87)."""
+
+    def __init__(self, connection, uuid: uuid_mod.UUID):
+        self.connection = connection
+        self.uuid = uuid
+
+    @classmethod
+    async def connect(cls, port: int, host: str = "127.0.0.1") -> "WsClient":
+        connection = await ws_connect(f"ws://{host}:{port}")
+        handshake = deserialize_message(await connection.recv())
+        assert handshake.instruction == Instruction.HANDSHAKE
+        assigned = uuid_mod.UUID(handshake.parameter)
+        client = cls(connection, assigned)
+        await client.send(Message(instruction=Instruction.HANDSHAKE))
+        return client
+
+    async def send(self, message: Message) -> None:
+        message.sender_uuid = self.uuid
+        await self.connection.send(serialize_message(message))
+
+    async def send_raw(self, data) -> None:
+        await self.connection.send(data)
+
+    async def recv(self, timeout: float = 2.0) -> Message:
+        frame = await asyncio.wait_for(self.connection.recv(), timeout)
+        return deserialize_message(frame)
+
+    async def recv_until(
+        self, instruction: Instruction, timeout: float = 2.0
+    ) -> Message:
+        while True:
+            message = await self.recv(timeout)
+            if message.instruction == instruction:
+                return message
+
+    async def close(self) -> None:
+        await self.connection.close()
+
+
+class ZmqClient:
+    """ZeroMQ client: we pick our UUID and hand the server a
+    connect-back address (incoming.rs:52-72, outgoing.rs:81-130)."""
+
+    def __init__(self, ctx, push, pull, uuid: uuid_mod.UUID):
+        self.ctx = ctx
+        self.push = push  # client → server PULL
+        self.pull = pull  # server PUSH → client
+        self.uuid = uuid
+
+    @classmethod
+    async def connect(cls, server_port: int, host: str = "127.0.0.1") -> "ZmqClient":
+        ctx = zmq.asyncio.Context()
+        pull = ctx.socket(zmq.PULL)
+        client_port = pull.bind_to_random_port(f"tcp://{host}")
+        push = ctx.socket(zmq.PUSH)
+        push.setsockopt(zmq.LINGER, 0)
+        push.connect(f"tcp://{host}:{server_port}")
+
+        client = cls(ctx, push, pull, uuid_mod.uuid4())
+        await client.send(
+            Message(
+                instruction=Instruction.HANDSHAKE,
+                parameter=f"{host}:{client_port}",
+            )
+        )
+        echo = await client.recv()
+        assert echo.instruction == Instruction.HANDSHAKE
+        return client
+
+    async def send(self, message: Message) -> None:
+        message.sender_uuid = self.uuid
+        await self.push.send(serialize_message(message))
+
+    async def recv(self, timeout: float = 2.0) -> Message:
+        data = await asyncio.wait_for(self.pull.recv(), timeout)
+        return deserialize_message(data)
+
+    async def recv_until(
+        self, instruction: Instruction, timeout: float = 2.0
+    ) -> Message:
+        while True:
+            message = await self.recv(timeout)
+            if message.instruction == instruction:
+                return message
+
+    async def close(self) -> None:
+        self.push.close(linger=0)
+        self.pull.close(linger=0)
+        self.ctx.term()

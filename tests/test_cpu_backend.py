@@ -1,0 +1,170 @@
+"""CPU spatial backend tests.
+
+The two scenario tests are ports of the reference's AreaMap unit tests
+(area_map.rs:149-255); the rest pin WorldMap-level behavior
+(world_map.rs) and the replication filters (local_message.rs:60-86).
+"""
+
+import uuid
+
+from worldql_server_tpu.protocol.types import Replication, Vector3
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+
+W = "world"
+
+
+def test_area_subscriptions():
+    peer = uuid.uuid4()
+    b = CpuSpatialBackend(cube_size=16)
+
+    cube_1 = (0, 0, 0)
+    cube_2 = (16, 16, 16)
+    vec_1 = Vector3(6.3, 1.0, 10.5)  # quantizes to cube_2
+
+    assert not b.is_subscribed(W, peer, cube_1)
+    assert not b.is_subscribed(W, peer, cube_2)
+    assert not b.is_subscribed(W, peer, vec_1)
+
+    b.add_subscription(W, peer, cube_1)
+    assert b.is_subscribed(W, peer, cube_1)
+    assert not b.is_subscribed(W, peer, cube_2)
+    assert not b.is_subscribed(W, peer, vec_1)
+
+    b.add_subscription(W, peer, cube_2)
+    assert b.is_subscribed(W, peer, cube_1)
+    assert b.is_subscribed(W, peer, cube_2)
+    assert b.is_subscribed(W, peer, vec_1)
+
+    b.remove_subscription(W, peer, cube_1)
+    assert not b.is_subscribed(W, peer, cube_1)
+    assert b.is_subscribed(W, peer, cube_2)
+    assert b.is_subscribed(W, peer, vec_1)
+
+    b.remove_subscription(W, peer, cube_2)
+    assert not b.is_subscribed(W, peer, cube_2)
+    assert not b.is_subscribed(W, peer, vec_1)
+
+    b.add_subscription(W, peer, vec_1)
+    assert not b.is_subscribed(W, peer, cube_1)
+    assert b.is_subscribed(W, peer, cube_2)
+    assert b.is_subscribed(W, peer, vec_1)
+
+    b.remove_subscription(W, peer, vec_1)
+    assert not b.is_subscribed(W, peer, cube_1)
+    assert not b.is_subscribed(W, peer, cube_2)
+    assert not b.is_subscribed(W, peer, vec_1)
+
+
+def test_world_subscriptions():
+    peer_1, peer_2 = uuid.uuid4(), uuid.uuid4()
+    cube_1, cube_2 = (0, 0, 0), (16, 16, 16)
+    b = CpuSpatialBackend(cube_size=16)
+
+    assert not b.is_subscribed_any(W, peer_1)
+    assert not b.is_subscribed_any(W, peer_2)
+
+    b.add_subscription(W, peer_1, cube_1)
+    assert b.is_subscribed_any(W, peer_1)
+    assert not b.is_subscribed_any(W, peer_2)
+
+    b.add_subscription(W, peer_1, cube_2)
+    assert b.is_subscribed_any(W, peer_1)
+    assert not b.is_subscribed_any(W, peer_2)
+
+    b.add_subscription(W, peer_2, cube_2)
+    assert b.is_subscribed_any(W, peer_1)
+    assert b.is_subscribed_any(W, peer_2)
+
+    b.remove_subscription(W, peer_1, cube_1)
+    assert b.is_subscribed_any(W, peer_1)
+    assert b.is_subscribed_any(W, peer_2)
+
+    b.remove_subscription(W, peer_1, cube_2)
+    assert not b.is_subscribed_any(W, peer_1)
+    assert b.is_subscribed_any(W, peer_2)
+
+    b.add_subscription(W, peer_2, cube_1)
+    assert not b.is_subscribed_any(W, peer_1)
+    assert b.is_subscribed_any(W, peer_2)
+
+    b.remove_peer(peer_2)
+    assert not b.is_subscribed_any(W, peer_1)
+    assert not b.is_subscribed_any(W, peer_2)
+
+
+def test_duplicate_add_returns_false():
+    peer = uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    assert b.add_subscription(W, peer, (16, 16, 16)) is True
+    assert b.add_subscription(W, peer, (16, 16, 16)) is False
+    assert b.add_subscription(W, peer, Vector3(1, 1, 1)) is False  # same cube
+
+
+def test_remove_nonexistent_returns_false():
+    peer = uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    assert b.remove_subscription(W, peer, (16, 16, 16)) is False
+    b.add_subscription(W, uuid.uuid4(), (16, 16, 16))
+    assert b.remove_subscription(W, peer, (16, 16, 16)) is False
+
+
+def test_queries_on_unknown_world_are_empty():
+    b = CpuSpatialBackend(16)
+    assert b.query_cube("nowhere", (16, 16, 16)) == set()
+    assert b.query_world("nowhere") == set()
+
+
+def test_remove_peer_spans_worlds():
+    peer, other = uuid.uuid4(), uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    b.add_subscription("w1", peer, (16, 16, 16))
+    b.add_subscription("w2", peer, (32, 16, 16))
+    b.add_subscription("w2", other, (32, 16, 16))
+
+    assert b.remove_peer(peer) is True
+    assert b.query_world("w1") == set()
+    assert b.query_world("w2") == {other}
+    assert b.query_cube("w2", (32, 16, 16)) == {other}
+    assert b.remove_peer(peer) is False
+
+
+def test_empty_cube_gc():
+    peer = uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    b.add_subscription(W, peer, (16, 16, 16))
+    assert b.cube_count(W) == 1
+    b.remove_subscription(W, peer, (16, 16, 16))
+    assert b.cube_count(W) == 0
+
+
+def test_match_local_batch_replication_filters():
+    sender, other1, other2 = uuid.uuid4(), uuid.uuid4(), uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    pos = Vector3(5.0, 5.0, 5.0)
+    for p in (sender, other1, other2):
+        b.add_subscription(W, p, pos)
+
+    queries = [
+        LocalQuery(W, pos, sender, Replication.EXCEPT_SELF),
+        LocalQuery(W, pos, sender, Replication.INCLUDING_SELF),
+        LocalQuery(W, pos, sender, Replication.ONLY_SELF),
+        LocalQuery(W, Vector3(100, 100, 100), sender, Replication.EXCEPT_SELF),
+    ]
+    results = b.match_local_batch(queries)
+
+    assert set(results[0]) == {other1, other2}
+    assert set(results[1]) == {sender, other1, other2}
+    assert results[2] == [sender]
+    assert results[3] == []
+
+
+def test_sender_not_subscribed_only_self_empty():
+    sender, other = uuid.uuid4(), uuid.uuid4()
+    b = CpuSpatialBackend(16)
+    pos = Vector3(5.0, 5.0, 5.0)
+    b.add_subscription(W, other, pos)
+    results = b.match_local_batch(
+        [LocalQuery(W, pos, sender, Replication.ONLY_SELF)]
+    )
+    assert results == [[]]

@@ -1,0 +1,461 @@
+"""Engine-level tests: router dispatch, PeerMap broadcasts, heartbeat,
+record flow — all through in-process loopback peers (no sockets).
+
+Behavior contracts cite the reference handlers they mirror.
+"""
+
+import asyncio
+import uuid
+
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.peers import Peer, PeerMap
+from worldql_server_tpu.engine.router import Router
+from worldql_server_tpu.protocol import (
+    Instruction,
+    Message,
+    Record,
+    Replication,
+    Vector3,
+    deserialize_message,
+)
+from worldql_server_tpu.protocol.types import NIL_UUID
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Harness:
+    """In-process server core: peer map + router + fake peers."""
+
+    def __init__(self):
+        config = Config()
+        self.backend = CpuSpatialBackend(config.sub_region_size)
+        self.store = MemoryRecordStore(config)
+        self.peer_map = PeerMap(on_remove=self.backend.remove_peer)
+        self.router = Router(self.peer_map, self.backend, self.store)
+        self.inboxes: dict[uuid.UUID, list[Message]] = {}
+
+    async def add_peer(self, tracks_heartbeat=False) -> uuid.UUID:
+        peer_uuid = uuid.uuid4()
+        inbox: list[Message] = []
+        self.inboxes[peer_uuid] = inbox
+
+        async def send_raw(data: bytes) -> None:
+            inbox.append(deserialize_message(data))
+
+        await self.peer_map.insert(
+            Peer(peer_uuid, "loopback", send_raw, "test", tracks_heartbeat)
+        )
+        return peer_uuid
+
+    def received(self, peer_uuid, instruction=None) -> list[Message]:
+        msgs = self.inboxes[peer_uuid]
+        if instruction is None:
+            return msgs
+        return [m for m in msgs if m.instruction == instruction]
+
+
+def test_peer_connect_disconnect_broadcasts():
+    async def scenario():
+        h = Harness()
+        p1 = await h.add_peer()
+        p2 = await h.add_peer()
+
+        # p1 heard about p2's connect (peer_map.rs:106-113), not itself.
+        connects_p1 = h.received(p1, Instruction.PEER_CONNECT)
+        assert [m.parameter for m in connects_p1] == [str(p2)]
+        assert h.received(p2, Instruction.PEER_CONNECT) == []
+
+        await h.peer_map.remove(p2)
+        disconnects = h.received(p1, Instruction.PEER_DISCONNECT)
+        assert [m.parameter for m in disconnects] == [str(p2)]
+        return True
+
+    assert run(scenario())
+
+
+def test_local_message_fanout_replication():
+    async def scenario():
+        h = Harness()
+        sender = await h.add_peer()
+        near = await h.add_peer()
+        far = await h.add_peer()
+        pos = Vector3(5.0, 5.0, 5.0)
+        far_pos = Vector3(500.0, 5.0, 5.0)
+
+        for p, where in ((sender, pos), (near, pos), (far, far_pos)):
+            await h.router.handle_message(
+                Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    sender_uuid=p,
+                    world_name="world",
+                    position=where,
+                )
+            )
+
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+                position=pos,
+                parameter="hello",
+            )
+        )
+
+        # ExceptSelf (default): near got it; sender and far did not
+        # (local_message.rs:61-69).
+        assert [m.parameter for m in h.received(near, Instruction.LOCAL_MESSAGE)] == ["hello"]
+        assert h.received(sender, Instruction.LOCAL_MESSAGE) == []
+        assert h.received(far, Instruction.LOCAL_MESSAGE) == []
+
+        # IncludingSelf reaches the sender too (local_message.rs:70-76).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+                position=pos,
+                replication=Replication.INCLUDING_SELF,
+            )
+        )
+        assert len(h.received(sender, Instruction.LOCAL_MESSAGE)) == 1
+        assert len(h.received(near, Instruction.LOCAL_MESSAGE)) == 2
+
+        # OnlySelf reaches only the sender (local_message.rs:77-85).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+                position=pos,
+                replication=Replication.ONLY_SELF,
+            )
+        )
+        assert len(h.received(sender, Instruction.LOCAL_MESSAGE)) == 2
+        assert len(h.received(near, Instruction.LOCAL_MESSAGE)) == 2
+        return True
+
+    assert run(scenario())
+
+
+def test_local_message_invalid_inputs_dropped():
+    async def scenario():
+        h = Harness()
+        sender = await h.add_peer()
+        other = await h.add_peer()
+        pos = Vector3(1, 1, 1)
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                sender_uuid=other,
+                world_name="world",
+                position=pos,
+            )
+        )
+
+        # @global world rejected (local_message.rs:17-24)
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="@global",
+                position=pos,
+            )
+        )
+        # missing position rejected (local_message.rs:26-37)
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+            )
+        )
+        # invalid world name rejected (local_message.rs:40-50)
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="0bad",
+                position=pos,
+            )
+        )
+        assert h.received(other, Instruction.LOCAL_MESSAGE) == []
+
+        # NaN position must not kill the router: quantizes to cube
+        # (+size,+size,+size) via Rust-saturating-cast semantics, the
+        # same arithmetic the reference executes on NaN.
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+                position=Vector3(float("nan"), 0.5, 0.5),
+            )
+        )
+
+        # Router still alive afterwards
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=sender,
+                world_name="world",
+                position=pos,
+            )
+        )
+        assert len(h.received(other, Instruction.LOCAL_MESSAGE)) >= 1
+        return True
+
+    assert run(scenario())
+
+
+def test_global_message_world_and_global():
+    async def scenario():
+        h = Harness()
+        a = await h.add_peer()
+        b = await h.add_peer()
+        c = await h.add_peer()
+
+        # b subscribed anywhere in "world"; c in a different world.
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                sender_uuid=b,
+                world_name="world",
+                position=Vector3(1000, 0, 0),
+            )
+        )
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                sender_uuid=c,
+                world_name="other",
+                position=Vector3(0, 0, 0),
+            )
+        )
+
+        # World-scoped global: any-cube subscribers (global_message.rs:58-84).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.GLOBAL_MESSAGE,
+                sender_uuid=a,
+                world_name="world",
+                parameter="w",
+            )
+        )
+        assert [m.parameter for m in h.received(b, Instruction.GLOBAL_MESSAGE)] == ["w"]
+        assert h.received(c, Instruction.GLOBAL_MESSAGE) == []
+
+        # @global reaches all connected peers except sender
+        # (global_message.rs:18-24).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.GLOBAL_MESSAGE,
+                sender_uuid=a,
+                world_name="@global",
+                parameter="g",
+            )
+        )
+        assert [m.parameter for m in h.received(b, Instruction.GLOBAL_MESSAGE)] == ["w", "g"]
+        assert [m.parameter for m in h.received(c, Instruction.GLOBAL_MESSAGE)] == ["g"]
+        assert h.received(a, Instruction.GLOBAL_MESSAGE) == []
+        return True
+
+    assert run(scenario())
+
+
+def test_heartbeat_echo_and_tracking():
+    async def scenario():
+        h = Harness()
+        p = await h.add_peer(tracks_heartbeat=True)
+        peer = h.peer_map.get(p)
+        before = peer.last_heartbeat
+
+        await asyncio.sleep(0.01)
+        await h.router.handle_message(
+            Message(instruction=Instruction.HEARTBEAT, sender_uuid=p)
+        )
+
+        # Echo with nil sender (heartbeat.rs:36-42)
+        echoes = h.received(p, Instruction.HEARTBEAT)
+        assert len(echoes) == 1
+        assert echoes[0].sender_uuid == NIL_UUID
+        assert peer.last_heartbeat > before
+
+        # Unknown peer heartbeat: logged, not fatal (heartbeat.rs:21-29)
+        await h.router.handle_message(
+            Message(instruction=Instruction.HEARTBEAT, sender_uuid=uuid.uuid4())
+        )
+        return True
+
+    assert run(scenario())
+
+
+def test_disconnect_cleans_subscriptions():
+    async def scenario():
+        h = Harness()
+        p1 = await h.add_peer()
+        p2 = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        for p in (p1, p2):
+            await h.router.handle_message(
+                Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    sender_uuid=p,
+                    world_name="world",
+                    position=pos,
+                )
+            )
+        await h.peer_map.remove(p2)
+
+        # Subscription index no longer contains p2 (thread.rs:124-126).
+        assert h.backend.query_cube("world", pos) == {p1}
+        return True
+
+    assert run(scenario())
+
+
+def test_client_bound_instructions_dropped_not_fatal():
+    async def scenario():
+        h = Harness()
+        p = await h.add_peer()
+        for instruction in (
+            Instruction.HANDSHAKE,
+            Instruction.PEER_CONNECT,
+            Instruction.PEER_DISCONNECT,
+            Instruction.RECORD_REPLY,
+            Instruction.UNKNOWN,
+        ):
+            await h.router.handle_message(
+                Message(instruction=instruction, sender_uuid=p)
+            )
+        # Router alive: heartbeat still echoes.
+        await h.router.handle_message(
+            Message(instruction=Instruction.HEARTBEAT, sender_uuid=p)
+        )
+        assert len(h.received(p, Instruction.HEARTBEAT)) == 1
+        return True
+
+    assert run(scenario())
+
+
+def test_record_create_read_dedupe_delete():
+    async def scenario():
+        h = Harness()
+        p = await h.add_peer()
+        rec_id = uuid.uuid4()
+        pos = Vector3(5, 5, 5)
+
+        def record(data):
+            return Record(uuid=rec_id, position=pos, world_name="world", data=data)
+
+        # Create twice: insert-time duplicate tolerance (client.rs:86-228).
+        for data in ("v1", "v2"):
+            await h.router.handle_message(
+                Message(
+                    instruction=Instruction.RECORD_CREATE,
+                    sender_uuid=p,
+                    world_name="world",
+                    records=[record(data)],
+                )
+            )
+
+        # Read: newest-per-uuid dedupe, RecordReply to requester only
+        # (record_read.rs:61-123).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.RECORD_READ,
+                sender_uuid=p,
+                world_name="world",
+                position=pos,
+            )
+        )
+        replies = h.received(p, Instruction.RECORD_REPLY)
+        assert len(replies) == 1
+        assert len(replies[0].records) == 1
+        assert replies[0].records[0].uuid == rec_id
+
+        # Read-repair pruned the stale duplicate row.
+        rows = await h.store.get_records_in_region("world", pos)
+        assert len(rows) == 1
+
+        # Delete removes the row (record_delete.rs, client.rs:365-399).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.RECORD_DELETE,
+                sender_uuid=p,
+                world_name="world",
+                records=[record(None)],
+            )
+        )
+        assert await h.store.get_records_in_region("world", pos) == []
+
+        # Empty region read sends no reply (record_read.rs:56-58).
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.RECORD_READ,
+                sender_uuid=p,
+                world_name="world",
+                position=pos,
+            )
+        )
+        assert len(h.received(p, Instruction.RECORD_REPLY)) == 1
+        return True
+
+    assert run(scenario())
+
+
+def test_record_update_is_implemented():
+    """The reference panics on RecordUpdate (thread.rs:168 todo!());
+    we treat it as append (dedupe-on-read collapses versions)."""
+
+    async def scenario():
+        h = Harness()
+        p = await h.add_peer()
+        rec_id = uuid.uuid4()
+        pos = Vector3(1, 2, 3)
+        await h.router.handle_message(
+            Message(
+                instruction=Instruction.RECORD_UPDATE,
+                sender_uuid=p,
+                world_name="world",
+                records=[
+                    Record(uuid=rec_id, position=pos, world_name="world", data="x")
+                ],
+            )
+        )
+        rows = await h.store.get_records_in_region("world", pos)
+        assert len(rows) == 1
+        return True
+
+    assert run(scenario())
+
+
+def test_config_validation():
+    config = Config()
+    config.validate()  # defaults OK
+
+    bad = Config()
+    bad.zmq_timeout_secs = 5
+    with pytest.raises(ValueError, match="at least 10"):
+        bad.validate()
+
+    bad = Config()
+    bad.db_table_size = 1000  # not divisible by 256
+    with pytest.raises(ValueError, match="divisible"):
+        bad.validate()
+
+    bad = Config()
+    bad.ws_port = bad.http_port = 9999
+    with pytest.raises(ValueError, match="clashes"):
+        bad.validate()
+
+    bad = Config()
+    bad.sub_region_size = 0
+    with pytest.raises(ValueError, match="greater than 0"):
+        bad.validate()

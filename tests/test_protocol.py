@@ -1,0 +1,287 @@
+"""Wire codec tests: roundtrips, required-field semantics, malformed input.
+
+Required/default semantics mirror the reference decoder
+(structures/message.rs:56-111) and the FlatBuffers layout constants in
+WorldQLFB_generated.rs (see worldql.fbs for the slot map).
+"""
+
+import struct
+import uuid
+
+import pytest
+
+from worldql_server_tpu.protocol import (
+    NIL_UUID,
+    DeserializeError,
+    Entity,
+    Instruction,
+    Message,
+    Record,
+    Replication,
+    Vector3,
+    deserialize_message,
+    serialize_message,
+)
+
+
+def roundtrip(msg: Message) -> Message:
+    return deserialize_message(serialize_message(msg))
+
+
+def test_minimal_default_message():
+    msg = Message()
+    out = roundtrip(msg)
+    assert out.instruction == Instruction.UNKNOWN
+    assert out.sender_uuid == NIL_UUID
+    assert out.world_name == ""
+    assert out.replication == Replication.EXCEPT_SELF
+    assert out.parameter is None
+    assert out.records == []
+    assert out.entities == []
+    assert out.position is None
+    assert out.flex is None
+
+
+@pytest.mark.parametrize("instruction", list(Instruction))
+def test_all_instructions_roundtrip(instruction):
+    msg = Message(instruction=instruction, sender_uuid=uuid.uuid4())
+    assert roundtrip(msg).instruction == instruction
+
+
+@pytest.mark.parametrize("replication", list(Replication))
+def test_all_replications_roundtrip(replication):
+    msg = Message(replication=replication)
+    assert roundtrip(msg).replication == replication
+
+
+def test_full_message_roundtrip():
+    sender = uuid.uuid4()
+    rec_id = uuid.uuid4()
+    ent_id = uuid.uuid4()
+    msg = Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        parameter="param-value",
+        sender_uuid=sender,
+        world_name="overworld",
+        replication=Replication.INCLUDING_SELF,
+        records=[
+            Record(
+                uuid=rec_id,
+                position=Vector3(1.5, -2.25, 1e9),
+                world_name="overworld",
+                data='{"kind": "chest"}',
+                flex=b"\x00\x01\xff",
+            ),
+            Record(uuid=rec_id, world_name="overworld"),  # no position
+        ],
+        entities=[
+            Entity(
+                uuid=ent_id,
+                position=Vector3(-0.0, 123.456, -9e5),
+                world_name="overworld",
+                data="entity-data",
+                flex=b"raw",
+            )
+        ],
+        position=Vector3(10.0, 64.0, -10.0),
+        flex=b"\xde\xad\xbe\xef",
+    )
+
+    out = roundtrip(msg)
+    assert out.instruction == Instruction.LOCAL_MESSAGE
+    assert out.parameter == "param-value"
+    assert out.sender_uuid == sender
+    assert out.world_name == "overworld"
+    assert out.replication == Replication.INCLUDING_SELF
+    assert out.position == Vector3(10.0, 64.0, -10.0)
+    assert out.flex == b"\xde\xad\xbe\xef"
+
+    assert len(out.records) == 2
+    r0 = out.records[0]
+    assert (r0.uuid, r0.world_name, r0.data, r0.flex) == (
+        rec_id,
+        "overworld",
+        '{"kind": "chest"}',
+        b"\x00\x01\xff",
+    )
+    assert r0.position == Vector3(1.5, -2.25, 1e9)
+    assert out.records[1].position is None
+
+    e0 = out.entities[0]
+    assert e0.uuid == ent_id
+    assert e0.position == Vector3(-0.0, 123.456, -9e5)
+
+
+def test_f64_precision_preserved():
+    # Exact f64 bit patterns must survive the wire (grid parity depends on it).
+    vals = (1e-308, 16.000000000000004, -0.1 + 0.3)
+    msg = Message(position=Vector3(*vals))
+    out = roundtrip(msg)
+    assert struct.pack("<3d", *vals) == struct.pack("<3d", *out.position.as_tuple())
+
+
+def test_unicode_strings():
+    msg = Message(parameter="héllo wörld \N{SNOWMAN}", world_name="world")
+    assert roundtrip(msg).parameter == "héllo wörld \N{SNOWMAN}"
+
+
+def test_empty_flex_and_strings():
+    msg = Message(parameter="", flex=b"")
+    out = roundtrip(msg)
+    assert out.parameter == ""
+    assert out.flex == b""
+
+
+def test_invalid_sender_uuid_rejected():
+    # Hand-build a buffer whose sender_uuid string is not a UUID.
+    import flatbuffers
+
+    b = flatbuffers.Builder(64)
+    bad = b.CreateString("not-a-uuid")
+    world = b.CreateString("world")
+    b.StartObject(9)
+    b.PrependUOffsetTRelativeSlot(2, bad, 0)
+    b.PrependUOffsetTRelativeSlot(3, world, 0)
+    root = b.EndObject()
+    b.Finish(root)
+    with pytest.raises(DeserializeError):
+        deserialize_message(bytes(b.Output()))
+
+
+def test_missing_required_fields_rejected():
+    import flatbuffers
+
+    # Missing world_name (only sender present)
+    b = flatbuffers.Builder(64)
+    sender = b.CreateString(str(NIL_UUID))
+    b.StartObject(9)
+    b.PrependUOffsetTRelativeSlot(2, sender, 0)
+    root = b.EndObject()
+    b.Finish(root)
+    with pytest.raises(DeserializeError, match="world_name"):
+        deserialize_message(bytes(b.Output()))
+
+    # Empty table: missing sender_uuid
+    b = flatbuffers.Builder(64)
+    b.StartObject(9)
+    root = b.EndObject()
+    b.Finish(root)
+    with pytest.raises(DeserializeError, match="sender_uuid"):
+        deserialize_message(bytes(b.Output()))
+
+
+@pytest.mark.parametrize(
+    "junk",
+    [
+        b"",
+        b"\x00",
+        b"\x00\x00\x00\x00",
+        b"\xff" * 64,
+        b"\x04\x00\x00\x00" + b"\x00" * 4,
+        bytes(range(256)),
+    ],
+)
+def test_malformed_buffers_raise_typed_error(junk):
+    with pytest.raises(DeserializeError):
+        deserialize_message(junk)
+
+
+def test_malformed_fuzz_never_crashes():
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    good = serialize_message(
+        Message(
+            instruction=Instruction.LOCAL_MESSAGE,
+            sender_uuid=uuid.uuid4(),
+            world_name="world",
+            position=Vector3(1, 2, 3),
+            records=[Record(uuid=uuid.uuid4(), world_name="world")],
+        )
+    )
+    for _ in range(500):
+        buf = bytearray(good)
+        for _ in range(rng.randint(1, 8)):
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        try:
+            deserialize_message(bytes(buf))
+        except DeserializeError:
+            pass  # typed failure is the contract
+
+
+def test_wire_default_instruction_is_heartbeat():
+    """A buffer that omits the instruction field decodes as Heartbeat(0),
+    matching the wire default (WorldQLFB_generated.rs:951)."""
+    import flatbuffers
+
+    b = flatbuffers.Builder(64)
+    sender = b.CreateString(str(NIL_UUID))
+    world = b.CreateString("w")
+    b.StartObject(9)
+    b.PrependUOffsetTRelativeSlot(2, sender, 0)
+    b.PrependUOffsetTRelativeSlot(3, world, 0)
+    root = b.EndObject()
+    b.Finish(root)
+    out = deserialize_message(bytes(b.Output()))
+    assert out.instruction == Instruction.HEARTBEAT
+
+
+def test_out_of_range_enum_values_degrade_gracefully():
+    """Unknown instruction byte → UNKNOWN; unknown replication → EXCEPT_SELF
+    (instruction.rs:73, replication.rs:31-35)."""
+    import flatbuffers
+
+    b = flatbuffers.Builder(64)
+    sender = b.CreateString(str(NIL_UUID))
+    world = b.CreateString("w")
+    b.StartObject(9)
+    b.PrependUint8Slot(0, 200, 0)
+    b.PrependUOffsetTRelativeSlot(2, sender, 0)
+    b.PrependUOffsetTRelativeSlot(3, world, 0)
+    b.PrependUint8Slot(4, 77, 0)
+    root = b.EndObject()
+    b.Finish(root)
+    out = deserialize_message(bytes(b.Output()))
+    assert out.instruction == Instruction.UNKNOWN
+    assert out.replication == Replication.EXCEPT_SELF
+
+
+def test_entity_requires_position():
+    import flatbuffers
+
+    b = flatbuffers.Builder(128)
+    # entity table without position
+    euuid = b.CreateString(str(NIL_UUID))
+    eworld = b.CreateString("w")
+    b.StartObject(5)
+    b.PrependUOffsetTRelativeSlot(0, euuid, 0)
+    b.PrependUOffsetTRelativeSlot(2, eworld, 0)
+    ent = b.EndObject()
+
+    b.StartVector(4, 1, 4)
+    b.PrependUOffsetTRelative(ent)
+    vec = b.EndVector()
+
+    sender = b.CreateString(str(NIL_UUID))
+    world = b.CreateString("w")
+    b.StartObject(9)
+    b.PrependUOffsetTRelativeSlot(2, sender, 0)
+    b.PrependUOffsetTRelativeSlot(3, world, 0)
+    b.PrependUOffsetTRelativeSlot(6, vec, 0)
+    root = b.EndObject()
+    b.Finish(root)
+
+    with pytest.raises(DeserializeError, match="position"):
+        deserialize_message(bytes(b.Output()))
+
+
+def test_serialize_is_reentrant():
+    """No shared global builder (unlike message.rs:116-117): interleaved
+    serializations must not corrupt each other."""
+    msgs = [
+        Message(instruction=Instruction.HEARTBEAT, world_name=f"w{i}")
+        for i in range(16)
+    ]
+    blobs = [serialize_message(m) for m in msgs]
+    for m, blob in zip(msgs, blobs):
+        assert deserialize_message(blob).world_name == m.world_name

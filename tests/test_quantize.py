@@ -186,3 +186,34 @@ def test_round_by_multiple(args, expected):
 def test_coord_clamp_batch_negative_zero():
     out = coord_clamp_batch(np.array([-0.0, 0.0]), 10)
     assert list(out) == [10, 10]
+
+
+def test_saturating_cast_edge_cases():
+    """Rust `as i64` saturating-cast semantics at the extremes; scalar
+    and batch forms must agree on every special value."""
+    I64_MAX = 2**63 - 1
+
+    # inf saturates (scalar == batch)
+    assert coord_clamp(float("inf"), 16) == I64_MAX
+    assert coord_clamp(float("-inf"), 16) == -I64_MAX
+    # NaN follows the reference's arithmetic: lands in cube +size
+    assert coord_clamp(float("nan"), 16) == 16
+    # huge finite saturates; -1e19 is an exact multiple of 16 in f64, so
+    # it takes the `coord as i64` path and saturates to i64::MIN
+    assert coord_clamp(1e19, 16) == I64_MAX
+    assert coord_clamp(-1e19, 16) == -(2**63)
+
+    specials = np.array([float("inf"), float("-inf"), float("nan"), 1e19, -1e19, 0.0, -0.0, 16.0, 1e18])
+    batch = coord_clamp_batch(specials, 16)
+    for v, got in zip(specials, batch):
+        assert got == coord_clamp(float(v), 16), v
+
+    # region: NaN refuses (reference stack-overflows there), inf saturates
+    with pytest.raises(ValueError):
+        clamp_region_coord(float("nan"), 16)
+    with pytest.raises(ValueError):
+        clamp_region_coord_batch(np.array([1.0, float("nan")]), 16)
+    region_specials = np.array([float("inf"), float("-inf"), 1e19, -1e19, 1e18])
+    rbatch = clamp_region_coord_batch(region_specials, 16)
+    for v, got in zip(region_specials, rbatch):
+        assert got == clamp_region_coord(float(v), 16), v

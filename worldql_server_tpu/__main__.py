@@ -1,0 +1,97 @@
+"""CLI entry point.
+
+Flag surface mirrors the reference's clap Args (worldql_server/src/
+args.rs:21-129): every flag falls back to a ``WQL_*`` environment
+variable (handled in Config), ``-v`` stacks verbosity
+(main.rs:54-65), and validation failures exit 1 (main.rs:101-104).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from .engine.config import Config
+from .engine.server import WorldQLServer
+from . import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="worldql-server-tpu",
+        description="TPU-native real-time spatial message broker",
+    )
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--store-url", help="record store url (sqlite://PATH, memory://, postgres://…)")
+    p.add_argument("--sub-region-size", type=int, help="subscription cube size (default 16)")
+    p.add_argument("--db-region-x-size", type=int)
+    p.add_argument("--db-region-y-size", type=int)
+    p.add_argument("--db-region-z-size", type=int)
+    p.add_argument("--db-table-size", type=int)
+    p.add_argument("--db-cache-size", type=int)
+    p.add_argument("--http-host")
+    p.add_argument("--http-port", type=int)
+    p.add_argument("--http-auth-token")
+    p.add_argument("--no-http", action="store_true")
+    p.add_argument("--ws-host")
+    p.add_argument("--ws-port", type=int)
+    p.add_argument("--no-ws", action="store_true")
+    p.add_argument("--zmq-server-host")
+    p.add_argument("--zmq-server-port", type=int)
+    p.add_argument("--zmq-timeout-secs", type=int)
+    p.add_argument("--no-zmq", action="store_true")
+    p.add_argument("--spatial-backend", choices=["cpu", "tpu"])
+    p.add_argument("--tick-interval", type=float)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p
+
+
+_OVERRIDES = [
+    "store_url", "sub_region_size", "db_region_x_size", "db_region_y_size",
+    "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
+    "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
+    "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
+]
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    config = Config()
+    for name in _OVERRIDES:
+        value = getattr(args, name, None)
+        if value is not None:
+            setattr(config, name, value)
+    config.http_enabled = not args.no_http
+    config.ws_enabled = not args.no_ws
+    config.zmq_enabled = not args.no_zmq
+    config.verbose = args.verbose
+    return config
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+    )
+
+    config = config_from_args(args)
+    try:
+        config.validate()
+    except ValueError as exc:
+        print(f"config error: {exc}", file=sys.stderr)
+        return 1
+
+    server = WorldQLServer(config)
+    try:
+        asyncio.run(server.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

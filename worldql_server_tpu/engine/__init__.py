@@ -1,0 +1,6 @@
+from .config import Config
+from .peers import Peer, PeerMap
+from .router import Router
+from .server import WorldQLServer
+
+__all__ = ["Config", "Peer", "PeerMap", "Router", "WorldQLServer"]

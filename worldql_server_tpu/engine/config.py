@@ -1,0 +1,149 @@
+"""Server configuration.
+
+Mirrors the reference's CLI/config surface (worldql_server/src/args.rs):
+every flag has an environment-variable fallback, non-zero constraints
+are enforced, the ZeroMQ timeout has a 10-second floor
+(args.rs:172-182), the DB table size must divide evenly by each region
+axis (args.rs:186-226), listening ports must be distinct
+(main.rs:73-98), and a sub-region size under 10 logs a performance
+warning (args.rs:189-191).
+
+New knobs beyond the reference are grouped at the bottom: spatial
+backend selection, the batched tick interval, and store URL (the
+reference is Postgres-only; we default to SQLite so the server runs
+self-contained).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class Config:
+    # Record store (reference: --psql, args.rs:24-25)
+    store_url: str = field(
+        default_factory=lambda: _env("WQL_STORE_URL", "sqlite://worldql.db")
+    )
+
+    # Subscription cube size (args.rs:30-31)
+    sub_region_size: int = field(
+        default_factory=lambda: int(_env("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", "16"))
+    )
+
+    # DB region/table sharding (args.rs:36-61)
+    db_region_x_size: int = field(
+        default_factory=lambda: int(_env("WQL_DB_REGION_X_SIZE", "16"))
+    )
+    db_region_y_size: int = field(
+        default_factory=lambda: int(_env("WQL_DB_REGION_Y_SIZE", "256"))
+    )
+    db_region_z_size: int = field(
+        default_factory=lambda: int(_env("WQL_DB_REGION_Z_SIZE", "16"))
+    )
+    db_table_size: int = field(
+        default_factory=lambda: int(_env("WQL_DB_TABLE_SIZE", "1024"))
+    )
+    db_cache_size: int = field(
+        default_factory=lambda: int(_env("WQL_DB_CACHE_SIZE", "1024"))
+    )
+
+    # HTTP (args.rs:66-78)
+    http_enabled: bool = True
+    http_host: str = field(default_factory=lambda: _env("WQL_HTTP_HOST", "0.0.0.0"))
+    http_port: int = field(default_factory=lambda: int(_env("WQL_HTTP_PORT", "8080")))
+    http_auth_token: str | None = field(
+        default_factory=lambda: os.environ.get("WQL_HTTP_AUTH_TOKEN")
+    )
+
+    # WebSocket (args.rs:83-95)
+    ws_enabled: bool = True
+    ws_host: str = field(default_factory=lambda: _env("WQL_WS_HOST", "0.0.0.0"))
+    ws_port: int = field(default_factory=lambda: int(_env("WQL_WS_PORT", "8081")))
+
+    # ZeroMQ (args.rs:99-119)
+    zmq_enabled: bool = True
+    zmq_server_host: str = field(
+        default_factory=lambda: _env("WQL_ZMQ_SERVER_HOST", "0.0.0.0")
+    )
+    zmq_server_port: int = field(
+        default_factory=lambda: int(_env("WQL_ZMQ_SERVER_PORT", "5555"))
+    )
+    zmq_timeout_secs: int = field(
+        default_factory=lambda: int(_env("WQL_ZMQ_TIMEOUT_SECS", "25"))
+    )
+
+    verbose: int = 0
+
+    # --- rebuild-specific knobs ------------------------------------
+    # 'cpu' | 'tpu' — which SpatialBackend answers proximity queries.
+    spatial_backend: str = field(
+        default_factory=lambda: _env("WQL_SPATIAL_BACKEND", "cpu")
+    )
+    # Batched-tick window in seconds for the TPU backend; 0 = flush
+    # per message (reference-equivalent immediate semantics).
+    tick_interval: float = field(
+        default_factory=lambda: float(_env("WQL_TICK_INTERVAL", "0"))
+    )
+
+    def validate(self) -> None:
+        """Cross-field validation; raises ValueError on any violation
+        (args.rs:145-226, main.rs:73-98)."""
+        errors: list[str] = []
+
+        for name in (
+            "sub_region_size",
+            "db_region_x_size",
+            "db_region_y_size",
+            "db_region_z_size",
+            "db_table_size",
+        ):
+            if getattr(self, name) <= 0:
+                errors.append(f"{name} must be greater than 0")
+        if self.db_cache_size < 0:
+            errors.append("db_cache_size must be >= 0")
+
+        if self.sub_region_size < 10:
+            logger.warning(
+                "sub-region sizes less than 10 might impact lookup performance"
+            )
+
+        if self.zmq_enabled and self.zmq_timeout_secs < 10:
+            errors.append("zmq_timeout_secs must be at least 10 seconds")
+
+        for axis in ("x", "y", "z"):
+            region = getattr(self, f"db_region_{axis}_size")
+            if region > 0 and self.db_table_size % region != 0:
+                errors.append(
+                    f"db_table_size must be evenly divisible by db_region_{axis}_size"
+                )
+
+        ports = []
+        if self.http_enabled:
+            ports.append(("http_port", self.http_port))
+        if self.ws_enabled:
+            ports.append(("ws_port", self.ws_port))
+        if self.zmq_enabled:
+            ports.append(("zmq_server_port", self.zmq_server_port))
+        seen: dict[int, str] = {}
+        for name, port in ports:
+            if port in seen:
+                errors.append(f"{name} clashes with {seen[port]} (both {port})")
+            else:
+                seen[port] = name
+
+        if self.spatial_backend not in ("cpu", "tpu"):
+            errors.append("spatial_backend must be 'cpu' or 'tpu'")
+        if self.tick_interval < 0:
+            errors.append("tick_interval must be >= 0")
+
+        if errors:
+            raise ValueError("; ".join(errors))

@@ -1,0 +1,178 @@
+"""Peer registry and broadcast hub.
+
+Python rebuild of the reference's Peer/PeerMap
+(worldql_server/src/transport/peer.rs, peer_map.rs). One asyncio event
+loop replaces the Rust ``Arc<RwLock<PeerMap>>``: map mutations are
+atomic between awaits, and broadcasts serialize the message once then
+fan out concurrently (peer_map.rs:22-40).
+
+Transports supply an async ``send_raw(bytes)`` and may mark themselves
+heartbeat-tracked (ZeroMQ-style, staleness-swept) or not
+(WebSocket-style, liveness == stream health; peer.rs:59-69).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid as uuid_mod
+from typing import Awaitable, Callable, Iterable
+
+from ..protocol import Instruction, Message, serialize_message
+
+logger = logging.getLogger(__name__)
+
+SendRaw = Callable[[bytes], Awaitable[None]]
+OnRemove = Callable[[uuid_mod.UUID], None]
+
+
+class PeerSendError(Exception):
+    pass
+
+
+class Peer:
+    """Uniform outbound handle over any transport (peer.rs:33-88)."""
+
+    __slots__ = ("uuid", "addr", "kind", "_send_raw", "tracks_heartbeat",
+                 "last_heartbeat", "closed")
+
+    def __init__(
+        self,
+        uuid: uuid_mod.UUID,
+        addr: str,
+        send_raw: SendRaw,
+        kind: str = "unknown",
+        tracks_heartbeat: bool = False,
+    ):
+        self.uuid = uuid
+        self.addr = addr
+        self.kind = kind
+        self._send_raw = send_raw
+        self.tracks_heartbeat = tracks_heartbeat
+        self.last_heartbeat = time.monotonic()
+        self.closed = False
+
+    def update_last_heartbeat(self) -> None:
+        self.last_heartbeat = time.monotonic()
+
+    def is_stale(self, now: float, max_age_secs: float) -> bool:
+        """Heartbeat-tracked peers go stale; stream peers never do
+        (peer.rs:59-69)."""
+        if not self.tracks_heartbeat:
+            return False
+        return (now - self.last_heartbeat) > max_age_secs
+
+    async def send(self, message: Message) -> None:
+        await self.send_raw(serialize_message(message))
+
+    async def send_raw(self, data: bytes) -> None:
+        if self.closed:
+            raise PeerSendError(f"peer {self.uuid} is closed")
+        try:
+            await self._send_raw(data)
+        except Exception as exc:
+            raise PeerSendError(str(exc)) from exc
+
+    def __repr__(self) -> str:
+        return f"Peer({self.kind}, {self.uuid}, {self.addr})"
+
+
+class PeerMap:
+    """UUID → Peer registry + broadcast primitives (peer_map.rs:16-176).
+
+    ``on_remove`` mirrors the reference's remove channel
+    (peer_map.rs:139): the engine hooks it to purge the spatial index
+    when a peer disconnects.
+    """
+
+    def __init__(self, on_remove: OnRemove | None = None):
+        self._map: dict[uuid_mod.UUID, Peer] = {}
+        self._on_remove = on_remove
+
+    # region: lookups
+
+    def __contains__(self, uuid: uuid_mod.UUID) -> bool:
+        return uuid in self._map
+
+    def get(self, uuid: uuid_mod.UUID) -> Peer | None:
+        return self._map.get(uuid)
+
+    def size(self) -> int:
+        return len(self._map)
+
+    def peer_ids(self) -> list[uuid_mod.UUID]:
+        return list(self._map.keys())
+
+    def stale_peers(self, max_age_secs: float) -> list[uuid_mod.UUID]:
+        now = time.monotonic()
+        return [
+            p.uuid for p in self._map.values() if p.is_stale(now, max_age_secs)
+        ]
+
+    # endregion
+
+    # region: modifiers
+
+    async def insert(self, peer: Peer) -> Peer | None:
+        """Register a peer and announce PeerConnect to everyone else
+        (peer_map.rs:100-116)."""
+        logger.info("[%s] %s peer connected", peer.addr, peer.kind)
+        existing = self._map.get(peer.uuid)
+        self._map[peer.uuid] = peer
+
+        await self.broadcast_except(
+            Message(
+                instruction=Instruction.PEER_CONNECT,
+                parameter=str(peer.uuid),
+            ),
+            peer.uuid,
+        )
+        return existing
+
+    async def remove(self, uuid: uuid_mod.UUID) -> Peer | None:
+        """Drop a peer, announce PeerDisconnect to all remaining peers,
+        and fire the removal hook (peer_map.rs:121-141)."""
+        peer = self._map.pop(uuid, None)
+        if peer is not None:
+            peer.closed = True
+            logger.info("[%s] %s peer disconnected", peer.addr, peer.kind)
+            await self.broadcast_all(
+                Message(
+                    instruction=Instruction.PEER_DISCONNECT,
+                    parameter=str(uuid),
+                )
+            )
+        if self._on_remove is not None:
+            self._on_remove(uuid)
+        return peer
+
+    # endregion
+
+    # region: broadcasts — serialize once, send concurrently
+
+    async def _broadcast(self, message: Message, peers: Iterable[Peer]) -> None:
+        data = serialize_message(message)
+        results = await asyncio.gather(
+            *(p.send_raw(data) for p in peers), return_exceptions=True
+        )
+        for result in results:
+            if isinstance(result, Exception):
+                logger.debug("broadcast error: %s", result)
+
+    async def broadcast_all(self, message: Message) -> None:
+        await self._broadcast(message, list(self._map.values()))
+
+    async def broadcast_to(
+        self, message: Message, uuids: Iterable[uuid_mod.UUID]
+    ) -> None:
+        peers = [self._map[u] for u in set(uuids) if u in self._map]
+        await self._broadcast(message, peers)
+
+    async def broadcast_except(
+        self, message: Message, except_uuid: uuid_mod.UUID
+    ) -> None:
+        peers = [p for p in self._map.values() if p.uuid != except_uuid]
+        await self._broadcast(message, peers)
+
+    # endregion

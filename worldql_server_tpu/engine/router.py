@@ -1,0 +1,305 @@
+"""Instruction router: the processing layer.
+
+Python rebuild of the reference's processing thread + handlers
+(worldql_server/src/processing/). Dispatch table follows
+thread.rs:72-108: heartbeats are handled inline; subscription ops and
+pub/sub messages hit the spatial backend; record ops hit the store.
+Client-bound instructions (Handshake, PeerConnect/Disconnect,
+RecordReply) arriving inbound are dropped with a warning — the
+reference panics (thread.rs:74-79), but a client must never be able to
+kill the server, so we log instead.
+
+Every handler is wrapped in per-message error isolation: a hostile
+payload (e.g. NaN positions overflowing quantization) drops that
+message, never the server.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid as uuid_mod
+
+from ..protocol import Instruction, Message, Replication
+from ..spatial.backend import LocalQuery, SpatialBackend
+from ..storage.store import RecordStore
+from ..utils.names import GLOBAL_WORLD, SanitizeError, sanitize_world_name
+from ..utils.timeutil import parse_epoch_millis
+from .peers import PeerMap
+
+logger = logging.getLogger(__name__)
+
+NIL = uuid_mod.UUID(int=0)
+
+
+class Router:
+    def __init__(
+        self,
+        peer_map: PeerMap,
+        backend: SpatialBackend,
+        store: RecordStore,
+    ):
+        self.peer_map = peer_map
+        self.backend = backend
+        self.store = store
+
+    async def handle_message(self, message: Message) -> None:
+        """Route one inbound message (thread.rs:72-108). Never raises."""
+        try:
+            await self._dispatch(message)
+        except Exception:
+            logger.exception(
+                "error handling %s from %s — message dropped",
+                message.instruction.name,
+                message.sender_uuid,
+            )
+
+    async def _dispatch(self, message: Message) -> None:
+        instruction = message.instruction
+
+        if instruction == Instruction.HEARTBEAT:
+            await self._heartbeat(message)
+        elif instruction == Instruction.AREA_SUBSCRIBE:
+            self._area_subscribe(message)
+        elif instruction == Instruction.AREA_UNSUBSCRIBE:
+            self._area_unsubscribe(message)
+        elif instruction == Instruction.LOCAL_MESSAGE:
+            await self._local_message(message)
+        elif instruction == Instruction.GLOBAL_MESSAGE:
+            await self._global_message(message)
+        elif instruction == Instruction.RECORD_CREATE:
+            await self._record_create(message)
+        elif instruction == Instruction.RECORD_READ:
+            await self._record_read(message)
+        elif instruction == Instruction.RECORD_UPDATE:
+            # The reference leaves this unimplemented (thread.rs:168,
+            # `todo!()`). Store inserts are append-with-dedupe-on-read,
+            # so update == create; implemented rather than crashing.
+            await self._record_create(message)
+        elif instruction == Instruction.RECORD_DELETE:
+            await self._record_delete(message)
+        elif instruction in (
+            Instruction.HANDSHAKE,
+            Instruction.PEER_CONNECT,
+            Instruction.PEER_DISCONNECT,
+            Instruction.RECORD_REPLY,
+        ):
+            logger.warning(
+                "client-bound instruction %s received from %s — dropped",
+                instruction.name,
+                message.sender_uuid,
+            )
+        else:
+            logger.warning(
+                "Unknown instruction received from %s", message.sender_uuid
+            )
+
+    # region: heartbeat (processing/heartbeat.rs:9-44)
+
+    async def _heartbeat(self, message: Message) -> None:
+        peer = self.peer_map.get(message.sender_uuid)
+        if peer is None:
+            logger.warning("missing peer for heartbeat: %s", message.sender_uuid)
+            return
+        peer.update_last_heartbeat()
+        await peer.send(message.with_(sender_uuid=NIL))
+
+    # endregion
+
+    # region: subscriptions (processing/area_subscribe.rs, area_unsubscribe.rs)
+
+    def _sanitize_or_log(self, message: Message) -> str | None:
+        try:
+            return sanitize_world_name(message.world_name)
+        except SanitizeError as exc:
+            logger.warning(
+                "peer %s sent invalid world name: %s (%s)",
+                message.sender_uuid,
+                message.world_name,
+                exc,
+            )
+            return None
+
+    def _area_subscribe(self, message: Message) -> None:
+        if message.world_name == GLOBAL_WORLD:
+            return
+        world = self._sanitize_or_log(message)
+        if world is None:
+            return
+        if message.position is None:
+            logger.debug(
+                "invalid AreaSubscribe from %s, missing position",
+                message.sender_uuid,
+            )
+            return
+        self.backend.add_subscription(world, message.sender_uuid, message.position)
+
+    def _area_unsubscribe(self, message: Message) -> None:
+        if message.world_name == GLOBAL_WORLD:
+            return
+        world = self._sanitize_or_log(message)
+        if world is None:
+            return
+        if message.position is None:
+            logger.debug(
+                "invalid AreaUnsubscribe from %s, missing position",
+                message.sender_uuid,
+            )
+            return
+        self.backend.remove_subscription(
+            world, message.sender_uuid, message.position
+        )
+
+    # endregion
+
+    # region: pub/sub fan-out (processing/local_message.rs, global_message.rs)
+
+    async def _local_message(self, message: Message) -> None:
+        if message.world_name == GLOBAL_WORLD:
+            logger.debug(
+                "invalid LocalMessage from %s, uses @global", message.sender_uuid
+            )
+            return
+        if message.position is None:
+            logger.debug(
+                "invalid LocalMessage from %s, missing position",
+                message.sender_uuid,
+            )
+            return
+        world = self._sanitize_or_log(message)
+        if world is None:
+            return
+
+        [targets] = self.backend.match_local_batch(
+            [
+                LocalQuery(
+                    world=world,
+                    position=message.position,
+                    sender=message.sender_uuid,
+                    replication=message.replication,
+                )
+            ]
+        )
+        if targets:
+            await self.peer_map.broadcast_to(message, targets)
+
+    async def _global_message(self, message: Message) -> None:
+        sender = message.sender_uuid
+        if message.world_name == GLOBAL_WORLD:
+            # World-wide broadcast to every connected peer
+            # (global_message.rs:18-35).
+            if message.replication == Replication.EXCEPT_SELF:
+                await self.peer_map.broadcast_except(message, sender)
+            elif message.replication == Replication.INCLUDING_SELF:
+                await self.peer_map.broadcast_all(message)
+            else:  # ONLY_SELF
+                peer = self.peer_map.get(sender)
+                if peer is None:
+                    logger.warning("missing peer %s for GlobalMessage send", sender)
+                    return
+                await peer.send(message)
+            return
+
+        world = self._sanitize_or_log(message)
+        if world is None:
+            return
+        peers = self.backend.query_world(world)
+        if message.replication == Replication.EXCEPT_SELF:
+            targets = [p for p in peers if p != sender]
+        elif message.replication == Replication.ONLY_SELF:
+            targets = [p for p in peers if p == sender]
+        else:
+            targets = list(peers)
+        if targets:
+            await self.peer_map.broadcast_to(message, targets)
+
+    # endregion
+
+    # region: records (processing/record_create.rs, record_read.rs, record_delete.rs)
+
+    async def _record_create(self, message: Message) -> None:
+        if message.world_name == GLOBAL_WORLD:
+            return
+        try:
+            await self.store.insert_records(message.records)
+        except Exception as exc:
+            logger.warning(
+                "error inserting records for %s: %s", message.sender_uuid, exc
+            )
+
+    async def _record_delete(self, message: Message) -> None:
+        if message.world_name == GLOBAL_WORLD:
+            return
+        try:
+            await self.store.delete_records(message.records)
+        except Exception as exc:
+            logger.warning(
+                "error deleting records for %s: %s", message.sender_uuid, exc
+            )
+
+    async def _record_read(self, message: Message) -> None:
+        """Region read + newest-per-uuid dedupe + read-repair
+        (record_read.rs:11-135)."""
+        if message.world_name == GLOBAL_WORLD:
+            return
+        sender = message.sender_uuid
+
+        if message.position is None:
+            # Reference: todo!() (record_read.rs:135). We log and drop.
+            logger.warning(
+                "RecordRead without position from %s not supported", sender
+            )
+            return
+
+        after = None
+        if message.parameter is not None:
+            try:
+                after = parse_epoch_millis(message.parameter)
+            except ValueError as exc:
+                logger.warning("error parsing timestamp for %s: %s", sender, exc)
+                return
+
+        try:
+            rows = await self.store.get_records_in_region(
+                message.world_name, message.position, after
+            )
+        except Exception as exc:
+            logger.warning("error getting records for %s: %s", sender, exc)
+            return
+        if not rows:
+            return
+
+        # Deduplicate: newest row per record uuid (record_read.rs:61-81).
+        newest: dict[uuid_mod.UUID, tuple] = {}
+        for sr in rows:
+            existing = newest.get(sr.record.uuid)
+            if existing is None or sr.timestamp >= existing[0]:
+                newest[sr.record.uuid] = (sr.timestamp, sr.record)
+
+        dedupe_ops = [
+            (rec.uuid, ts, rec.world_name, rec.position)
+            for ts, rec in newest.values()
+            if rec.position is not None
+        ]
+        records = [rec for _, rec in newest.values()]
+
+        reply = Message(
+            instruction=Instruction.RECORD_REPLY,
+            world_name=message.world_name,
+            records=records,
+        )
+        peer = self.peer_map.get(sender)
+        if peer is None:
+            logger.warning("missing peer %s for RecordReply send", sender)
+            return
+        try:
+            await peer.send(reply)
+        except Exception as exc:
+            logger.debug("RecordReply send failed: %s", exc)
+
+        # Read-repair in the background path (record_read.rs:126-130).
+        try:
+            await self.store.dedupe_records(dedupe_ops)
+        except Exception as exc:
+            logger.warning("error deduping records for %s: %s", sender, exc)
+
+    # endregion

@@ -1,0 +1,124 @@
+"""Server bootstrap and wiring.
+
+Python rebuild of the reference's main.rs: builds the peer map, spatial
+backend, record store and router, starts the enabled transports, and
+runs the ZeroMQ-style staleness sweeper (outgoing.rs:28-47,132-150).
+The reference's task/channel mesh (main.rs:138-207) collapses into one
+asyncio event loop; the transport→router channel hop becomes a direct
+awaited call, removing two queue hops from the hot path (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..spatial.backend import SpatialBackend
+from ..spatial.cpu_backend import CpuSpatialBackend
+from ..storage.store import RecordStore, open_store
+from .config import Config
+from .peers import PeerMap
+from .router import Router
+
+logger = logging.getLogger(__name__)
+
+
+def build_backend(config: Config) -> SpatialBackend:
+    if config.spatial_backend == "tpu":
+        from ..spatial.tpu_backend import TpuSpatialBackend
+
+        return TpuSpatialBackend(config.sub_region_size)
+    return CpuSpatialBackend(config.sub_region_size)
+
+
+class WorldQLServer:
+    def __init__(
+        self,
+        config: Config,
+        backend: SpatialBackend | None = None,
+        store: RecordStore | None = None,
+    ):
+        config.validate()
+        self.config = config
+        self.backend = backend if backend is not None else build_backend(config)
+        self.store = store if store is not None else open_store(
+            config.store_url, config
+        )
+        self.peer_map = PeerMap(on_remove=self._on_peer_remove)
+        self.router = Router(self.peer_map, self.backend, self.store)
+        self._tasks: list[asyncio.Task] = []
+        self._transports: list = []
+        self._started = asyncio.Event()
+
+    def _on_peer_remove(self, uuid) -> None:
+        """Disconnect cleanup: purge the spatial index (the remove_rx
+        path, thread.rs:124-126) and let transports drop socket state."""
+        self.backend.remove_peer(uuid)
+        for transport in self._transports:
+            hook = getattr(transport, "on_peer_removed", None)
+            if hook is not None:
+                hook(uuid)
+
+    async def start(self) -> None:
+        """Bring up the store and all enabled transports (main.rs:106-207)."""
+        await self.store.init()
+
+        if self.config.ws_enabled:
+            from ..transports.websocket import WebSocketTransport
+
+            ws = WebSocketTransport(self)
+            self._transports.append(ws)
+            await ws.start()
+
+        if self.config.http_enabled:
+            from ..transports.http import HttpTransport
+
+            http = HttpTransport(self)
+            self._transports.append(http)
+            await http.start()
+
+        if self.config.zmq_enabled:
+            from ..transports.zeromq import ZmqTransport
+
+            zmq_t = ZmqTransport(self)
+            self._transports.append(zmq_t)
+            await zmq_t.start()
+
+        if self.config.zmq_enabled:
+            self._tasks.append(
+                asyncio.create_task(self._staleness_sweeper(), name="stale-sweep")
+            )
+
+        self._started.set()
+        logger.info("worldql-server-tpu started")
+
+    async def _staleness_sweeper(self) -> None:
+        """Evict heartbeat-tracked peers that went silent
+        (outgoing.rs:132-150)."""
+        timeout = self.config.zmq_timeout_secs
+        while True:
+            await asyncio.sleep(timeout)
+            for uuid in self.peer_map.stale_peers(timeout):
+                logger.info("removing stale peer: %s", uuid)
+                await self.peer_map.remove(uuid)
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        for transport in reversed(self._transports):
+            await transport.stop()
+        self._transports.clear()
+        await self.store.close()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()

@@ -1,0 +1,23 @@
+from .codec import DeserializeError, deserialize_message, serialize_message
+from .types import (
+    NIL_UUID,
+    Entity,
+    Instruction,
+    Message,
+    Record,
+    Replication,
+    Vector3,
+)
+
+__all__ = [
+    "NIL_UUID",
+    "Entity",
+    "Instruction",
+    "Message",
+    "Record",
+    "Replication",
+    "Vector3",
+    "DeserializeError",
+    "deserialize_message",
+    "serialize_message",
+]

@@ -1,0 +1,136 @@
+"""The swappable spatial-subscription engine interface.
+
+This is the seam the whole rebuild pivots on (BASELINE.json north
+star): the reference hard-wires a ``WorldMap → AreaMap → CubeArea``
+HashMap pipeline into its handlers (subscriptions/world_map.rs,
+area_map.rs); here every subscription mutation and proximity query goes
+through ``SpatialBackend``, so the dict-based CPU engine and the
+batched JAX/TPU engine are interchangeable and property-tested against
+each other.
+
+Peers are identified by ``uuid.UUID`` at this boundary; backends may
+intern them to dense ints internally. Positions are accepted either as
+raw ``Vector3`` (quantized by the backend at the configured cube size)
+or as already-quantized ``(cx, cy, cz)`` int tuples — mirroring the
+reference's ``ToCubeArea`` trait (cube_area.rs:61-78).
+"""
+
+from __future__ import annotations
+
+import abc
+import uuid as uuid_mod
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+from ..protocol.types import Replication, Vector3
+from .quantize import cube_coords
+
+Cube = tuple[int, int, int]
+PosOrCube = "Vector3 | Cube"
+
+
+def to_cube(pos: Vector3 | Cube, cube_size: int) -> Cube:
+    """ToCubeArea: a Vector3 quantizes; a cube passes through
+    (cube_area.rs:61-78)."""
+    if isinstance(pos, Vector3):
+        return cube_coords(pos.x, pos.y, pos.z, cube_size)
+    return pos
+
+
+@dataclass(slots=True)
+class LocalQuery:
+    """One LocalMessage proximity query in a tick batch."""
+
+    world: str  # sanitized world name
+    position: Vector3
+    sender: uuid_mod.UUID
+    replication: Replication = Replication.EXCEPT_SELF
+
+
+class SpatialBackend(abc.ABC):
+    """Subscription index + proximity query engine for all worlds."""
+
+    def __init__(self, cube_size: int):
+        self.cube_size = cube_size
+
+    # region: mutations
+
+    @abc.abstractmethod
+    def add_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        """Subscribe peer to the cube containing ``pos`` in ``world``.
+        Creates the world lazily. Returns True if newly added
+        (area_map.rs:72-85)."""
+
+    @abc.abstractmethod
+    def remove_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        """Unsubscribe peer from one cube. Returns True if a
+        subscription was removed (area_map.rs:88-119)."""
+
+    @abc.abstractmethod
+    def remove_peer(self, peer: uuid_mod.UUID) -> bool:
+        """Remove a disconnected peer from every world/cube
+        (world_map.rs:41-61)."""
+
+    # endregion
+
+    # region: queries
+
+    @abc.abstractmethod
+    def query_cube(self, world: str, pos: Vector3 | Cube) -> set[uuid_mod.UUID]:
+        """Peers subscribed to the cube containing ``pos``; empty set if
+        the world has never been subscribed to (area_map.rs:52-60)."""
+
+    @abc.abstractmethod
+    def query_world(self, world: str) -> set[uuid_mod.UUID]:
+        """Peers subscribed to at least one cube of ``world``
+        (area_map.rs:65-67)."""
+
+    def is_subscribed(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        return peer in self.query_cube(world, pos)
+
+    def is_subscribed_any(self, world: str, peer: uuid_mod.UUID) -> bool:
+        return peer in self.query_world(world)
+
+    # endregion
+
+    # region: batched hot path
+
+    def match_local_batch(
+        self, queries: Sequence[LocalQuery]
+    ) -> list[list[uuid_mod.UUID]]:
+        """Resolve a tick's worth of LocalMessage queries to fan-out
+        lists, applying each query's replication filter
+        (local_message.rs:60-86).
+
+        Base implementation loops ``query_cube``; accelerated backends
+        override with one fused device batch.
+        """
+        out: list[list[uuid_mod.UUID]] = []
+        for q in queries:
+            peers = self.query_cube(q.world, q.position)
+            out.append(_apply_replication(peers, q.sender, q.replication))
+        return out
+
+    def flush(self) -> None:
+        """Make all prior mutations visible to queries. No-op for
+        immediate-mode backends; device-mirror backends sync here."""
+
+    # endregion
+
+
+def _apply_replication(
+    peers: Iterable[uuid_mod.UUID],
+    sender: uuid_mod.UUID,
+    replication: Replication,
+) -> list[uuid_mod.UUID]:
+    if replication == Replication.EXCEPT_SELF:
+        return [p for p in peers if p != sender]
+    if replication == Replication.ONLY_SELF:
+        return [p for p in peers if p == sender]
+    return list(peers)

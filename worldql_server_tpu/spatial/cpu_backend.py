@@ -1,0 +1,126 @@
+"""Dict-based reference implementation of :class:`SpatialBackend`.
+
+Observable semantics match the reference's WorldMap/AreaMap exactly
+(subscriptions/world_map.rs, area_map.rs) — lazily-created worlds,
+cube-keyed peer sets, and a world-level "subscribed to any cube" view.
+One deliberate improvement: world-level membership is tracked with
+per-peer cube refcounts, so ``remove_subscription`` and ``remove_peer``
+are O(1)/O(own cubes) instead of the reference's O(all cubes) scans
+(area_map.rs:113, area_map.rs:124-135) — same observable behavior.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from collections import defaultdict
+
+from ..protocol.types import Vector3
+from .backend import Cube, SpatialBackend, to_cube
+
+
+class _World:
+    __slots__ = ("cubes", "peer_cube_count", "peer_cubes")
+
+    def __init__(self) -> None:
+        self.cubes: dict[Cube, set[uuid_mod.UUID]] = {}
+        # peer -> number of cubes it is subscribed to (world-level view)
+        self.peer_cube_count: dict[uuid_mod.UUID, int] = defaultdict(int)
+        # peer -> set of cubes, for O(own cubes) disconnect cleanup
+        self.peer_cubes: dict[uuid_mod.UUID, set[Cube]] = defaultdict(set)
+
+
+class CpuSpatialBackend(SpatialBackend):
+    def __init__(self, cube_size: int):
+        super().__init__(cube_size)
+        self._worlds: dict[str, _World] = {}
+
+    # region: mutations
+
+    def add_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        cube = to_cube(pos, self.cube_size)
+        w = self._worlds.get(world)
+        if w is None:
+            w = self._worlds[world] = _World()
+
+        peers = w.cubes.setdefault(cube, set())
+        if peer in peers:
+            return False
+        peers.add(peer)
+        w.peer_cube_count[peer] += 1
+        w.peer_cubes[peer].add(cube)
+        return True
+
+    def remove_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        cube = to_cube(pos, self.cube_size)
+        w = self._worlds.get(world)
+        if w is None or cube not in w.cubes:
+            return False
+
+        peers = w.cubes[cube]
+        if peer not in peers:
+            return False
+        peers.remove(peer)
+        if not peers:
+            del w.cubes[cube]  # empty-set GC (area_map.rs:108-110)
+
+        w.peer_cubes[peer].discard(cube)
+        w.peer_cube_count[peer] -= 1
+        if w.peer_cube_count[peer] <= 0:
+            del w.peer_cube_count[peer]
+            del w.peer_cubes[peer]
+        return True
+
+    def remove_peer(self, peer: uuid_mod.UUID) -> bool:
+        removed = False
+        for w in self._worlds.values():
+            cubes = w.peer_cubes.pop(peer, None)
+            if not cubes:
+                w.peer_cube_count.pop(peer, None)
+                continue
+            removed = True
+            w.peer_cube_count.pop(peer, None)
+            for cube in cubes:
+                peers = w.cubes.get(cube)
+                if peers is not None:
+                    peers.discard(peer)
+                    if not peers:
+                        del w.cubes[cube]
+        return removed
+
+    # endregion
+
+    # region: queries
+
+    def query_cube(self, world: str, pos: Vector3 | Cube) -> set[uuid_mod.UUID]:
+        w = self._worlds.get(world)
+        if w is None:
+            return set()
+        return set(w.cubes.get(to_cube(pos, self.cube_size), ()))
+
+    def query_world(self, world: str) -> set[uuid_mod.UUID]:
+        w = self._worlds.get(world)
+        if w is None:
+            return set()
+        return set(w.peer_cube_count.keys())
+
+    # endregion
+
+    # region: introspection (tests, metrics)
+
+    def world_names(self) -> list[str]:
+        return list(self._worlds.keys())
+
+    def cube_count(self, world: str) -> int:
+        w = self._worlds.get(world)
+        return 0 if w is None else len(w.cubes)
+
+    def subscription_count(self) -> int:
+        return sum(
+            len(peers) for w in self._worlds.values() for peers in w.cubes.values()
+        )
+
+    # endregion

@@ -28,24 +28,54 @@ import numpy as np
 
 from ..utils.rounding import round_by_multiple
 
+_I64_MAX = 2**63 - 1
+_I64_MIN = -(2**63)
+_I64_MAX_F = float(_I64_MAX)  # 9.223372036854776e18
+_I64_MIN_F = float(_I64_MIN)
+
+
+def _as_i64(f: float) -> int:
+    """Rust `f64 as i64` saturating cast: NaN → 0, out-of-range clamps."""
+    if math.isnan(f):
+        return 0
+    if f >= _I64_MAX_F:
+        return _I64_MAX
+    if f <= _I64_MIN_F:
+        return _I64_MIN
+    return int(f)
+
+
+def _sat_add(a: int, b: int) -> int:
+    return max(_I64_MIN, min(_I64_MAX, a + b))
+
+
 # region: scalar reference implementations
 
 
 def coord_clamp(coord: float, size: int) -> int:
-    """Quantize one subscription-cube coordinate (cube_area.rs:23-44)."""
-    abs_coord = abs(coord)
-    multiplier = -1 if coord < 0.0 else 1
+    """Quantize one subscription-cube coordinate (cube_area.rs:23-44).
 
-    # Exact non-zero multiples label their own cube (Rust `coord as i64`
-    # truncates toward zero).
-    if math.fmod(abs_coord, float(size)) == 0.0 and coord != 0.0:
-        return int(coord)
+    Total function: casts saturate like Rust's ``as i64`` (NaN → cube
+    ``+size`` by the same arithmetic the reference executes; ±inf
+    saturates to ±i64::MAX instead of the reference's release-mode
+    integer wrap, which is the only divergence and only at ±inf).
+    """
+    if math.isinf(coord):
+        return _I64_MAX if coord > 0 else -_I64_MAX
+
+    abs_coord = abs(coord)
+    multiplier = -1 if coord < 0.0 else 1  # NaN compares false → +1
+
+    # Exact non-zero multiples label their own cube.
+    if not math.isnan(coord):
+        if math.fmod(abs_coord, float(size)) == 0.0 and coord != 0.0:
+            return _as_i64(coord)
 
     rounded = round_by_multiple(abs_coord, float(size))
-    if rounded > coord:
-        result = int(rounded)
+    if rounded > coord:  # NaN > NaN is false → falls to +size, like Rust
+        result = _as_i64(rounded)
     else:
-        result = int(rounded) + size
+        result = _sat_add(_as_i64(rounded), size)
 
     return result * multiplier
 
@@ -56,12 +86,20 @@ def cube_coords(x: float, y: float, z: float, size: int) -> tuple[int, int, int]
 
 
 def clamp_region_coord(c: float, region_size: int) -> int:
-    """Quantize one DB-region coordinate (world_region.rs:93-110)."""
+    """Quantize one DB-region coordinate (world_region.rs:93-110).
+
+    NaN raises ValueError: the reference recurses forever on NaN here
+    (world_region.rs:104-109 — a stack overflow a hostile record could
+    trigger); we refuse instead and let per-message isolation drop it.
+    ±inf saturates like Rust's ``as i64``.
+    """
+    if math.isnan(c):
+        raise ValueError("NaN region coordinate")
     if c == 0.0:
         return 0
 
     if c >= 0.0:
-        ci = int(c)  # truncate toward zero
+        ci = _as_i64(c)  # truncate toward zero, saturating
         return ci - ci % region_size  # ci >= 0: python % == trunc %
     # Negative: reflect, quantize, negate. Exact negative multiples land
     # one region further down — reference-exact behavior.
@@ -104,24 +142,42 @@ def table_bounds(region_coord: int, table_size: int) -> tuple[int, int]:
 # region: vectorized batch implementations
 
 
+def _sat_i64_batch(x: np.ndarray) -> np.ndarray:
+    """Vectorized Rust-style saturating f64 → i64 cast."""
+    safe = np.where(np.isfinite(x) & (np.abs(x) < _I64_MAX_F), x, 0.0)
+    out = safe.astype(np.int64)
+    out = np.where(x >= _I64_MAX_F, np.int64(_I64_MAX), out)
+    out = np.where(x <= _I64_MIN_F, np.int64(_I64_MIN), out)
+    return np.where(np.isnan(x), np.int64(0), out)
+
+
 def coord_clamp_batch(coords: np.ndarray, size: int) -> np.ndarray:
-    """Vectorized ``coord_clamp`` over a float64 array → int64 array."""
+    """Vectorized ``coord_clamp`` over a float64 array → int64 array.
+    Agrees with the scalar form on every input, including NaN/±inf and
+    |coord| beyond i64 range (saturating-cast semantics)."""
     c = np.asarray(coords, dtype=np.float64)
     size_f = float(size)
 
     abs_c = np.abs(c)
     multiplier = np.where(c < 0.0, -1, 1).astype(np.int64)
 
-    exact = (np.fmod(abs_c, size_f) == 0.0) & (c != 0.0)
+    with np.errstate(invalid="ignore"):
+        exact = (np.fmod(abs_c, size_f) == 0.0) & (c != 0.0)
 
-    # round_by_multiple(abs_c, size) with the 0→size special case.
-    rounded = np.ceil(abs_c / size_f) * size_f
-    rounded = np.where(abs_c == 0.0, size_f, rounded)
+        # round_by_multiple(abs_c, size) with the 0→size special case.
+        rounded = np.ceil(abs_c / size_f) * size_f
+        rounded = np.where(abs_c == 0.0, size_f, rounded)
 
-    result = np.where(rounded > c, rounded.astype(np.int64), rounded.astype(np.int64) + size)
-    result = result * multiplier
+        rounded_i = _sat_i64_batch(rounded)
+        bumped = np.minimum(rounded_i, _I64_MAX - size) + size  # saturating +size
+        result = np.where(rounded > c, rounded_i, bumped) * multiplier
+        result = np.where(exact, _sat_i64_batch(c), result)
 
-    return np.where(exact, c.astype(np.int64), result)
+        # Specials, matching the scalar form exactly.
+        result = np.where(np.isposinf(c), np.int64(_I64_MAX), result)
+        result = np.where(np.isneginf(c), np.int64(-_I64_MAX), result)
+
+    return result
 
 
 def cube_coords_batch(positions: np.ndarray, size: int) -> np.ndarray:
@@ -131,11 +187,14 @@ def cube_coords_batch(positions: np.ndarray, size: int) -> np.ndarray:
 
 
 def clamp_region_coord_batch(coords: np.ndarray, region_size: int) -> np.ndarray:
-    """Vectorized ``clamp_region_coord`` → int64 array."""
+    """Vectorized ``clamp_region_coord`` → int64 array. NaN raises
+    ValueError (see the scalar form); ±inf saturates."""
     c = np.asarray(coords, dtype=np.float64)
+    if np.isnan(c).any():
+        raise ValueError("NaN region coordinate")
 
     def _positive(v: np.ndarray) -> np.ndarray:
-        vi = v.astype(np.int64)  # truncation toward zero for v >= 0
+        vi = _sat_i64_batch(v)  # truncation toward zero for v >= 0
         return vi - vi % np.int64(region_size)
 
     pos_result = _positive(np.maximum(c, 0.0))

@@ -1,0 +1,10 @@
+from .store import DedupeOp, RecordStore, StoredRecord, open_store
+from .memory_store import MemoryRecordStore
+
+__all__ = [
+    "DedupeOp",
+    "RecordStore",
+    "StoredRecord",
+    "MemoryRecordStore",
+    "open_store",
+]

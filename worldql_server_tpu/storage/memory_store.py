@@ -1,0 +1,92 @@
+"""In-memory RecordStore: the semantic reference for tests.
+
+Implements the exact append/region-read/dedupe contract of store.py
+with plain dicts keyed by (world, region cell). Timestamps default to
+``datetime.now(UTC)`` at insert, like the DB's ``NOW()`` column default
+(database/query_constants.rs:92).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from collections import defaultdict
+from datetime import datetime, timezone
+
+from ..protocol.types import Record, Vector3
+from ..spatial.quantize import region_coords
+from ..utils.names import sanitize_world_name
+from .store import DedupeOp, RecordStore, StoredRecord
+
+logger = logging.getLogger(__name__)
+
+
+class MemoryRecordStore(RecordStore):
+    def __init__(self, config):
+        self._rx = config.db_region_x_size
+        self._ry = config.db_region_y_size
+        self._rz = config.db_region_z_size
+        # (world, (rx, ry, rz)) -> list of (seq, StoredRecord)
+        self._regions: dict[tuple, list[tuple[int, StoredRecord]]] = defaultdict(list)
+        self._seq = itertools.count()
+
+    def _region_key(self, world_name: str, position: Vector3) -> tuple:
+        world = sanitize_world_name(world_name)
+        region = region_coords(
+            position.x, position.y, position.z, self._rx, self._ry, self._rz
+        )
+        return (world, region)
+
+    async def insert_records(self, records: list[Record]) -> int:
+        written = 0
+        now = datetime.now(timezone.utc)
+        for record in records:
+            if record.position is None:
+                logger.warning("record %s has no position, skipping", record.uuid)
+                continue
+            key = self._region_key(record.world_name, record.position)
+            self._regions[key].append(
+                (next(self._seq), StoredRecord(now, record))
+            )
+            written += 1
+        return written
+
+    async def get_records_in_region(
+        self, world_name: str, position: Vector3, after: datetime | None = None
+    ) -> list[StoredRecord]:
+        key = self._region_key(world_name, position)
+        rows = self._regions.get(key, [])
+        out = [sr for _, sr in rows]
+        if after is not None:
+            out = [sr for sr in out if sr.timestamp > after]
+        return list(out)
+
+    async def delete_records(self, records: list[Record]) -> int:
+        deleted = 0
+        for record in records:
+            if record.position is None:
+                continue
+            key = self._region_key(record.world_name, record.position)
+            rows = self._regions.get(key)
+            if not rows:
+                continue
+            keep = [(s, sr) for s, sr in rows if sr.record.uuid != record.uuid]
+            deleted += len(rows) - len(keep)
+            self._regions[key] = keep
+        return deleted
+
+    async def dedupe_records(self, ops: list[DedupeOp]) -> int:
+        deleted = 0
+        for rec_uuid, keep_ts, world_name, position in ops:
+            key = self._region_key(world_name, position)
+            rows = self._regions.get(key)
+            if not rows:
+                continue
+            keep = [
+                (s, sr)
+                for s, sr in rows
+                if sr.record.uuid != rec_uuid or sr.timestamp >= keep_ts
+            ]
+            deleted += len(rows) - len(keep)
+            self._regions[key] = keep
+        return deleted

@@ -1,0 +1,93 @@
+"""Record persistence interface.
+
+Capability contract from the reference's DatabaseClient
+(worldql_server/src/database/client.rs):
+
+* **Insert is append** — duplicates are tolerated at write time and
+  collapsed on read (client.rs:86-228).
+* **Region-scoped reads** fetch every row in the DB region containing a
+  position, optionally filtered to rows newer than an "after"
+  timestamp; reads of never-written regions return empty
+  (client.rs:312-362).
+* **Read-repair dedupe** — after a read, older duplicate rows per
+  record-uuid are deleted (client.rs:402-412, record_read.rs:126-130).
+* **Delete** removes all rows for (uuid, world, region)
+  (client.rs:365-399).
+
+Region/table sharding follows WorldRegion semantics
+(database/world_region.rs): positions quantize to floor-style region
+cells of (x, y, z) sizes, grouped into tables of ``table_size`` extent
+per axis. Storage backends: SQLite (default, self-contained), memory
+(tests), Postgres (when a driver is available).
+"""
+
+from __future__ import annotations
+
+import abc
+import uuid as uuid_mod
+from dataclasses import dataclass
+from datetime import datetime
+
+from ..protocol.types import Record, Vector3
+
+
+@dataclass(slots=True)
+class StoredRecord:
+    """A record row plus its last-modified timestamp."""
+
+    timestamp: datetime
+    record: Record
+
+
+# (record_uuid, keep_timestamp, world_name, position) — delete older rows
+# (database/client.rs:31, record_read.rs:84-97)
+DedupeOp = tuple[uuid_mod.UUID, datetime, str, Vector3]
+
+
+class RecordStore(abc.ABC):
+    @abc.abstractmethod
+    async def insert_records(self, records: list[Record]) -> int:
+        """Append records (no upsert); returns rows written. Records
+        without positions are skipped with a warning, like the
+        reference (client.rs:102-117)."""
+
+    @abc.abstractmethod
+    async def get_records_in_region(
+        self, world_name: str, position: Vector3, after: datetime | None = None
+    ) -> list[StoredRecord]:
+        """All rows in the region containing ``position``; optionally
+        only rows with timestamp > ``after``."""
+
+    @abc.abstractmethod
+    async def delete_records(self, records: list[Record]) -> int:
+        """Delete all rows matching each record's (uuid, world, region);
+        returns rows deleted."""
+
+    @abc.abstractmethod
+    async def dedupe_records(self, ops: list[DedupeOp]) -> int:
+        """Read-repair: delete rows older than the kept timestamp for
+        each record uuid; returns rows deleted."""
+
+    async def init(self) -> None:
+        """Idempotent schema/bootstrap (database/init.rs:10-26)."""
+
+    async def close(self) -> None:
+        pass
+
+
+def open_store(url: str, config) -> RecordStore:
+    """Create a store from a URL: ``memory://``, ``sqlite://PATH`` or
+    ``postgres://...`` (gated on an available driver)."""
+    from .memory_store import MemoryRecordStore
+
+    if url.startswith("memory://"):
+        return MemoryRecordStore(config)
+    if url.startswith("sqlite://"):
+        from .sqlite_store import SqliteRecordStore
+
+        return SqliteRecordStore(url[len("sqlite://"):], config)
+    if url.startswith(("postgres://", "postgresql://")):
+        from .postgres_store import PostgresRecordStore  # raises if no driver
+
+        return PostgresRecordStore(url, config)
+    raise ValueError(f"unsupported store url: {url}")

@@ -1,0 +1,73 @@
+"""HTTP REST ingest transport.
+
+Rebuild of the reference's axum server
+(worldql_server/src/transport/http/http_rest.rs): a single route
+``POST /global_message`` taking JSON ``{parameter?, world_name}``,
+injected as a GlobalMessage with nil sender and ExceptSelf replication
+(http_rest.rs:40-60). Optional static bearer-token auth
+(http_rest.rs:85-98); success replies 204 No Content (http_rest.rs:104).
+HTTP callers are never peers — this is a fire-and-forget
+server→clients bridge (e.g. webhooks).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from ..protocol import Instruction, Message, Replication
+from ..protocol.types import NIL_UUID
+
+logger = logging.getLogger(__name__)
+
+
+class HttpTransport:
+    def __init__(self, server):
+        self.server = server
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        config = self.server.config
+        app = web.Application()
+        app.router.add_post("/global_message", self._post_global_message)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, config.http_host, config.http_port)
+        await site.start()
+        logger.info(
+            "HTTP server listening on %s:%s", config.http_host, config.http_port
+        )
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _post_global_message(self, request: web.Request) -> web.Response:
+        token = self.server.config.http_auth_token
+        if token is not None:
+            auth = request.headers.get("Authorization", "")
+            if not auth.startswith("Bearer ") or auth[len("Bearer "):] != token:
+                return web.Response(status=401)
+
+        try:
+            body = await request.json()
+            world_name = body["world_name"]
+            parameter = body.get("parameter")
+            if not isinstance(world_name, str) or not (
+                parameter is None or isinstance(parameter, str)
+            ):
+                raise ValueError("wrong field types")
+        except Exception:
+            return web.Response(status=400)
+
+        message = Message(
+            instruction=Instruction.GLOBAL_MESSAGE,
+            parameter=parameter,
+            sender_uuid=NIL_UUID,
+            world_name=world_name,
+            replication=Replication.EXCEPT_SELF,
+        )
+        await self.server.router.handle_message(message)
+        return web.Response(status=204)

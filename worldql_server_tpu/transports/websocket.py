@@ -1,0 +1,144 @@
+"""WebSocket transport.
+
+Rebuild of the reference's WS server
+(worldql_server/src/transport/http/websocket.rs): the *server* assigns
+the peer UUID (contrast ZeroMQ, where the client picks), sends a
+client-bound Handshake carrying that UUID as ``parameter``, and
+requires the client's first frame to be a Handshake echo with the
+assigned UUID as sender. After that, every binary frame must
+deserialize and carry the assigned sender UUID; a second Handshake or
+a wrong sender UUID disconnects the peer (websocket.rs:66-111,163-170).
+Text frames are ignored; liveness is the stream itself (no heartbeat
+staleness).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid as uuid_mod
+
+from websockets.asyncio.server import serve
+from websockets.exceptions import ConnectionClosed
+
+from ..protocol import (
+    DeserializeError,
+    Instruction,
+    Message,
+    deserialize_message,
+    serialize_message,
+)
+from ..engine.peers import Peer
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+
+class WebSocketTransport:
+    def __init__(self, server):
+        self.server = server
+        self._ws_server = None
+
+    async def start(self) -> None:
+        config = self.server.config
+        self._ws_server = await serve(
+            self._handle_connection,
+            config.ws_host,
+            config.ws_port,
+            max_size=MAX_FRAME_BYTES,
+        )
+        logger.info(
+            "WebSocket server listening on %s:%s", config.ws_host, config.ws_port
+        )
+
+    async def stop(self) -> None:
+        if self._ws_server is not None:
+            self._ws_server.close()
+            await self._ws_server.wait_closed()
+            self._ws_server = None
+
+    async def _handle_connection(self, connection) -> None:
+        addr = "%s:%s" % (connection.remote_address or ("?", "?"))[:2]
+        peer_uuid = uuid_mod.uuid4()
+        registered = False
+        try:
+            # Server-assigned UUID handshake (websocket.rs:51-63).
+            await connection.send(
+                serialize_message(
+                    Message(
+                        instruction=Instruction.HANDSHAKE,
+                        parameter=str(peer_uuid),
+                    )
+                )
+            )
+
+            # The handshake phase reads exactly one frame: anything but a
+            # valid Handshake drops the connection (websocket.rs:66-87).
+            first = await self._next_message(
+                connection, peer_uuid, addr, ignore_retries=False
+            )
+            if first is None or first.instruction != Instruction.HANDSHAKE:
+                logger.debug("peer %s did not complete handshake", addr)
+                return
+
+            peer = Peer(
+                uuid=peer_uuid,
+                addr=addr,
+                send_raw=connection.send,
+                kind="websocket",
+                tracks_heartbeat=False,
+            )
+            await self.server.peer_map.insert(peer)
+            registered = True
+
+            while True:
+                message = await self._next_message(connection, peer_uuid, addr)
+                if message is None:
+                    return
+                if message.instruction == Instruction.HANDSHAKE:
+                    # Duplicate handshake ⇒ disconnect (websocket.rs:108-111).
+                    return
+                await self.server.router.handle_message(message)
+        except ConnectionClosed:
+            pass
+        except Exception:
+            logger.exception("websocket connection error: %s", addr)
+        finally:
+            if registered:
+                await self.server.peer_map.remove(peer_uuid)
+
+    async def _next_message(
+        self,
+        connection,
+        peer_uuid: uuid_mod.UUID,
+        addr: str,
+        ignore_retries: bool = True,
+    ) -> Message | None:
+        """Read frames until a valid binary Message arrives; None on
+        close or sender-UUID violation (websocket.rs:137-173). With
+        ``ignore_retries=False`` an ignorable frame returns None too."""
+        while True:
+            try:
+                frame = await connection.recv()
+            except ConnectionClosed:
+                return None
+            if isinstance(frame, str):
+                if ignore_retries:
+                    continue  # non-binary → ignore
+                return None
+            try:
+                message = deserialize_message(frame)
+            except DeserializeError:
+                logger.debug("deserialize error from peer: %s", addr)
+                if ignore_retries:
+                    continue
+                return None
+            if message.sender_uuid != peer_uuid:
+                logger.debug(
+                    "peer uuid incorrect: expected %s, got %s",
+                    peer_uuid,
+                    message.sender_uuid,
+                )
+                return None  # wrong sender ⇒ close
+            return message

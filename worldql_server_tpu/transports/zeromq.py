@@ -1,0 +1,175 @@
+"""ZeroMQ transport.
+
+Rebuild of the reference's asymmetric socket pattern
+(worldql_server/src/transport/zeromq/): the server binds one PULL
+socket for all inbound traffic (incoming.rs:19-24); each client runs
+its own PULL and the server connects a dedicated PUSH socket *back* to
+an address the client supplies as the Handshake ``parameter``
+(outgoing.rs:95-118).
+
+Handshake flow: a message from an unknown sender UUID is dropped unless
+it is a Handshake carrying an address parameter; the server then
+connects a PUSH socket to ``tcp://<parameter>``, echoes a bare
+Handshake (nil sender, no parameter — outgoing.rs:108-118), and
+registers the peer. Known senders' Handshakes are swallowed
+(incoming.rs:56-61); UUID clashes drop the handshake
+(outgoing.rs:88-94). ZMQ peers are heartbeat-tracked: the engine's
+staleness sweeper evicts them (outgoing.rs:28-47,132-150), and a failed
+send evicts immediately (outgoing.rs:66-76).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid as uuid_mod
+
+import zmq
+import zmq.asyncio
+
+from ..engine.peers import Peer
+from ..protocol import (
+    DeserializeError,
+    Instruction,
+    Message,
+    deserialize_message,
+    serialize_message,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _valid_socket_addr(parameter: str) -> bool:
+    """The reference parses the parameter as a SocketAddr
+    (outgoing.rs:97-103): ``ip:port`` (IPv4 or bracketed IPv6)."""
+    import ipaddress
+
+    host, sep, port = parameter.rpartition(":")
+    if not sep or not host:
+        return False
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    try:
+        ipaddress.ip_address(host)
+    except ValueError:
+        return False
+    return port.isdigit() and 0 < int(port) < 65536
+
+
+class ZmqTransport:
+    def __init__(self, server):
+        self.server = server
+        self.ctx = zmq.asyncio.Context()
+        self._pull: zmq.asyncio.Socket | None = None
+        self._push_sockets: dict[uuid_mod.UUID, zmq.asyncio.Socket] = {}
+        self._recv_task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        config = self.server.config
+        self._pull = self.ctx.socket(zmq.PULL)
+        self._pull.bind(f"tcp://{config.zmq_server_host}:{config.zmq_server_port}")
+        logger.info(
+            "ZeroMQ PULL server listening on %s:%s",
+            config.zmq_server_host,
+            config.zmq_server_port,
+        )
+        self._recv_task = asyncio.create_task(self._recv_loop(), name="zmq-pull")
+
+    async def stop(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._recv_task = None
+        for sock in self._push_sockets.values():
+            sock.close(linger=0)
+        self._push_sockets.clear()
+        if self._pull is not None:
+            self._pull.close(linger=0)
+            self._pull = None
+        self.ctx.term()
+
+    async def _recv_loop(self) -> None:
+        """PULL loop (incoming.rs:26-75): multipart frames are
+        concatenated, deserialized-or-dropped, then routed."""
+        assert self._pull is not None
+        while True:
+            parts = await self._pull.recv_multipart()
+            data = b"".join(parts)
+            try:
+                message = deserialize_message(data)
+            except DeserializeError:
+                logger.debug("dropping invalid zmq message: deserialize error")
+                continue
+
+            if message.sender_uuid in self.server.peer_map:
+                if message.instruction != Instruction.HANDSHAKE:
+                    await self.server.router.handle_message(message)
+                continue
+
+            if (
+                message.instruction != Instruction.HANDSHAKE
+                or message.parameter is None
+            ):
+                continue  # unknown sender, not a handshake → ignore
+
+            await self._handle_handshake(message)
+
+    async def _handle_handshake(self, message: Message) -> None:
+        """Connect-back PUSH + handshake echo + registration
+        (outgoing.rs:81-130)."""
+        if message.sender_uuid in self.server.peer_map:
+            return  # clashing UUID → drop
+
+        parameter = message.parameter
+        if parameter is None or not _valid_socket_addr(parameter):
+            return  # invalid socket address → drop
+
+        endpoint = f"tcp://{parameter}"
+        logger.debug("zeromq peer address: %s", endpoint)
+
+        push = self.ctx.socket(zmq.PUSH)
+        push.setsockopt(zmq.LINGER, 0)
+        push.connect(endpoint)
+
+        # Bare handshake echo: nil sender, no parameter (outgoing.rs:108-118).
+        await push.send(
+            serialize_message(Message(instruction=Instruction.HANDSHAKE))
+        )
+
+        peer_uuid = message.sender_uuid
+        self._push_sockets[peer_uuid] = push
+
+        async def send_raw(data: bytes) -> None:
+            sock = self._push_sockets.get(peer_uuid)
+            if sock is None:
+                raise ConnectionError("push socket gone")
+            try:
+                await sock.send(data)
+            except Exception:
+                # Failed send ⇒ evict peer (outgoing.rs:66-76).
+                self._drop_socket(peer_uuid)
+                asyncio.get_running_loop().create_task(
+                    self.server.peer_map.remove(peer_uuid)
+                )
+                raise
+
+        peer = Peer(
+            uuid=peer_uuid,
+            addr=parameter,
+            send_raw=send_raw,
+            kind="zeromq",
+            tracks_heartbeat=True,
+        )
+        await self.server.peer_map.insert(peer)
+
+    def _drop_socket(self, peer_uuid: uuid_mod.UUID) -> None:
+        sock = self._push_sockets.pop(peer_uuid, None)
+        if sock is not None:
+            sock.close(linger=0)
+
+    def on_peer_removed(self, peer_uuid: uuid_mod.UUID) -> None:
+        """PeerMap removal hook: close the connect-back PUSH socket."""
+        self._drop_socket(peer_uuid)

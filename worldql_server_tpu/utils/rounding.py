@@ -17,4 +17,7 @@ def round_by_multiple(n: float, multiple: float) -> float:
     if n == 0.0:
         return multiple
 
-    return math.ceil(n / multiple) * multiple
+    q = n / multiple
+    if not math.isfinite(q):
+        return q * multiple  # NaN/±inf propagate, like Rust f64::ceil
+    return math.ceil(q) * multiple

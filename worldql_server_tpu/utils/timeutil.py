@@ -17,7 +17,9 @@ _U64_MAX = 2**64 - 1
 
 
 def parse_epoch_millis(value: str) -> datetime:
-    if not value.isdigit():  # rejects '', signs, whitespace, '_'
+    # ASCII digits only, like Rust's parse::<u64>() — rejects '', signs,
+    # whitespace, '_' and non-ASCII Unicode digits.
+    if not (value.isascii() and value.isdigit()):
         raise ValueError(f"invalid epoch millis: {value!r}")
 
     millis = int(value)
