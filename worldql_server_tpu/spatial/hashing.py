@@ -1,0 +1,57 @@
+"""64-bit spatial keys: (world_id, cube) → one sortable int64.
+
+The device index orders subscriptions by a single scalar key so range
+lookups are two ``searchsorted`` binary searches. A cube identity is
+128+ bits (world i32 + three i64 cube coords), so the key is a seeded
+splitmix64-style hash. Exactness is preserved anyway:
+
+* at flush time the host checks that distinct cubes got distinct keys
+  and rehashes with the next seed on collision (expected ~never:
+  ~C²/2⁶⁴), so stored cells are injective per epoch;
+* every query verifies the exact (world, cx, cy, cz) against the
+  candidate row, so a query for an absent cube that collides with a
+  stored one is rejected, not mis-routed.
+
+All functions are vectorized numpy over uint64 with wrapping overflow —
+the hot encode path runs at memory bandwidth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+# Padding rows sort after every real key; flush re-seeds if a real key
+# ever hashes to this value.
+PAD_KEY = np.int64(2**63 - 1)
+# World-id sentinel that never matches a real (>= 0) interned world.
+NO_WORLD = np.int32(-1)
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    x = (x ^ (x >> np.uint64(30))) * _M1
+    x = (x ^ (x >> np.uint64(27))) * _M2
+    return x ^ (x >> np.uint64(31))
+
+
+def spatial_keys(
+    world_ids: np.ndarray, cubes: np.ndarray, seed: int = 0
+) -> np.ndarray:
+    """[N] int32 world ids + [N, 3] int64 cube coords → [N] int64 keys."""
+    with np.errstate(over="ignore"):
+        h = _mix(np.uint64(seed) + _GOLDEN)
+        h = _mix(h ^ world_ids.astype(np.int64).view(np.uint64))
+        h = _mix(h ^ cubes[..., 0].view(np.uint64))
+        h = _mix(h ^ cubes[..., 1].view(np.uint64))
+        h = _mix(h ^ cubes[..., 2].view(np.uint64))
+    return h.view(np.int64)
+
+
+def next_pow2(n: int, floor: int = 8) -> int:
+    """Capacity tier: smallest power of two >= max(n, floor). Bounds
+    the number of distinct compiled shapes to log2(capacity)."""
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()

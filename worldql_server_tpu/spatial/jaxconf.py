@@ -1,0 +1,17 @@
+"""JAX configuration shared by every accelerated module.
+
+Cube labels are int64 (the reference's CubeArea is i64×3,
+subscriptions/cube_area.rs:8-13) and the sort keys derived from them are
+64-bit hashes, so the device path needs x64 enabled. TPU executes i64
+compares/gathers as emulated pairs of i32 ops — cheap for this workload,
+which is bandwidth-bound gathers, not arithmetic. No f64 ever reaches
+the device: quantization runs host-side in numpy f64 (spatial/quantize).
+
+Import this module before any ``import jax`` in accelerated code.
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

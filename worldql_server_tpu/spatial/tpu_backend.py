@@ -1,0 +1,321 @@
+"""TPU-accelerated :class:`SpatialBackend`: batched fan-out on device.
+
+The reference resolves each LocalMessage with a per-message HashMap
+probe + O(all-connected-peers) scan under a global write lock
+(local_message.rs:63-86, peer_map.rs:151-163). Here the entire tick's
+worth of queries resolves as ONE jitted device batch over a
+device-resident subscription index — the north-star design from
+BASELINE.json.
+
+Layout (SoA, device-resident, integers only — no f64 on device):
+
+* ``sub_key``   [S] int64 — spatial hash of (world, cube), sorted
+* ``sub_world`` [S] int32 — interned world id, in key order
+* ``sub_xyz``   [S, 3] int64 — exact cube coords, for hash verification
+* ``sub_peer``  [S] int32 — interned peer id, in key order
+
+A query is two binary searches (``searchsorted`` left/right) giving the
+contiguous run of subscribers of its cube, an exactness check of
+(world, cube) against the candidate row, a fixed-degree-K gather of
+peer ids, and a replication mask — all fused by XLA into one kernel
+launch for the whole batch. K is the max cube occupancy, rounded to a
+power of two; S and M are padded to power-of-two capacity tiers so the
+number of compiled shapes stays logarithmic.
+
+The host keeps the authoritative dict index (inherited from
+``CpuSpatialBackend``) — point queries and membership checks stay exact
+and O(1) on host; ``flush()`` mirrors it to the device after mutations.
+Quantization always runs host-side in numpy f64 (golden semantics,
+cube_area.rs:23-44); the device only ever compares integer labels, so
+TPU fast-math cannot perturb grid assignment.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from functools import partial
+from typing import Sequence
+
+import numpy as np
+
+from . import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+
+from ..protocol.types import Replication, Vector3
+from .backend import Cube, LocalQuery, to_cube
+from .cpu_backend import CpuSpatialBackend
+from .hashing import NO_WORLD, PAD_KEY, next_pow2, spatial_keys
+from .quantize import cube_coords_batch
+
+_REPL_EXCEPT = np.int8(int(Replication.EXCEPT_SELF))
+_REPL_ONLY = np.int8(int(Replication.ONLY_SELF))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _match_kernel(
+    sub_key, sub_world, sub_xyz, sub_peer,
+    q_key, q_world, q_xyz, q_sender, q_repl,
+    *, k: int,
+):
+    """[M] queries × [S] sorted subscriptions → [M, K] peer ids (-1 pad)."""
+    s = sub_key.shape[0]
+    lo = jnp.searchsorted(sub_key, q_key, side="left")
+    hi = jnp.searchsorted(sub_key, q_key, side="right")
+    li = jnp.minimum(lo, s - 1)
+
+    # Exactness: the hash located a candidate run; admit it only if the
+    # run's first row carries the query's exact (world, cube).
+    found = (
+        (sub_key[li] == q_key)
+        & (sub_world[li] == q_world)
+        & jnp.all(sub_xyz[li] == q_xyz, axis=-1)
+    )
+    cnt = jnp.where(found, hi - lo, 0)
+
+    offs = jnp.arange(k, dtype=lo.dtype)
+    gidx = jnp.minimum(lo[:, None] + offs[None, :], s - 1)
+    tgt = sub_peer[gidx]
+    valid = offs[None, :] < cnt[:, None]
+
+    # Replication filter (local_message.rs:60-86).
+    is_sender = tgt == q_sender[:, None]
+    repl = q_repl[:, None]
+    valid &= jnp.where(
+        repl == int(_REPL_EXCEPT),
+        ~is_sender,
+        jnp.where(repl == int(_REPL_ONLY), is_sender, True),
+    )
+    return jnp.where(valid, tgt, -1)
+
+
+class TpuSpatialBackend(CpuSpatialBackend):
+    """Device-batched backend. Mutations and point queries run on the
+    host authority; ``match_local_batch`` runs on device."""
+
+    def __init__(self, cube_size: int):
+        super().__init__(cube_size)
+        self._world_ids: dict[str, int] = {}
+        self._peer_ids: dict[uuid_mod.UUID, int] = {}
+        self._peer_list: list[uuid_mod.UUID] = []
+        self._dirty = True
+        self._seed = 0
+        self._k = 8
+        self._n_subs = 0
+        self._dev: tuple | None = None  # (sub_key, sub_world, sub_xyz, sub_peer)
+
+    # region: interning
+
+    def _world_id(self, world: str) -> int:
+        wid = self._world_ids.get(world)
+        if wid is None:
+            wid = self._world_ids[world] = len(self._world_ids)
+        return wid
+
+    def _peer_id(self, peer: uuid_mod.UUID) -> int:
+        pid = self._peer_ids.get(peer)
+        if pid is None:
+            pid = self._peer_ids[peer] = len(self._peer_list)
+            self._peer_list.append(peer)
+        return pid
+
+    # endregion
+
+    # region: mutations (host authority + dirty mark)
+
+    def add_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        added = super().add_subscription(world, peer, pos)
+        if added:
+            self._world_id(world)
+            self._peer_id(peer)
+            self._dirty = True
+        return added
+
+    def remove_subscription(
+        self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
+    ) -> bool:
+        removed = super().remove_subscription(world, peer, pos)
+        if removed:
+            self._dirty = True
+        return removed
+
+    def remove_peer(self, peer: uuid_mod.UUID) -> bool:
+        removed = super().remove_peer(peer)
+        if removed:
+            self._dirty = True
+        return removed
+
+    def bulk_add_subscriptions(
+        self, world: str, peers: Sequence[uuid_mod.UUID], cubes: np.ndarray
+    ) -> int:
+        """Bulk-load peers[i] → cube rows [N, 3] (already quantized).
+        Loader for benchmarks and snapshot restore."""
+        added = 0
+        for peer, cube in zip(peers, cubes):
+            if super().add_subscription(world, peer, (int(cube[0]), int(cube[1]), int(cube[2]))):
+                self._peer_id(peer)
+                added += 1
+        if added:
+            self._world_id(world)
+            self._dirty = True
+        return added
+
+    # endregion
+
+    # region: device mirror
+
+    def flush(self) -> None:
+        """Rebuild the device mirror from the host authority."""
+        if not self._dirty:
+            return
+        self._dirty = False
+
+        n = self.subscription_count()
+        self._n_subs = n
+        if n == 0:
+            self._dev = None
+            return
+
+        worlds = np.empty(n, dtype=np.int32)
+        xyz = np.empty((n, 3), dtype=np.int64)
+        peers = np.empty(n, dtype=np.int32)
+        n_cubes = 0
+        i = 0
+        for wname, w in self._worlds.items():
+            wid = self._world_ids[wname]
+            n_cubes += len(w.cubes)
+            for cube, cube_peers in w.cubes.items():
+                j = i + len(cube_peers)
+                worlds[i:j] = wid
+                xyz[i:j] = cube
+                peers[i:j] = [self._peer_ids[p] for p in cube_peers]
+                i = j
+        assert i == n
+
+        # Seed search: distinct cubes must map to distinct keys, and no
+        # real key may equal the padding sentinel (see spatial/hashing).
+        while True:
+            keys = spatial_keys(worlds, xyz, self._seed)
+            uniq, counts = np.unique(keys, return_counts=True)
+            cube_occupancy = int(counts.max())
+            if uniq.size == n_cubes and (uniq[-1] if uniq.size else 0) != PAD_KEY:
+                break
+            self._seed += 1
+
+        order = np.argsort(keys, kind="stable")
+        cap = next_pow2(n)
+        pad = cap - n
+
+        def _pad(arr: np.ndarray, fill) -> np.ndarray:
+            if pad == 0:
+                return arr
+            widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+            return np.pad(arr, widths, constant_values=fill)
+
+        self._k = next_pow2(cube_occupancy, 8)
+        self._dev = (
+            jnp.asarray(_pad(keys[order], PAD_KEY)),
+            jnp.asarray(_pad(worlds[order], NO_WORLD)),
+            jnp.asarray(_pad(xyz[order], np.int64(-(2**62)))),
+            jnp.asarray(_pad(peers[order], np.int32(-1))),
+        )
+
+    # endregion
+
+    # region: batched hot path
+
+    def match_arrays(
+        self,
+        world_ids: np.ndarray,
+        positions: np.ndarray,
+        sender_ids: np.ndarray,
+        repls: np.ndarray,
+    ) -> np.ndarray:
+        """Array-native hot path: [M] int32 interned world ids, [M, 3]
+        f64 positions, [M] int32 sender peer ids (-1 for none), [M] int8
+        replication → [M, K] int32 peer ids, -1-padded.
+
+        Quantizes host-side (golden f64 semantics), then one fused
+        device batch. The object API wraps this; benchmarks call it
+        directly.
+        """
+        self.flush()
+        m = len(world_ids)
+        if self._dev is None or m == 0:
+            return np.full((m, 1), -1, dtype=np.int32)
+
+        cubes = cube_coords_batch(positions, self.cube_size)
+        keys = spatial_keys(world_ids, cubes, self._seed)
+
+        cap = next_pow2(m)
+        pad = cap - m
+        if pad:
+            keys = np.pad(keys, (0, pad), constant_values=PAD_KEY)
+            world_ids = np.pad(world_ids, (0, pad), constant_values=NO_WORLD)
+            cubes = np.pad(cubes, ((0, pad), (0, 0)), constant_values=0)
+            sender_ids = np.pad(sender_ids, (0, pad), constant_values=-1)
+            repls = np.pad(repls, (0, pad), constant_values=0)
+
+        tgt = _match_kernel(
+            *self._dev,
+            jnp.asarray(keys),
+            jnp.asarray(world_ids),
+            jnp.asarray(cubes),
+            jnp.asarray(sender_ids.astype(np.int32)),
+            jnp.asarray(repls.astype(np.int8)),
+            k=self._k,
+        )
+        return np.asarray(tgt[:m])
+
+    def match_local_batch(
+        self, queries: Sequence[LocalQuery]
+    ) -> list[list[uuid_mod.UUID]]:
+        m = len(queries)
+        if m == 0:
+            return []
+        world_ids = np.fromiter(
+            (self._world_ids.get(q.world, -1) for q in queries),
+            dtype=np.int32, count=m,
+        )
+        positions = np.empty((m, 3), dtype=np.float64)
+        for i, q in enumerate(queries):
+            positions[i] = (q.position.x, q.position.y, q.position.z)
+        sender_ids = np.fromiter(
+            (self._peer_ids.get(q.sender, -1) for q in queries),
+            dtype=np.int32, count=m,
+        )
+        repls = np.fromiter(
+            (int(q.replication) for q in queries), dtype=np.int8, count=m
+        )
+
+        tgt = self.match_arrays(world_ids, positions, sender_ids, repls)
+
+        mask = tgt >= 0
+        counts = mask.sum(axis=1)
+        flat = tgt[mask]
+        peer_list = self._peer_list
+        out: list[list[uuid_mod.UUID]] = []
+        pos = 0
+        for c in counts:
+            out.append([peer_list[i] for i in flat[pos:pos + c]])
+            pos += c
+        return out
+
+    # endregion
+
+    # region: introspection
+
+    def device_stats(self) -> dict:
+        return {
+            "subscriptions": self._n_subs,
+            "capacity": 0 if self._dev is None else int(self._dev[0].shape[0]),
+            "max_fanout_k": self._k,
+            "worlds": len(self._world_ids),
+            "peers": len(self._peer_list),
+            "hash_seed": self._seed,
+            "dirty": self._dirty,
+        }
+
+    # endregion
