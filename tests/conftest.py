@@ -1,15 +1,23 @@
-"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
-
-Must run before the first ``import jax`` anywhere in the test session so
-multi-chip sharding tests can exercise real Mesh/shard_map paths without
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so
+multi-chip sharding tests exercise real Mesh/shard_map paths without
 TPU hardware.
+
+This environment auto-imports jax at interpreter startup (an `axon`
+plugin .pth hook), so JAX_PLATFORMS/JAX_PLATFORM_NAME set here are too
+late and ignored. `jax.config.update` after import still works, and
+XLA_FLAGS is only read at (lazy) backend initialization — so set the
+flag, then override the platform via config before any test touches a
+device.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platform_name", "cpu")

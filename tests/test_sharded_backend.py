@@ -1,0 +1,110 @@
+"""Sharded backend on the 8-device virtual CPU mesh: behavioral parity
+with the single-chip backend and run-boundary split invariants."""
+
+import random
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.parallel import ShardedTpuSpatialBackend, make_fanout_mesh
+from worldql_server_tpu.parallel.sharded_backend import split_at_run_boundaries
+from worldql_server_tpu.protocol.types import Replication, Vector3
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+
+W = "world"
+
+
+def _require_devices(n: int):
+    import jax
+
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def test_split_at_run_boundaries():
+    keys = np.array([1, 1, 1, 2, 2, 3, 4, 4, 4, 4, 5, 6], dtype=np.int64)
+    splits = split_at_run_boundaries(keys, 4)
+    assert splits[0] == 0 and splits[-1] == len(keys)
+    assert splits == sorted(splits)
+    for s in splits[1:-1]:
+        if 0 < s < len(keys):
+            assert keys[s - 1] != keys[s], "run straddles a shard boundary"
+
+
+def test_split_single_giant_run():
+    keys = np.zeros(10, dtype=np.int64)
+    splits = split_at_run_boundaries(keys, 4)
+    assert splits[0] == 0 and splits[-1] == 10
+    assert all(a <= b for a, b in zip(splits, splits[1:]))
+
+
+@pytest.mark.parametrize("n_batch,n_space", [(1, 8), (2, 4), (4, 2)])
+def test_sharded_matches_cpu(n_batch, n_space):
+    _require_devices(n_batch * n_space)
+    mesh = make_fanout_mesh(n_batch, n_space)
+    rng = random.Random(0xC0FFEE + n_batch)
+    cpu = CpuSpatialBackend(16)
+    shard = ShardedTpuSpatialBackend(16, mesh)
+    peers = [uuid.uuid4() for _ in range(30)]
+    worlds = ["alpha", "beta", "gamma", "delta"]
+
+    def rand_pos():
+        return Vector3(
+            rng.uniform(-150, 150), rng.uniform(-150, 150), rng.uniform(-150, 150)
+        )
+
+    for _ in range(600):
+        w, p, pos = rng.choice(worlds), rng.choice(peers), rand_pos()
+        if rng.random() < 0.8:
+            assert cpu.add_subscription(w, p, pos) == shard.add_subscription(w, p, pos)
+        else:
+            assert cpu.remove_subscription(w, p, pos) == shard.remove_subscription(w, p, pos)
+
+    queries = [
+        LocalQuery(
+            rng.choice(worlds + ["never"]),
+            rand_pos(),
+            rng.choice(peers),
+            rng.choice(list(Replication)),
+        )
+        for _ in range(100)
+    ]
+    for c, t in zip(cpu.match_local_batch(queries), shard.match_local_batch(queries)):
+        assert set(c) == set(t)
+
+
+def test_sharded_mutation_then_requery():
+    _require_devices(8)
+    mesh = make_fanout_mesh(2, 4)
+    b = ShardedTpuSpatialBackend(16, mesh)
+    sender, other = uuid.uuid4(), uuid.uuid4()
+    pos = Vector3(5, 5, 5)
+    b.add_subscription(W, other, pos)
+    assert b.match_local_batch([LocalQuery(W, pos, sender)]) == [[other]]
+    b.remove_peer(other)
+    assert b.match_local_batch([LocalQuery(W, pos, sender)]) == [[]]
+    stats = b.device_stats()
+    assert stats["mesh"] == {"batch": 2, "space": 4}
+
+
+def test_non_pow2_batch_axis():
+    """Batch padding must stay divisible by a non-power-of-two batch
+    axis (regression: device_put raised on cap=8, n_batch=3)."""
+    _require_devices(6)
+    mesh = make_fanout_mesh(3, 2)
+    b = ShardedTpuSpatialBackend(16, mesh)
+    p = uuid.uuid4()
+    b.add_subscription(W, p, Vector3(5, 5, 5))
+    assert b.match_local_batch([LocalQuery(W, Vector3(5, 5, 5), uuid.uuid4())]) == [[p]]
+
+
+def test_make_fanout_mesh_validation():
+    _require_devices(8)
+    with pytest.raises(ValueError):
+        make_fanout_mesh(3)  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        make_fanout_mesh(4, 4)  # 16 > 8
+    mesh = make_fanout_mesh(2)
+    assert mesh.shape == {"batch": 2, "space": 4}

@@ -1,0 +1,16 @@
+"""Multi-chip scale-out: mesh construction and the sharded spatial
+backend (SURVEY §7 step 6; BASELINE configs 4-5).
+
+The reference scales by running more tokio tasks in one process
+(SURVEY §2 "Parallelism") — there is no multi-node story. Here the
+scale axis is a ``jax.sharding.Mesh``: subscriptions shard across the
+``space`` axis (the domain's sequence/context parallelism — sharding
+space, not sequence), query batches across the ``batch`` axis (data
+parallelism), and per-query partial results combine with one ``pmax``
+collective over ICI.
+"""
+
+from .mesh import make_fanout_mesh
+from .sharded_backend import ShardedTpuSpatialBackend
+
+__all__ = ["make_fanout_mesh", "ShardedTpuSpatialBackend"]

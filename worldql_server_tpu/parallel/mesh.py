@@ -1,0 +1,41 @@
+"""Device-mesh construction for the fan-out engine.
+
+Axes:
+
+* ``batch`` — data parallelism over the per-tick query batch; each
+  device resolves M/b queries.
+* ``space`` — the spatial index sharded by contiguous sorted-key
+  ranges; the domain's analog of sequence/context parallelism
+  (SURVEY §5: "sharding space, not sequence").
+
+On a real slice the mesh should be built so ``space`` rides ICI
+(neighbor collectives dominate); ``batch`` only ever combines at the
+end of a tick.
+"""
+
+from __future__ import annotations
+
+from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+from jax.sharding import Mesh
+
+
+def make_fanout_mesh(
+    n_batch: int = 1, n_space: int | None = None, devices=None
+) -> Mesh:
+    """Build a ('batch', 'space') mesh. With only ``n_batch`` given,
+    ``space`` takes all remaining devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if n_space is None:
+        if n % n_batch:
+            raise ValueError(f"{n} devices not divisible by batch={n_batch}")
+        n_space = n // n_batch
+    if n_batch * n_space > n:
+        raise ValueError(
+            f"mesh {n_batch}x{n_space} exceeds {n} available devices"
+        )
+    import numpy as np
+
+    grid = np.array(devices[: n_batch * n_space]).reshape(n_batch, n_space)
+    return Mesh(grid, axis_names=("batch", "space"))

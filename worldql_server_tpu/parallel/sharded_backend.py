@@ -1,0 +1,180 @@
+"""Spatial fan-out sharded over a device mesh.
+
+Scale-out design (BASELINE configs 4-5): the sorted subscription index
+is split into per-device contiguous key ranges — split points snapped
+to cube-run boundaries so every cube's subscriber run lives wholly on
+one device. Queries shard over the ``batch`` axis. Each device binary-
+searches its local range; exactly one ``space`` shard can match a given
+cube, so partial [M/b, K] results (−1 = no match) combine with a single
+``pmax`` over ``space`` — one ICI collective per tick, no host hops.
+
+SPMD via ``jax.shard_map``; XLA lays out the gathers per shard and the
+final combine as an ICI all-reduce(max). Worlds need no special
+handling: world id is part of the spatial key, so a world's cubes
+scatter across shards (load-balancing Zipf-hotspot worlds) while each
+cube stays device-local.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
+from ..spatial.quantize import cube_coords_batch
+from ..spatial.tpu_backend import TpuSpatialBackend, match_core
+
+
+def split_at_run_boundaries(keys: np.ndarray, n_shards: int) -> list[int]:
+    """Split points for sorted ``keys`` into ``n_shards`` near-equal
+    chunks, snapped left to run starts so equal keys never straddle a
+    boundary. Returns n_shards+1 offsets."""
+    n = len(keys)
+    splits = [0]
+    for i in range(1, n_shards):
+        ideal = (n * i) // n_shards
+        if ideal <= splits[-1]:
+            splits.append(splits[-1])
+            continue
+        snapped = int(np.searchsorted(keys, keys[ideal], side="left"))
+        splits.append(max(snapped, splits[-1]))
+    splits.append(n)
+    return splits
+
+
+def _sharded_match(mesh: Mesh, k: int):
+    """Build the jitted shard_map kernel for this mesh and fan-out K."""
+
+    def local(sub_key, sub_world, sub_xyz, sub_peer,
+              q_key, q_world, q_xyz, q_sender, q_repl):
+        tgt = match_core(
+            sub_key[0], sub_world[0], sub_xyz[0], sub_peer[0],
+            q_key, q_world, q_xyz, q_sender, q_repl, k=k,
+        )
+        # Exactly one 'space' shard holds any cube's run; everyone else
+        # contributes -1, so max is a lossless merge.
+        return jax.lax.pmax(tgt, "space")
+
+    sub = P("space", None)
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                sub, sub, P("space", None, None), sub,
+                P("batch"), P("batch"), P("batch", None),
+                P("batch"), P("batch"),
+            ),
+            out_specs=P("batch", None),
+        )
+    )
+
+
+class ShardedTpuSpatialBackend(TpuSpatialBackend):
+    """Multi-chip backend: same host authority and observable semantics
+    as the single-chip backend, index sharded over ``mesh``."""
+
+    def __init__(self, cube_size: int, mesh: Mesh):
+        super().__init__(cube_size)
+        if set(mesh.axis_names) != {"batch", "space"}:
+            raise ValueError("mesh must have axes ('batch', 'space')")
+        self.mesh = mesh
+        self.n_batch = mesh.shape["batch"]
+        self.n_space = mesh.shape["space"]
+        self._kernels: dict[int, object] = {}  # k → compiled shard_map
+
+    # region: device mirror (sharded)
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        self._dirty = False
+
+        built = self._build_sorted()
+        if built is None:
+            self._dev = None
+            return
+        keys, worlds, xyz, peers, cube_occupancy = built
+        self._k = next_pow2(cube_occupancy, 8)
+
+        splits = split_at_run_boundaries(keys, self.n_space)
+        cap = next_pow2(max(b - a for a, b in zip(splits, splits[1:])))
+
+        def stack(arr: np.ndarray, fill) -> np.ndarray:
+            return np.stack([
+                pad_to(arr[a:b], cap, fill)
+                for a, b in zip(splits, splits[1:])
+            ])
+
+        def put(arr: np.ndarray, spec: P):
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+        sub = P("space", None)
+        self._dev = (
+            put(stack(keys, PAD_KEY), sub),
+            put(stack(worlds, NO_WORLD), sub),
+            put(stack(xyz, np.int64(-(2**62))), P("space", None, None)),
+            put(stack(peers, np.int32(-1)), sub),
+        )
+
+    # endregion
+
+    # region: batched hot path
+
+    def match_arrays(
+        self,
+        world_ids: np.ndarray,
+        positions: np.ndarray,
+        sender_ids: np.ndarray,
+        repls: np.ndarray,
+    ) -> np.ndarray:
+        self.flush()
+        m = len(world_ids)
+        if self._dev is None or m == 0:
+            return np.full((m, 1), -1, dtype=np.int32)
+
+        cubes = cube_coords_batch(positions, self.cube_size)
+        keys = spatial_keys(world_ids, cubes, self._seed)
+
+        # Batch capacity must shard evenly over 'batch': power-of-two
+        # tier, rounded up to a multiple of n_batch (which need not be
+        # a power of two).
+        cap = max(next_pow2(m), self.n_batch)
+        cap = -(-cap // self.n_batch) * self.n_batch
+        keys = pad_to(keys, cap, PAD_KEY)
+        world_ids = pad_to(world_ids, cap, NO_WORLD)
+        cubes = pad_to(cubes, cap, np.int64(0))
+        sender_ids = pad_to(sender_ids.astype(np.int32), cap, np.int32(-1))
+        repls = pad_to(repls.astype(np.int8), cap, np.int8(0))
+
+        kernel = self._kernels.get(self._k)
+        if kernel is None:
+            kernel = self._kernels[self._k] = _sharded_match(self.mesh, self._k)
+
+        def put(arr, *spec):
+            return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+        tgt = kernel(
+            *self._dev,
+            put(keys, "batch"),
+            put(world_ids, "batch"),
+            put(cubes, "batch", None),
+            put(sender_ids, "batch"),
+            put(repls, "batch"),
+        )
+        return np.asarray(tgt[:m])
+
+    # endregion
+
+    def device_stats(self) -> dict:
+        stats = super().device_stats()
+        stats["mesh"] = {"batch": self.n_batch, "space": self.n_space}
+        if self._dev is not None:
+            stats["capacity"] = int(
+                self._dev[0].shape[0] * self._dev[0].shape[1]
+            )
+        return stats

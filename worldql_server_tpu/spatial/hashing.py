@@ -55,3 +55,12 @@ def next_pow2(n: int, floor: int = 8) -> int:
     the number of distinct compiled shapes to log2(capacity)."""
     n = max(n, floor)
     return 1 << (n - 1).bit_length()
+
+
+def pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    """Pad ``arr`` along axis 0 to ``size`` rows with ``fill``."""
+    pad = size - arr.shape[0]
+    if pad <= 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, widths, constant_values=fill)

@@ -45,20 +45,24 @@ import jax.numpy as jnp
 from ..protocol.types import Replication, Vector3
 from .backend import Cube, LocalQuery, to_cube
 from .cpu_backend import CpuSpatialBackend
-from .hashing import NO_WORLD, PAD_KEY, next_pow2, spatial_keys
+from .hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
 from .quantize import cube_coords_batch
 
 _REPL_EXCEPT = np.int8(int(Replication.EXCEPT_SELF))
 _REPL_ONLY = np.int8(int(Replication.ONLY_SELF))
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _match_kernel(
+def match_core(
     sub_key, sub_world, sub_xyz, sub_peer,
     q_key, q_world, q_xyz, q_sender, q_repl,
     *, k: int,
 ):
-    """[M] queries × [S] sorted subscriptions → [M, K] peer ids (-1 pad)."""
+    """[M] queries × [S] sorted subscriptions → [M, K] peer ids (-1 pad).
+
+    Pure traceable core; the single-chip backend jits it directly and
+    the sharded backend (parallel/sharded_backend.py) wraps it in
+    shard_map over a device mesh.
+    """
     s = sub_key.shape[0]
     lo = jnp.searchsorted(sub_key, q_key, side="left")
     hi = jnp.searchsorted(sub_key, q_key, side="right")
@@ -87,6 +91,9 @@ def _match_kernel(
         jnp.where(repl == int(_REPL_ONLY), is_sender, True),
     )
     return jnp.where(valid, tgt, -1)
+
+
+_match_kernel = partial(jax.jit, static_argnames=("k",))(match_core)
 
 
 class TpuSpatialBackend(CpuSpatialBackend):
@@ -166,17 +173,14 @@ class TpuSpatialBackend(CpuSpatialBackend):
 
     # region: device mirror
 
-    def flush(self) -> None:
-        """Rebuild the device mirror from the host authority."""
-        if not self._dirty:
-            return
-        self._dirty = False
-
+    def _build_sorted(self):
+        """Gather the host authority into key-sorted numpy SoA arrays:
+        → (keys, worlds, xyz, peers, max_cube_occupancy), or None if
+        empty. Also advances the hash seed past any collision."""
         n = self.subscription_count()
         self._n_subs = n
         if n == 0:
-            self._dev = None
-            return
+            return None
 
         worlds = np.empty(n, dtype=np.int32)
         xyz = np.empty((n, 3), dtype=np.int64)
@@ -205,21 +209,27 @@ class TpuSpatialBackend(CpuSpatialBackend):
             self._seed += 1
 
         order = np.argsort(keys, kind="stable")
-        cap = next_pow2(n)
-        pad = cap - n
+        return keys[order], worlds[order], xyz[order], peers[order], cube_occupancy
 
-        def _pad(arr: np.ndarray, fill) -> np.ndarray:
-            if pad == 0:
-                return arr
-            widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-            return np.pad(arr, widths, constant_values=fill)
+    def flush(self) -> None:
+        """Rebuild the device mirror from the host authority."""
+        if not self._dirty:
+            return
+        self._dirty = False
+
+        built = self._build_sorted()
+        if built is None:
+            self._dev = None
+            return
+        keys, worlds, xyz, peers, cube_occupancy = built
 
         self._k = next_pow2(cube_occupancy, 8)
+        cap = next_pow2(len(keys))
         self._dev = (
-            jnp.asarray(_pad(keys[order], PAD_KEY)),
-            jnp.asarray(_pad(worlds[order], NO_WORLD)),
-            jnp.asarray(_pad(xyz[order], np.int64(-(2**62)))),
-            jnp.asarray(_pad(peers[order], np.int32(-1))),
+            jnp.asarray(pad_to(keys, cap, PAD_KEY)),
+            jnp.asarray(pad_to(worlds, cap, NO_WORLD)),
+            jnp.asarray(pad_to(xyz, cap, np.int64(-(2**62)))),
+            jnp.asarray(pad_to(peers, cap, np.int32(-1))),
         )
 
     # endregion
@@ -250,13 +260,11 @@ class TpuSpatialBackend(CpuSpatialBackend):
         keys = spatial_keys(world_ids, cubes, self._seed)
 
         cap = next_pow2(m)
-        pad = cap - m
-        if pad:
-            keys = np.pad(keys, (0, pad), constant_values=PAD_KEY)
-            world_ids = np.pad(world_ids, (0, pad), constant_values=NO_WORLD)
-            cubes = np.pad(cubes, ((0, pad), (0, 0)), constant_values=0)
-            sender_ids = np.pad(sender_ids, (0, pad), constant_values=-1)
-            repls = np.pad(repls, (0, pad), constant_values=0)
+        keys = pad_to(keys, cap, PAD_KEY)
+        world_ids = pad_to(world_ids, cap, NO_WORLD)
+        cubes = pad_to(cubes, cap, np.int64(0))
+        sender_ids = pad_to(sender_ids, cap, np.int32(-1))
+        repls = pad_to(repls, cap, np.int8(0))
 
         tgt = _match_kernel(
             *self._dev,
