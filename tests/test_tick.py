@@ -1,0 +1,95 @@
+"""Device tick loop correctness vs numpy reference implementations."""
+
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from worldql_server_tpu.ops.tick import (
+    EntityState,
+    device_coord_clamp,
+    device_spatial_keys,
+    example_state,
+    make_tick_fn,
+)
+from worldql_server_tpu.spatial.hashing import spatial_keys
+from worldql_server_tpu.spatial.quantize import coord_clamp
+
+
+def test_device_coord_clamp_matches_host_golden():
+    """f32-representable coordinates must quantize exactly like the
+    golden host quantizer (cube_area.rs:23-44 semantics)."""
+    rng = np.random.default_rng(11)
+    # quarters are f32-exact; include exact multiples, zero, negatives
+    coords = np.concatenate([
+        np.round(rng.uniform(-500, 500, 500) * 4) / 4,
+        np.array([0.0, 16.0, -16.0, 32.0, -32.0, 0.25, -0.25, 15.75, -15.75]),
+    ]).astype(np.float32)
+    for size in (10, 16):
+        got = np.asarray(device_coord_clamp(jnp.asarray(coords), size))
+        want = np.array([coord_clamp(float(c), size) for c in coords])
+        np.testing.assert_array_equal(got, want)
+
+
+def test_device_keys_match_host_keys():
+    """The device hash must agree with the host hash bit-for-bit, so
+    host-built indexes and device-built queries interoperate."""
+    rng = np.random.default_rng(5)
+    worlds = rng.integers(0, 8, 64).astype(np.int32)
+    cubes = rng.integers(-1000, 1000, (64, 3)).astype(np.int64)
+    host = spatial_keys(worlds, cubes, seed=3)
+    dev = np.asarray(
+        device_spatial_keys(jnp.asarray(worlds), jnp.asarray(cubes), seed=3)
+    )
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_tick_counts_and_targets_match_numpy():
+    state = example_state(n=512, n_worlds=3)
+    k = 64
+    tick = jax.jit(make_tick_fn(cube_size=16, k=k))
+    new_state, targets, counts = tick(state)
+
+    pos = np.asarray(new_state.position)
+    world = np.asarray(state.world)
+    peer = np.asarray(state.peer)
+
+    cubes = np.stack(
+        [[coord_clamp(float(c), 16) for c in row] for row in pos]
+    ).astype(np.int64)
+    cells = [tuple([int(world[i])] + list(cubes[i])) for i in range(len(pos))]
+    pop = Counter(cells)
+
+    np.testing.assert_array_equal(np.asarray(counts), [pop[c] for c in cells])
+
+    tgt = np.asarray(targets)
+    for i in range(len(pos)):
+        expect = {int(peer[j]) for j in range(len(pos))
+                  if cells[j] == cells[i] and j != i}
+        got = {int(t) for t in tgt[i] if t >= 0}
+        assert got == expect, f"entity {i}"
+
+
+def test_tick_reflects_at_bounds():
+    state = EntityState(
+        position=jnp.array([[999.0, 0.0, -999.0]], jnp.float32),
+        velocity=jnp.array([[100.0, 0.0, -100.0]], jnp.float32),
+        world=jnp.zeros(1, jnp.int32),
+        peer=jnp.zeros(1, jnp.int32),
+    )
+    tick = make_tick_fn(cube_size=16, k=8, dt=1.0, bounds=1000.0)
+    new_state, _, _ = tick(state)
+    pos = np.asarray(new_state.position)[0]
+    vel = np.asarray(new_state.velocity)[0]
+    assert pos[0] == 901.0 and vel[0] == -100.0
+    assert pos[2] == -901.0 and vel[2] == 100.0
+
+
+def test_tick_is_deterministic():
+    state = example_state(n=256)
+    tick = jax.jit(make_tick_fn(cube_size=16, k=16))
+    out1 = tick(state)
+    out2 = tick(state)
+    for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(out2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

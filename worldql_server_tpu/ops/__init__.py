@@ -1,0 +1,19 @@
+"""Device-resident simulation ops: the fully-on-device tick loop used
+by the crowd benchmarks (BASELINE configs 2/3/5) and the graft entry.
+"""
+
+from .tick import (
+    EntityState,
+    device_coord_clamp,
+    device_spatial_keys,
+    make_tick_fn,
+    simulation_tick,
+)
+
+__all__ = [
+    "EntityState",
+    "device_coord_clamp",
+    "device_spatial_keys",
+    "make_tick_fn",
+    "simulation_tick",
+]

@@ -24,8 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
-from ..spatial.quantize import cube_coords_batch
+from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to
 from ..spatial.tpu_backend import TpuSpatialBackend, match_core
 
 
@@ -125,40 +124,24 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
     # region: batched hot path
 
-    def match_arrays(
-        self,
-        world_ids: np.ndarray,
-        positions: np.ndarray,
-        sender_ids: np.ndarray,
-        repls: np.ndarray,
-    ) -> np.ndarray:
-        self.flush()
-        m = len(world_ids)
-        if self._dev is None or m == 0:
-            return np.full((m, 1), -1, dtype=np.int32)
-
-        cubes = cube_coords_batch(positions, self.cube_size)
-        keys = spatial_keys(world_ids, cubes, self._seed)
-
+    def _query_cap(self, m: int) -> int:
         # Batch capacity must shard evenly over 'batch': power-of-two
         # tier, rounded up to a multiple of n_batch (which need not be
         # a power of two).
         cap = max(next_pow2(m), self.n_batch)
-        cap = -(-cap // self.n_batch) * self.n_batch
-        keys = pad_to(keys, cap, PAD_KEY)
-        world_ids = pad_to(world_ids, cap, NO_WORLD)
-        cubes = pad_to(cubes, cap, np.int64(0))
-        sender_ids = pad_to(sender_ids.astype(np.int32), cap, np.int32(-1))
-        repls = pad_to(repls.astype(np.int8), cap, np.int8(0))
+        return -(-cap // self.n_batch) * self.n_batch
 
+    def _dispatch(self, queries: tuple):
         kernel = self._kernels.get(self._k)
         if kernel is None:
             kernel = self._kernels[self._k] = _sharded_match(self.mesh, self._k)
 
+        keys, world_ids, cubes, sender_ids, repls = queries
+
         def put(arr, *spec):
             return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
-        tgt = kernel(
+        return kernel(
             *self._dev,
             put(keys, "batch"),
             put(world_ids, "batch"),
@@ -166,7 +149,6 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             put(sender_ids, "batch"),
             put(repls, "batch"),
         )
-        return np.asarray(tgt[:m])
 
     # endregion
 

@@ -259,23 +259,26 @@ class TpuSpatialBackend(CpuSpatialBackend):
         cubes = cube_coords_batch(positions, self.cube_size)
         keys = spatial_keys(world_ids, cubes, self._seed)
 
-        cap = next_pow2(m)
-        keys = pad_to(keys, cap, PAD_KEY)
-        world_ids = pad_to(world_ids, cap, NO_WORLD)
-        cubes = pad_to(cubes, cap, np.int64(0))
-        sender_ids = pad_to(sender_ids, cap, np.int32(-1))
-        repls = pad_to(repls, cap, np.int8(0))
-
-        tgt = _match_kernel(
-            *self._dev,
-            jnp.asarray(keys),
-            jnp.asarray(world_ids),
-            jnp.asarray(cubes),
-            jnp.asarray(sender_ids.astype(np.int32)),
-            jnp.asarray(repls.astype(np.int8)),
-            k=self._k,
+        cap = self._query_cap(m)
+        queries = (
+            pad_to(keys, cap, PAD_KEY),
+            pad_to(world_ids, cap, NO_WORLD),
+            pad_to(cubes, cap, np.int64(0)),
+            pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
+            pad_to(repls.astype(np.int8), cap, np.int8(0)),
         )
-        return np.asarray(tgt[:m])
+        return np.asarray(self._dispatch(queries)[:m])
+
+    def _query_cap(self, m: int) -> int:
+        """Padded query-batch capacity tier; sharded backends round to
+        their batch-axis divisibility."""
+        return next_pow2(m)
+
+    def _dispatch(self, queries: tuple):
+        """Run the padded query arrays against the device mirror."""
+        return _match_kernel(
+            *self._dev, *(jnp.asarray(q) for q in queries), k=self._k
+        )
 
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
