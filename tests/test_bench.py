@@ -1,0 +1,29 @@
+"""bench.py harness smoke test: runs tiny shapes, checks the JSON
+contract line (driver protocol: ONE json object on stdout)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_bench_contract():
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"), "--subs", "4000",
+         "--queries", "256", "--ticks", "6", "--cpu-ticks", "2"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "JAX_PLATFORM_NAME": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be one JSON line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "local_fanout_sustained_tick_ms"
+    assert rec["unit"] == "ms"
+    assert rec["value"] > 0
+    assert "vs_baseline" in rec
+    assert "parity check" in out.stderr

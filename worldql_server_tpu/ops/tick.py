@@ -64,9 +64,11 @@ def device_coord_clamp(x: jax.Array, size: int) -> jax.Array:
     return jnp.where(jnp.isnan(x), jnp.int64(size), res * mult)
 
 
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+from ..spatial.hashing import MIX_GOLDEN, MIX_M1, MIX_M2
+
+_M1 = jnp.uint64(MIX_M1)
+_M2 = jnp.uint64(MIX_M2)
+_GOLDEN = jnp.uint64(MIX_GOLDEN)
 
 
 def _mix(x: jax.Array) -> jax.Array:

@@ -131,6 +131,18 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         cap = max(next_pow2(m), self.n_batch)
         return -(-cap // self.n_batch) * self.n_batch
 
+    def _dispatch_sparse(self, queries: tuple, c: int):
+        raise NotImplementedError(
+            "sparse/CSR compaction over a sharded mesh lands with the "
+            "distributed delivery path; use the dense API here"
+        )
+
+    def _dispatch_csr(self, queries: tuple, t_cap: int):
+        raise NotImplementedError(
+            "sparse/CSR compaction over a sharded mesh lands with the "
+            "distributed delivery path; use the dense API here"
+        )
+
     def _dispatch(self, queries: tuple):
         kernel = self._kernels.get(self._k)
         if kernel is None:

@@ -74,6 +74,17 @@ class CpuSpatialBackend(SpatialBackend):
             del w.peer_cubes[peer]
         return True
 
+    def bulk_add_subscriptions(self, world, peers, cubes) -> int:
+        """Bulk-load peers[i] → cube rows [N, 3] (already quantized).
+        Loader for benchmarks and snapshot restore."""
+        added = 0
+        for peer, cube in zip(peers, cubes):
+            if self.add_subscription(
+                world, peer, (int(cube[0]), int(cube[1]), int(cube[2]))
+            ):
+                added += 1
+        return added
+
     def remove_peer(self, peer: uuid_mod.UUID) -> bool:
         removed = False
         for w in self._worlds.values():

@@ -20,9 +20,15 @@ from __future__ import annotations
 
 import numpy as np
 
-_M1 = np.uint64(0xBF58476D1CE4E5B9)
-_M2 = np.uint64(0x94D049BB133111EB)
-_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+# splitmix64 constants — shared with the device twin
+# (ops/tick.device_spatial_keys), which must stay bit-identical.
+MIX_M1 = 0xBF58476D1CE4E5B9
+MIX_M2 = 0x94D049BB133111EB
+MIX_GOLDEN = 0x9E3779B97F4A7C15
+
+_M1 = np.uint64(MIX_M1)
+_M2 = np.uint64(MIX_M2)
+_GOLDEN = np.uint64(MIX_GOLDEN)
 
 # Padding rows sort after every real key; flush re-seeds if a real key
 # ever hashes to this value.

@@ -96,6 +96,67 @@ def match_core(
 _match_kernel = partial(jax.jit, static_argnames=("k",))(match_core)
 
 
+def match_core_sparse(
+    sub_key, sub_world, sub_xyz, sub_peer,
+    q_key, q_world, q_xyz, q_sender, q_repl,
+    *, k: int, c: int,
+):
+    """Sparse variant: most queries resolve to an empty fan-out (an
+    entity alone in its cube broadcasting except-self), so compact the
+    non-empty rows on device and ship only those. Returns
+    ``(rows[c], targets[c, k], n_hits)``: query indices with >= 1
+    target, their target rows, and the true hit count (host re-fetches
+    dense on the rare ``n_hits > c`` overflow). Cuts device→host result
+    bytes by the hit rate — the dominant cost on PCIe, decisive on
+    tunneled devices."""
+    tgt = match_core(
+        sub_key, sub_world, sub_xyz, sub_peer,
+        q_key, q_world, q_xyz, q_sender, q_repl, k=k,
+    )
+    nz = jnp.any(tgt >= 0, axis=1)
+    order = jnp.argsort(~nz, stable=True)  # hit rows first, in order
+    rows = order[:c]
+    return rows.astype(jnp.int32), tgt[rows], nz.sum(dtype=jnp.int32)
+
+
+_match_kernel_sparse = partial(jax.jit, static_argnames=("k", "c"))(
+    match_core_sparse
+)
+
+
+def match_core_csr(
+    sub_key, sub_world, sub_xyz, sub_peer,
+    q_key, q_world, q_xyz, q_sender, q_repl,
+    *, k: int, t_cap: int,
+):
+    """CSR-compacted variant: returns ``(counts[M], flat[t_cap],
+    total)`` — per-query fan-out counts and all target peer ids
+    concatenated in query order. This is the layout the host needs to
+    build per-peer frames, and it shrinks the device→host result from
+    M×K to ~total ints (the dominant cost on the wire back). On
+    ``total > t_cap`` overflow the tail is dropped; callers detect via
+    ``total`` and re-fetch dense."""
+    tgt = match_core(
+        sub_key, sub_world, sub_xyz, sub_peer,
+        q_key, q_world, q_xyz, q_sender, q_repl, k=k,
+    )
+    valid = tgt >= 0
+    cnt = valid.sum(axis=1, dtype=jnp.int32)
+    starts = jnp.cumsum(cnt) - cnt  # exclusive prefix
+    slot = jnp.cumsum(valid, axis=1) - 1
+    flat_idx = jnp.where(valid, starts[:, None] + slot, t_cap)
+    flat_idx = jnp.minimum(flat_idx, t_cap)  # overflow tail → spill slot
+    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32).at[flat_idx].max(
+        jnp.where(valid, tgt, -1)
+    )
+    return cnt, flat[:t_cap], cnt.sum(dtype=jnp.int32)
+
+
+_match_kernel_csr = partial(jax.jit, static_argnames=("k", "t_cap"))(
+    match_core_csr
+)
+
+
 class TpuSpatialBackend(CpuSpatialBackend):
     """Device-batched backend. Mutations and point queries run on the
     host authority; ``match_local_batch`` runs on device."""
@@ -153,21 +214,6 @@ class TpuSpatialBackend(CpuSpatialBackend):
         if removed:
             self._dirty = True
         return removed
-
-    def bulk_add_subscriptions(
-        self, world: str, peers: Sequence[uuid_mod.UUID], cubes: np.ndarray
-    ) -> int:
-        """Bulk-load peers[i] → cube rows [N, 3] (already quantized).
-        Loader for benchmarks and snapshot restore."""
-        added = 0
-        for peer, cube in zip(peers, cubes):
-            if super().add_subscription(world, peer, (int(cube[0]), int(cube[1]), int(cube[2]))):
-                self._peer_id(peer)
-                added += 1
-        if added:
-            self._world_id(world)
-            self._dirty = True
-        return added
 
     # endregion
 
@@ -251,10 +297,35 @@ class TpuSpatialBackend(CpuSpatialBackend):
         device batch. The object API wraps this; benchmarks call it
         directly.
         """
+        m, result = self.match_arrays_async(
+            world_ids, positions, sender_ids, repls
+        )
+        if result is None:
+            return np.full((m, 1), -1, dtype=np.int32)
+        return np.asarray(result[:m])
+
+    def match_arrays_async(
+        self,
+        world_ids: np.ndarray,
+        positions: np.ndarray,
+        sender_ids: np.ndarray,
+        repls: np.ndarray,
+        max_hits: int | None = None,
+        csr_cap: int | None = None,
+    ):
+        """Asynchronous hot path: dispatch without forcing the result.
+
+        Returns ``(m, result)`` where ``result`` is the device value —
+        dense ``targets``; with ``max_hits`` the sparse
+        ``(rows, targets, n_hits)`` triple; with ``csr_cap`` the
+        compacted ``(counts, flat_targets, total)`` triple. Callers
+        overlap ticks by dispatching tick t+1 before reading tick t
+        (double buffering: transfer and compute of adjacent ticks
+        overlap)."""
         self.flush()
         m = len(world_ids)
         if self._dev is None or m == 0:
-            return np.full((m, 1), -1, dtype=np.int32)
+            return m, None
 
         cubes = cube_coords_batch(positions, self.cube_size)
         keys = spatial_keys(world_ids, cubes, self._seed)
@@ -267,7 +338,19 @@ class TpuSpatialBackend(CpuSpatialBackend):
             pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
             pad_to(repls.astype(np.int8), cap, np.int8(0)),
         )
-        return np.asarray(self._dispatch(queries)[:m])
+        if csr_cap is not None:
+            result = self._dispatch_csr(queries, next_pow2(csr_cap))
+        elif max_hits is not None:
+            result = self._dispatch_sparse(queries, next_pow2(max_hits))
+        else:
+            result = (self._dispatch(queries),)
+        # Enqueue D2H now: by the time a pipelined caller reads the
+        # result, the copy has landed — the read costs no round-trip.
+        for r in result:
+            copy = getattr(r, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        return m, result[0] if max_hits is None and csr_cap is None else result
 
     def _query_cap(self, m: int) -> int:
         """Padded query-batch capacity tier; sharded backends round to
@@ -275,10 +358,17 @@ class TpuSpatialBackend(CpuSpatialBackend):
         return next_pow2(m)
 
     def _dispatch(self, queries: tuple):
-        """Run the padded query arrays against the device mirror."""
-        return _match_kernel(
-            *self._dev, *(jnp.asarray(q) for q in queries), k=self._k
-        )
+        """Run the padded query arrays against the device mirror. Numpy
+        args go straight into the jitted call so all five H2D transfers
+        ride one dispatch — on tunneled/remote devices per-array
+        ``device_put`` round-trips dominate otherwise."""
+        return _match_kernel(*self._dev, *queries, k=self._k)
+
+    def _dispatch_sparse(self, queries: tuple, c: int):
+        return _match_kernel_sparse(*self._dev, *queries, k=self._k, c=c)
+
+    def _dispatch_csr(self, queries: tuple, t_cap: int):
+        return _match_kernel_csr(*self._dev, *queries, k=self._k, t_cap=t_cap)
 
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
