@@ -1,0 +1,303 @@
+"""Record store contract tests, run against every available backend.
+
+One parametrized suite pins the capability contract from the
+reference's DatabaseClient (store.py docstring): append-only inserts,
+region-scoped reads with 'after' filtering, read-repair dedupe,
+deletes, lazy DDL across table cells, and (sqlite) durability across
+reopen. The memory store is the semantic reference; sqlite must agree
+with it everywhere.
+"""
+
+import asyncio
+import uuid
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.protocol.types import Record, Vector3
+from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+from worldql_server_tpu.storage.sqlite_store import SqliteRecordStore
+from worldql_server_tpu.storage.store import open_store
+
+
+def make_config(**kw) -> Config:
+    return Config(store_url="memory://", **kw)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store_factory(request, tmp_path):
+    """Returns an async factory; tests open the store inside their own
+    event loop (no pytest-asyncio in this image)."""
+
+    async def make():
+        config = make_config()
+        if request.param == "memory":
+            s = MemoryRecordStore(config)
+        else:
+            s = SqliteRecordStore(str(tmp_path / "records.db"), config)
+        await s.init()
+        return s
+
+    return make
+
+
+def rec(world="world", pos=(1.0, 2.0, 3.0), data="payload", rid=None) -> Record:
+    return Record(
+        uuid=rid or uuid.uuid4(),
+        position=Vector3(*pos) if pos is not None else None,
+        world_name=world,
+        data=data,
+    )
+
+
+def test_insert_and_read_roundtrip(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_insert_and_read_roundtrip(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_insert_and_read_roundtrip(store):
+    r = rec()
+    assert await store.insert_records([r]) == 1
+    rows = await store.get_records_in_region("world", Vector3(5, 5, 5))
+    assert len(rows) == 1
+    got = rows[0].record
+    assert got.uuid == r.uuid
+    assert got.data == "payload"
+    assert got.position == Vector3(1.0, 2.0, 3.0)
+
+
+def test_read_is_region_scoped(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_read_is_region_scoped(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_read_is_region_scoped(store):
+    await store.insert_records([rec(pos=(1, 1, 1))])
+    # default region sizes 16/256/16: x=100 is a different region
+    assert await store.get_records_in_region("world", Vector3(100, 1, 1)) == []
+    # same region, different world
+    assert await store.get_records_in_region("other", Vector3(1, 1, 1)) == []
+
+
+def test_insert_is_append_duplicates_tolerated(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_insert_is_append_duplicates_tolerated(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_insert_is_append_duplicates_tolerated(store):
+    rid = uuid.uuid4()
+    await store.insert_records([rec(rid=rid, data="v1")])
+    await store.insert_records([rec(rid=rid, data="v2")])
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    assert len(rows) == 2
+
+
+def test_after_filter(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_after_filter(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_after_filter(store):
+    await store.insert_records([rec(data="old")])
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    cutoff = rows[0].timestamp
+    await asyncio.sleep(0.01)
+    await store.insert_records([rec(data="new")])
+
+    newer = await store.get_records_in_region("world", Vector3(1, 1, 1), cutoff)
+    assert [sr.record.data for sr in newer] == ["new"]
+    none = await store.get_records_in_region(
+        "world", Vector3(1, 1, 1), cutoff + timedelta(hours=1)
+    )
+    assert none == []
+
+
+def test_dedupe_records_removes_older(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_dedupe_records_removes_older(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_dedupe_records_removes_older(store):
+    rid = uuid.uuid4()
+    await store.insert_records([rec(rid=rid, data="v1")])
+    await asyncio.sleep(0.01)
+    await store.insert_records([rec(rid=rid, data="v2")])
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    keep_ts = max(sr.timestamp for sr in rows)
+
+    deleted = await store.dedupe_records(
+        [(rid, keep_ts, "world", Vector3(1, 1, 1))]
+    )
+    assert deleted == 1
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    assert [sr.record.data for sr in rows] == ["v2"]
+
+
+def test_delete_records(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_delete_records(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_delete_records(store):
+    r1, r2 = rec(data="a"), rec(data="b")
+    await store.insert_records([r1, r2])
+    assert await store.delete_records([r1]) == 1
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    assert [sr.record.uuid for sr in rows] == [r2.uuid]
+    # deleting again is a no-op
+    assert await store.delete_records([r1]) == 0
+
+
+def test_record_without_position_skipped(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_record_without_position_skipped(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_record_without_position_skipped(store):
+    assert await store.insert_records([rec(pos=None)]) == 0
+
+
+def test_world_name_is_sanitized(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_world_name_is_sanitized(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_world_name_is_sanitized(store):
+    """'my world' and 'my_world' are the same storage key
+    (world_names.rs:54-87 replacement rules)."""
+    await store.insert_records([rec(world="my world")])
+    rows = await store.get_records_in_region("my_world", Vector3(1, 1, 1))
+    assert len(rows) == 1
+
+
+def test_far_regions_hit_distinct_tables(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_far_regions_hit_distinct_tables(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_far_regions_hit_distinct_tables(store):
+    """Positions beyond table_size land in lazily-created separate
+    tables (client.rs:178-225)."""
+    await store.insert_records([rec(pos=(1, 1, 1), data="near")])
+    await store.insert_records([rec(pos=(5000.0, 1, 1), data="far")])
+    near = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    far = await store.get_records_in_region("world", Vector3(5000.0, 1, 1))
+    assert [sr.record.data for sr in near] == ["near"]
+    assert [sr.record.data for sr in far] == ["far"]
+
+
+def test_negative_coordinates(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_negative_coordinates(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_negative_coordinates(store):
+    await store.insert_records([rec(pos=(-1.0, -1.0, -1.0), data="neg")])
+    rows = await store.get_records_in_region("world", Vector3(-5.0, -5.0, -5.0))
+    assert [sr.record.data for sr in rows] == ["neg"]
+    assert await store.get_records_in_region("world", Vector3(5.0, 5.0, 5.0)) == []
+
+
+def test_flex_bytes_roundtrip(store_factory):
+    async def scenario():
+        store = await store_factory()
+        try:
+            await _test_flex_bytes_roundtrip(store)
+        finally:
+            await store.close()
+    run(scenario())
+
+
+async def _test_flex_bytes_roundtrip(store):
+    r = rec()
+    r.flex = b"\x00\x01\xffbinary"
+    await store.insert_records([r])
+    rows = await store.get_records_in_region("world", Vector3(1, 1, 1))
+    assert rows[0].record.flex == b"\x00\x01\xffbinary"
+
+
+def test_sqlite_durability_across_reopen(tmp_path):
+    run(_durability(tmp_path))
+
+
+async def _durability(tmp_path):
+    config = make_config()
+    path = str(tmp_path / "durable.db")
+    s = SqliteRecordStore(path, config)
+    await s.init()
+    r = rec()
+    await s.insert_records([r])
+    await s.close()
+
+    s2 = SqliteRecordStore(path, config)
+    await s2.init()
+    rows = await s2.get_records_in_region("world", Vector3(1, 1, 1))
+    assert [sr.record.uuid for sr in rows] == [r.uuid]
+    await s2.close()
+
+
+def test_open_store_dispatch(tmp_path):
+    config = make_config()
+    assert isinstance(open_store("memory://", config), MemoryRecordStore)
+    assert isinstance(
+        open_store(f"sqlite://{tmp_path}/x.db", config), SqliteRecordStore
+    )
+    with pytest.raises(ValueError):
+        open_store("bogus://", config)
+    with pytest.raises(ImportError):
+        open_store("postgres://u@h/db", config)  # no driver in this image

@@ -34,6 +34,8 @@ from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
 import jax
 import jax.numpy as jnp
 
+from ..spatial.hashing import MIX_GOLDEN, MIX_M1, MIX_M2
+
 
 class EntityState(NamedTuple):
     """SoA device state for one entity population."""
@@ -63,8 +65,6 @@ def device_coord_clamp(x: jax.Array, size: int) -> jax.Array:
     # platform-defined, so guard explicitly).
     return jnp.where(jnp.isnan(x), jnp.int64(size), res * mult)
 
-
-from ..spatial.hashing import MIX_GOLDEN, MIX_M1, MIX_M2
 
 _M1 = jnp.uint64(MIX_M1)
 _M2 = jnp.uint64(MIX_M2)

@@ -302,7 +302,9 @@ class TpuSpatialBackend(CpuSpatialBackend):
         )
         if result is None:
             return np.full((m, 1), -1, dtype=np.int32)
-        return np.asarray(result[:m])
+        # Convert the whole (prefetched) array, trim on host — a device
+        # slice would dispatch again and re-transfer.
+        return np.asarray(result)[:m]
 
     def match_arrays_async(
         self,

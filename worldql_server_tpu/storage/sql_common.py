@@ -1,0 +1,73 @@
+"""Shared core for SQL record stores: geo-sharded navigation.
+
+Rebuilds the reference's two-level sharding scheme
+(worldql_server/src/database/{world_region,navigation}.rs):
+
+* a position floors to a **region** cell of (x, y, z) sizes
+  (world_region.rs:93-110 — see spatial/quantize.clamp_region_coord);
+* regions group into **tables** of ``table_size`` extent per axis
+  (world_region.rs:38-59);
+* ``navigation`` tables map (world, bounds) → serial ``table_suffix`` /
+  ``region_id`` (query_constants.rs:2-38), cached in LRUs sized by
+  ``db_cache_size`` (0 = unbounded; navigation.rs:30-34, args.rs:57-61);
+* data rows live in per-(world, table) tables named from the sanitized
+  world name — safety rests on ``sanitize_world_name`` exactly like the
+  reference (world_names.rs:54-87).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..protocol.types import Vector3
+from ..spatial.quantize import region_coords, table_bounds
+from ..utils.names import sanitize_world_name
+
+
+class LruCache:
+    """Minimal LRU; ``maxsize=0`` means unbounded (navigation.rs:30-34)."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._map: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        try:
+            self._map.move_to_end(key)
+            return self._map[key]
+        except KeyError:
+            return None
+
+    def put(self, key, value) -> None:
+        self._map[key] = value
+        self._map.move_to_end(key)
+        if self.maxsize and len(self._map) > self.maxsize:
+            self._map.popitem(last=False)
+
+
+class RegionMath:
+    """Position → (region cell, table cell) quantization."""
+
+    def __init__(self, config):
+        self.rx = config.db_region_x_size
+        self.ry = config.db_region_y_size
+        self.rz = config.db_region_z_size
+        self.table_size = config.db_table_size
+
+    def region_of(self, position: Vector3) -> tuple[int, int, int]:
+        return region_coords(
+            position.x, position.y, position.z, self.rx, self.ry, self.rz
+        )
+
+    def table_of(self, region: tuple[int, int, int]) -> tuple[int, int, int]:
+        return (
+            table_bounds(region[0], self.table_size)[0],
+            table_bounds(region[1], self.table_size)[0],
+            table_bounds(region[2], self.table_size)[0],
+        )
+
+
+def world_key(world_name: str) -> str:
+    """Sanitized world name — the only value ever spliced into SQL
+    identifiers (world_names.rs gate)."""
+    return sanitize_world_name(world_name)

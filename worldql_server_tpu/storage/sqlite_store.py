@@ -1,0 +1,294 @@
+"""SQLite RecordStore: the default self-contained persistent store.
+
+Same observable contract as the reference's Postgres DatabaseClient
+(worldql_server/src/database/client.rs) — append-only inserts with
+dedupe-on-read, region-scoped reads, read-repair deletes, lazy DDL —
+mapped onto SQLite: the reference's schema-per-world + table-per-suffix
+(``w_<world>.t_<n>``, query_constants.rs:84-121) becomes table
+``w_<world>__t_<n>`` (SQLite has no schemas), with the same btree index
+on region_id and the same navigation mapping.
+
+sqlite3 is synchronous; every operation runs on the event loop's
+default executor via ``asyncio.to_thread`` under a store-wide lock
+(the reference likewise serializes on one DatabaseClient instance,
+thread.rs:151-155).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sqlite3
+import uuid as uuid_mod
+from datetime import datetime, timezone
+
+from ..protocol.types import Record, Vector3
+from .sql_common import LruCache, RegionMath, world_key
+from .store import DedupeOp, RecordStore, StoredRecord
+
+logger = logging.getLogger(__name__)
+
+_NAV_DDL = (
+    """CREATE TABLE IF NOT EXISTS navigation_tables (
+        world_name TEXT NOT NULL,
+        tx INTEGER NOT NULL, ty INTEGER NOT NULL, tz INTEGER NOT NULL,
+        table_suffix INTEGER PRIMARY KEY AUTOINCREMENT,
+        UNIQUE (world_name, tx, ty, tz)
+    )""",
+    """CREATE TABLE IF NOT EXISTS navigation_regions (
+        world_name TEXT NOT NULL,
+        rx INTEGER NOT NULL, ry INTEGER NOT NULL, rz INTEGER NOT NULL,
+        region_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        UNIQUE (world_name, rx, ry, rz)
+    )""",
+)
+
+
+def _data_table(world: str, suffix: int) -> str:
+    # world is sanitized ([A-Za-z][A-Za-z0-9_]*), suffix is an int from
+    # our own navigation table — both safe as identifiers.
+    return f"w_{world}__t_{suffix}"
+
+
+class SqliteRecordStore(RecordStore):
+    def __init__(self, path: str, config):
+        self._path = path or ":memory:"
+        self._math = RegionMath(config)
+        cache = config.db_cache_size
+        self._table_cache = LruCache(cache)
+        self._region_cache = LruCache(cache)
+        self._conn: sqlite3.Connection | None = None
+        self._lock = asyncio.Lock()
+
+    # region: lifecycle
+
+    async def init(self) -> None:
+        def _open():
+            conn = sqlite3.connect(self._path, check_same_thread=False)
+            conn.execute("PRAGMA journal_mode=WAL")
+            for ddl in _NAV_DDL:
+                conn.execute(ddl)
+            conn.commit()
+            return conn
+
+        self._conn = await asyncio.to_thread(_open)
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            conn, self._conn = self._conn, None
+            await asyncio.to_thread(conn.close)
+
+    # endregion
+
+    # region: navigation (lookup-or-insert, LRU-cached; navigation.rs:15-168)
+
+    def _lookup_table_suffix(self, conn, world: str, table: tuple) -> int:
+        key = (world, table)
+        hit = self._table_cache.get(key)
+        if hit is not None:
+            return hit
+        row = conn.execute(
+            "SELECT table_suffix FROM navigation_tables "
+            "WHERE world_name=? AND tx=? AND ty=? AND tz=?",
+            (world, *table),
+        ).fetchone()
+        if row is None:
+            cur = conn.execute(
+                "INSERT INTO navigation_tables (world_name, tx, ty, tz) "
+                "VALUES (?,?,?,?)",
+                (world, *table),
+            )
+            suffix = cur.lastrowid
+        else:
+            suffix = row[0]
+        self._table_cache.put(key, suffix)
+        return suffix
+
+    def _lookup_region_id(self, conn, world: str, region: tuple) -> int:
+        key = (world, region)
+        hit = self._region_cache.get(key)
+        if hit is not None:
+            return hit
+        row = conn.execute(
+            "SELECT region_id FROM navigation_regions "
+            "WHERE world_name=? AND rx=? AND ry=? AND rz=?",
+            (world, *region),
+        ).fetchone()
+        if row is None:
+            cur = conn.execute(
+                "INSERT INTO navigation_regions (world_name, rx, ry, rz) "
+                "VALUES (?,?,?,?)",
+                (world, *region),
+            )
+            region_id = cur.lastrowid
+        else:
+            region_id = row[0]
+        self._region_cache.put(key, region_id)
+        return region_id
+
+    def _lookup_ids(self, conn, world: str, position: Vector3) -> tuple[int, int]:
+        region = self._math.region_of(position)
+        suffix = self._lookup_table_suffix(conn, world, self._math.table_of(region))
+        region_id = self._lookup_region_id(conn, world, region)
+        return suffix, region_id
+
+    # endregion
+
+    # region: data tables (lazy DDL on missing table; client.rs:178-225)
+
+    def _create_data_table(self, conn, table: str) -> None:
+        conn.execute(
+            f"""CREATE TABLE IF NOT EXISTS {table} (
+                last_modified REAL NOT NULL,
+                region_id INTEGER NOT NULL,
+                x REAL NOT NULL, y REAL NOT NULL, z REAL NOT NULL,
+                uuid TEXT NOT NULL,
+                data TEXT,
+                flex BLOB
+            )"""
+        )
+        conn.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{table}_region "
+            f"ON {table} (region_id)"
+        )
+
+    # endregion
+
+    # region: record ops
+
+    async def insert_records(self, records: list[Record]) -> int:
+        async with self._lock:
+            return await asyncio.to_thread(self._insert_sync, records)
+
+    def _insert_sync(self, records: list[Record]) -> int:
+        conn = self._conn
+        now = datetime.now(timezone.utc).timestamp()
+        # Group rows per data table, one multi-row INSERT each
+        # (client.rs:119-162).
+        table_map: dict[str, list[tuple]] = {}
+        for record in records:
+            if record.position is None:
+                logger.warning("record %s has no position, skipping", record.uuid)
+                continue
+            try:
+                world = world_key(record.world_name)
+            except Exception as exc:
+                logger.warning("record %s bad world name: %s", record.uuid, exc)
+                continue
+            suffix, region_id = self._lookup_ids(conn, world, record.position)
+            table_map.setdefault(_data_table(world, suffix), []).append((
+                now, region_id,
+                record.position.x, record.position.y, record.position.z,
+                str(record.uuid), record.data, record.flex,
+            ))
+
+        written = 0
+        for table, rows in table_map.items():
+            sql = (f"INSERT INTO {table} "
+                   "(last_modified, region_id, x, y, z, uuid, data, flex) "
+                   "VALUES (?,?,?,?,?,?,?,?)")
+            try:
+                conn.executemany(sql, rows)
+            except sqlite3.OperationalError as exc:
+                if "no such table" not in str(exc):
+                    raise
+                self._create_data_table(conn, table)
+                conn.executemany(sql, rows)
+            written += len(rows)
+        conn.commit()
+        return written
+
+    async def get_records_in_region(
+        self, world_name: str, position: Vector3, after: datetime | None = None
+    ) -> list[StoredRecord]:
+        async with self._lock:
+            return await asyncio.to_thread(
+                self._get_sync, world_name, position, after
+            )
+
+    def _get_sync(self, world_name, position, after) -> list[StoredRecord]:
+        conn = self._conn
+        world = world_key(world_name)
+        suffix, region_id = self._lookup_ids(conn, world, position)
+        conn.commit()  # persist any navigation inserts from the lookup
+        table = _data_table(world, suffix)
+        sql = (f"SELECT last_modified, x, y, z, uuid, data, flex FROM {table} "
+               "WHERE region_id=?")
+        params: list = [region_id]
+        if after is not None:
+            sql += " AND last_modified > ?"
+            params.append(after.timestamp())
+        try:
+            rows = conn.execute(sql, params).fetchall()
+        except sqlite3.OperationalError as exc:
+            if "no such table" in str(exc):
+                return []  # never-written region (client.rs:341-346)
+            raise
+        return [
+            StoredRecord(
+                timestamp=datetime.fromtimestamp(ts, timezone.utc),
+                record=Record(
+                    uuid=uuid_mod.UUID(u),
+                    position=Vector3(x, y, z),
+                    world_name=world_name,
+                    data=data,
+                    flex=flex,
+                ),
+            )
+            for ts, x, y, z, u, data, flex in rows
+        ]
+
+    async def delete_records(self, records: list[Record]) -> int:
+        async with self._lock:
+            return await asyncio.to_thread(self._delete_sync, records)
+
+    def _delete_sync(self, records: list[Record]) -> int:
+        conn = self._conn
+        deleted = 0
+        for record in records:
+            if record.position is None:
+                continue
+            try:
+                world = world_key(record.world_name)
+            except Exception as exc:
+                logger.warning("record %s bad world name: %s", record.uuid, exc)
+                continue
+            suffix, region_id = self._lookup_ids(conn, world, record.position)
+            table = _data_table(world, suffix)
+            try:
+                cur = conn.execute(
+                    f"DELETE FROM {table} WHERE uuid=? AND region_id=?",
+                    (str(record.uuid), region_id),
+                )
+                deleted += cur.rowcount
+            except sqlite3.OperationalError as exc:
+                if "no such table" not in str(exc):
+                    raise
+        conn.commit()
+        return deleted
+
+    async def dedupe_records(self, ops: list[DedupeOp]) -> int:
+        async with self._lock:
+            return await asyncio.to_thread(self._dedupe_sync, ops)
+
+    def _dedupe_sync(self, ops: list[DedupeOp]) -> int:
+        conn = self._conn
+        deleted = 0
+        for rec_uuid, keep_ts, world_name, position in ops:
+            world = world_key(world_name)
+            suffix, region_id = self._lookup_ids(conn, world, position)
+            table = _data_table(world, suffix)
+            try:
+                cur = conn.execute(
+                    f"DELETE FROM {table} "
+                    "WHERE uuid=? AND region_id=? AND last_modified < ?",
+                    (str(rec_uuid), region_id, keep_ts.timestamp()),
+                )
+                deleted += cur.rowcount
+            except sqlite3.OperationalError as exc:
+                if "no such table" not in str(exc):
+                    raise
+        conn.commit()
+        return deleted
+
+    # endregion
