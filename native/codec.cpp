@@ -1,0 +1,431 @@
+// Native WorldQL wire codec: hand-rolled FlatBuffers reader/writer for
+// the fixed WorldQLFB schema (reference: worldql_server/src/flatbuffers/
+// WorldQLFB_generated.rs; Python twin: worldql_server_tpu/protocol/codec.py).
+//
+// The reader treats input as untrusted: every load is bounds-checked
+// against the buffer (the Rust reference relies on flatbuffers verifier
+// semantics; the Python twin bounds-checks likewise). The writer emits
+// canonical back-to-front FlatBuffers with per-table vtables (no dedup —
+// slightly larger buffers, identical semantics).
+//
+// C ABI (ctypes consumer: worldql_server_tpu/protocol/native_codec.py):
+//   wql_decode(buf, len, WqlMsg* out) -> 0 ok / negative error
+//   wql_encode(const WqlMsg* in, uint8_t** out, size_t* out_len) -> 0 ok
+//   wql_buffer_free(uint8_t*)
+// Strings/bytes in WqlMsg are (pointer, length) views; on decode they
+// point into the caller's input buffer (zero-copy).
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+constexpr int32_t WQL_MAX_OBJS = 1024;  // per-message record/entity cap
+
+typedef struct {
+  const uint8_t* uuid;  int32_t uuid_len;
+  const uint8_t* world; int32_t world_len;
+  const uint8_t* data;  int32_t data_len;   // data == NULL → absent
+  const uint8_t* flex;  int32_t flex_len;   // flex == NULL → absent
+  double x, y, z;
+  uint8_t has_pos;
+} WqlObj;
+
+typedef struct {
+  uint8_t instruction;
+  uint8_t replication;
+  uint8_t has_pos;
+  double x, y, z;
+  const uint8_t* parameter; int32_t parameter_len;  // NULL → absent
+  const uint8_t* sender;    int32_t sender_len;     // NULL → absent
+  const uint8_t* world;     int32_t world_len;      // NULL → absent
+  const uint8_t* flex;      int32_t flex_len;       // NULL → absent
+  int32_t n_records;
+  int32_t n_entities;
+  WqlObj records[WQL_MAX_OBJS];
+  WqlObj entities[WQL_MAX_OBJS];
+} WqlMsg;
+
+enum {
+  WQL_OK = 0,
+  WQL_E_BOUNDS = -1,    // malformed/truncated buffer
+  WQL_E_TOO_MANY = -2,  // > WQL_MAX_OBJS records or entities
+  WQL_E_ALLOC = -3,
+};
+
+// ---------------------------------------------------------------- reader
+
+namespace {
+
+struct Reader {
+  const uint8_t* buf;
+  size_t len;
+
+  bool in(size_t pos, size_t n) const {
+    return pos <= len && n <= len - pos;
+  }
+  template <typename T>
+  bool load(size_t pos, T* out) const {
+    if (!in(pos, sizeof(T))) return false;
+    std::memcpy(out, buf + pos, sizeof(T));
+    return true;
+  }
+};
+
+// Field position for a vtable slot; 0 if absent/malformed-absent.
+static size_t field_pos(const Reader& r, size_t table, int slot, bool* err) {
+  int32_t soff;
+  if (!r.load<int32_t>(table, &soff)) { *err = true; return 0; }
+  // vtable = table - soff (soffset may be negative)
+  int64_t vt = static_cast<int64_t>(table) - soff;
+  if (vt < 0 || !r.in(static_cast<size_t>(vt), 4)) { *err = true; return 0; }
+  uint16_t vt_size;
+  if (!r.load<uint16_t>(static_cast<size_t>(vt), &vt_size)) { *err = true; return 0; }
+  size_t entry = static_cast<size_t>(vt) + 4 + 2 * static_cast<size_t>(slot);
+  if (4 + 2 * (slot + 1) > vt_size) return 0;  // slot beyond vtable → default
+  uint16_t foff;
+  if (!r.load<uint16_t>(entry, &foff)) { *err = true; return 0; }
+  if (foff == 0) return 0;
+  size_t pos = table + foff;
+  if (pos >= r.len) { *err = true; return 0; }
+  return pos;
+}
+
+// Follow a uoffset32 at pos → target position.
+static size_t indirect(const Reader& r, size_t pos, bool* err) {
+  uint32_t uoff;
+  if (!r.load<uint32_t>(pos, &uoff)) { *err = true; return 0; }
+  size_t target = pos + uoff;
+  if (target >= r.len) { *err = true; return 0; }
+  return target;
+}
+
+// String/byte-vector at slot: view into the buffer.
+static bool read_blob(const Reader& r, size_t table, int slot,
+                      const uint8_t** out, int32_t* out_len, bool* err) {
+  *out = nullptr; *out_len = 0;
+  size_t fpos = field_pos(r, table, slot, err);
+  if (*err || fpos == 0) return fpos != 0 && !*err;
+  size_t s = indirect(r, fpos, err);
+  if (*err) return false;
+  uint32_t n;
+  if (!r.load<uint32_t>(s, &n)) { *err = true; return false; }
+  if (n > r.len || !r.in(s + 4, n)) { *err = true; return false; }
+  *out = r.buf + s + 4;
+  *out_len = static_cast<int32_t>(n);
+  return true;
+}
+
+static uint8_t read_u8(const Reader& r, size_t table, int slot,
+                       uint8_t dflt, bool* err) {
+  size_t fpos = field_pos(r, table, slot, err);
+  if (*err || fpos == 0) return dflt;
+  uint8_t v;
+  if (!r.load<uint8_t>(fpos, &v)) { *err = true; return dflt; }
+  return v;
+}
+
+static bool read_vec3(const Reader& r, size_t table, int slot,
+                      double* x, double* y, double* z, bool* err) {
+  size_t fpos = field_pos(r, table, slot, err);
+  if (*err || fpos == 0) return false;
+  double v[3];
+  if (!r.in(fpos, 24)) { *err = true; return false; }
+  std::memcpy(v, r.buf + fpos, 24);
+  *x = v[0]; *y = v[1]; *z = v[2];
+  return true;
+}
+
+enum { OBJ_UUID = 0, OBJ_POSITION = 1, OBJ_WORLD = 2, OBJ_DATA = 3,
+       OBJ_FLEX = 4 };
+enum { MSG_INSTRUCTION = 0, MSG_PARAMETER = 1, MSG_SENDER = 2,
+       MSG_WORLD = 3, MSG_REPLICATION = 4, MSG_RECORDS = 5,
+       MSG_ENTITIES = 6, MSG_POSITION = 7, MSG_FLEX = 8 };
+
+static bool read_obj(const Reader& r, size_t table, WqlObj* o, bool* err) {
+  std::memset(o, 0, sizeof(WqlObj));
+  read_blob(r, table, OBJ_UUID, &o->uuid, &o->uuid_len, err);
+  if (*err) return false;
+  read_blob(r, table, OBJ_WORLD, &o->world, &o->world_len, err);
+  if (*err) return false;
+  read_blob(r, table, OBJ_DATA, &o->data, &o->data_len, err);
+  if (*err) return false;
+  read_blob(r, table, OBJ_FLEX, &o->flex, &o->flex_len, err);
+  if (*err) return false;
+  o->has_pos = read_vec3(r, table, OBJ_POSITION, &o->x, &o->y, &o->z, err)
+                   ? 1 : 0;
+  return !*err;
+}
+
+static int read_obj_vector(const Reader& r, size_t table, int slot,
+                           WqlObj* out, int32_t* out_n, bool* err) {
+  *out_n = 0;
+  size_t fpos = field_pos(r, table, slot, err);
+  if (*err) return WQL_E_BOUNDS;
+  if (fpos == 0) return WQL_OK;
+  size_t vec = indirect(r, fpos, err);
+  if (*err) return WQL_E_BOUNDS;
+  uint32_t n;
+  if (!r.load<uint32_t>(vec, &n)) return WQL_E_BOUNDS;
+  if (n > WQL_MAX_OBJS) return WQL_E_TOO_MANY;
+  if (!r.in(vec + 4, static_cast<size_t>(n) * 4)) return WQL_E_BOUNDS;
+  for (uint32_t i = 0; i < n; i++) {
+    size_t t = indirect(r, vec + 4 + 4 * i, err);
+    if (*err) return WQL_E_BOUNDS;
+    if (!read_obj(r, t, &out[i], err)) return WQL_E_BOUNDS;
+  }
+  *out_n = static_cast<int32_t>(n);
+  return WQL_OK;
+}
+
+}  // namespace
+
+extern "C" int wql_decode(const uint8_t* buf, size_t len, WqlMsg* out) {
+  Reader r{buf, len};
+  bool err = false;
+  std::memset(out, 0, offsetof(WqlMsg, records));
+  out->n_records = 0;
+  out->n_entities = 0;
+
+  uint32_t root_off;
+  if (!r.load<uint32_t>(0, &root_off) || root_off >= len) return WQL_E_BOUNDS;
+  size_t root = root_off;
+
+  out->instruction = read_u8(r, root, MSG_INSTRUCTION, 0, &err);
+  if (err) return WQL_E_BOUNDS;
+  out->replication = read_u8(r, root, MSG_REPLICATION, 0, &err);
+  if (err) return WQL_E_BOUNDS;
+  read_blob(r, root, MSG_PARAMETER, &out->parameter, &out->parameter_len, &err);
+  if (err) return WQL_E_BOUNDS;
+  read_blob(r, root, MSG_SENDER, &out->sender, &out->sender_len, &err);
+  if (err) return WQL_E_BOUNDS;
+  read_blob(r, root, MSG_WORLD, &out->world, &out->world_len, &err);
+  if (err) return WQL_E_BOUNDS;
+  read_blob(r, root, MSG_FLEX, &out->flex, &out->flex_len, &err);
+  if (err) return WQL_E_BOUNDS;
+  out->has_pos = read_vec3(r, root, MSG_POSITION, &out->x, &out->y, &out->z,
+                           &err) ? 1 : 0;
+  if (err) return WQL_E_BOUNDS;
+
+  int rc = read_obj_vector(r, root, MSG_RECORDS, out->records,
+                           &out->n_records, &err);
+  if (rc != WQL_OK || err) return rc != WQL_OK ? rc : WQL_E_BOUNDS;
+  rc = read_obj_vector(r, root, MSG_ENTITIES, out->entities,
+                       &out->n_entities, &err);
+  if (rc != WQL_OK || err) return rc != WQL_OK ? rc : WQL_E_BOUNDS;
+  return WQL_OK;
+}
+
+// ---------------------------------------------------------------- writer
+
+namespace {
+
+// Back-to-front FlatBuffers builder: offsets are measured from the END
+// of the storage; final buffer is the tail slice.
+struct Builder {
+  std::vector<uint8_t> store;
+  size_t head;       // index of first used byte
+  size_t minalign = 1;
+
+  explicit Builder(size_t cap = 1024) : store(cap), head(cap) {}
+
+  size_t offset() const { return store.size() - head; }
+
+  void grow(size_t need) {
+    if (head >= need) return;
+    size_t old_size = store.size();
+    size_t new_size = old_size * 2;
+    while (new_size - old_size + head < need) new_size *= 2;
+    std::vector<uint8_t> bigger(new_size);
+    std::memcpy(bigger.data() + (new_size - old_size), store.data(), old_size);
+    head += new_size - old_size;
+    store.swap(bigger);
+  }
+
+  void pad(size_t n) {
+    grow(n);
+    head -= n;
+    std::memset(store.data() + head, 0, n);
+  }
+
+  // Align so that after writing `size` bytes, offset() % align == 0.
+  void prep(size_t align, size_t extra) {
+    if (align > minalign) minalign = align;
+    size_t align_size = ((~(offset() + extra)) + 1) & (align - 1);
+    pad(align_size);
+  }
+
+  void push(const void* src, size_t n) {
+    grow(n);
+    head -= n;
+    std::memcpy(store.data() + head, src, n);
+  }
+
+  template <typename T>
+  void push_scalar(T v) { push(&v, sizeof(T)); }
+
+  // uoffset32 referencing an object at `target` (offset-from-end).
+  void push_uoffset(size_t target) {
+    prep(4, 0);
+    uint32_t v = static_cast<uint32_t>(offset() + 4 - target);
+    push_scalar<uint32_t>(v);
+  }
+
+  size_t create_blob(const uint8_t* data, size_t n, bool nul) {
+    if (nul) { prep(4, n + 1); uint8_t z = 0; push(&z, 1); }
+    else     { prep(4, n); }
+    push(data, n);
+    push_scalar<uint32_t>(static_cast<uint32_t>(n));
+    return offset();
+  }
+
+  size_t create_vec3(double x, double y, double z) {
+    prep(8, 24);
+    double v[3] = {x, y, z};
+    push(v, 24);
+    return offset();
+  }
+};
+
+struct TableBuilder {
+  Builder& b;
+  size_t start;                     // offset() at StartTable
+  uint16_t slots[16] = {0};         // field offset-from-end per slot
+  int max_slot = -1;
+  size_t slot_off[16] = {0};
+
+  explicit TableBuilder(Builder& b_) : b(b_), start(b_.offset()) {}
+
+  void track(int slot) {
+    slot_off[slot] = b.offset();
+    if (slot > max_slot) max_slot = slot;
+  }
+
+  void field_u8(int slot, uint8_t v, uint8_t dflt) {
+    if (v == dflt) return;
+    b.prep(1, 0);
+    b.push_scalar<uint8_t>(v);
+    track(slot);
+  }
+
+  void field_uoffset(int slot, size_t target) {
+    b.push_uoffset(target);
+    track(slot);
+  }
+
+  void field_struct(int slot, size_t target) {
+    // Structs are written immediately before; they must be inline at
+    // the field position (flatbuffers invariant).
+    (void)target;
+    track(slot);
+  }
+
+  size_t end() {
+    // soffset placeholder
+    b.prep(4, 0);
+    b.push_scalar<int32_t>(0);
+    size_t table_start = b.offset();
+
+    int n_slots = max_slot + 1;
+    uint16_t vt_size = static_cast<uint16_t>(4 + 2 * n_slots);
+    uint16_t tbl_size = static_cast<uint16_t>(table_start - start);
+
+    // vtable entries, last slot first
+    for (int i = n_slots - 1; i >= 0; i--) {
+      uint16_t entry = slot_off[i]
+          ? static_cast<uint16_t>(table_start - slot_off[i]) : 0;
+      b.push_scalar<uint16_t>(entry);
+    }
+    b.push_scalar<uint16_t>(tbl_size);
+    b.push_scalar<uint16_t>(vt_size);
+    size_t vt = b.offset();
+
+    // patch soffset: vtable relative to table
+    int32_t soff = static_cast<int32_t>(vt - table_start);
+    size_t table_pos = b.store.size() - table_start;
+    std::memcpy(b.store.data() + table_pos, &soff, 4);
+    return table_start;
+  }
+};
+
+static size_t write_obj(Builder& b, const WqlObj* o) {
+  size_t uuid_off = b.create_blob(o->uuid, o->uuid_len, true);
+  size_t world_off = b.create_blob(o->world, o->world_len, true);
+  size_t data_off = o->data ? b.create_blob(o->data, o->data_len, true) : 0;
+  size_t flex_off = o->flex ? b.create_blob(o->flex, o->flex_len, false) : 0;
+
+  TableBuilder t(b);
+  t.field_uoffset(OBJ_UUID, uuid_off);
+  if (o->has_pos) {
+    b.create_vec3(o->x, o->y, o->z);
+    t.field_struct(OBJ_POSITION, 0);
+  }
+  t.field_uoffset(OBJ_WORLD, world_off);
+  if (data_off) t.field_uoffset(OBJ_DATA, data_off);
+  if (flex_off) t.field_uoffset(OBJ_FLEX, flex_off);
+  return t.end();
+}
+
+static size_t write_obj_vector(Builder& b, const WqlObj* objs, int32_t n) {
+  std::vector<size_t> offs(n);
+  for (int32_t i = 0; i < n; i++) offs[i] = write_obj(b, &objs[i]);
+  b.prep(4, static_cast<size_t>(n) * 4);
+  for (int32_t i = n - 1; i >= 0; i--) b.push_uoffset(offs[i]);
+  b.push_scalar<uint32_t>(static_cast<uint32_t>(n));
+  return b.offset();
+}
+
+}  // namespace
+
+extern "C" int wql_encode(const WqlMsg* in, uint8_t** out, size_t* out_len) {
+  if (in->n_records > WQL_MAX_OBJS || in->n_entities > WQL_MAX_OBJS)
+    return WQL_E_TOO_MANY;
+  Builder b(1024);
+
+  size_t records_vec = in->n_records
+      ? write_obj_vector(b, in->records, in->n_records) : 0;
+  size_t entities_vec = in->n_entities
+      ? write_obj_vector(b, in->entities, in->n_entities) : 0;
+
+  size_t param_off = in->parameter
+      ? b.create_blob(in->parameter, in->parameter_len, true) : 0;
+  size_t sender_off = in->sender
+      ? b.create_blob(in->sender, in->sender_len, true) : 0;
+  size_t world_off = in->world
+      ? b.create_blob(in->world, in->world_len, true) : 0;
+  size_t flex_off = in->flex
+      ? b.create_blob(in->flex, in->flex_len, false) : 0;
+
+  TableBuilder t(b);
+  t.field_u8(MSG_INSTRUCTION, in->instruction, 0);
+  if (param_off) t.field_uoffset(MSG_PARAMETER, param_off);
+  if (sender_off) t.field_uoffset(MSG_SENDER, sender_off);
+  if (world_off) t.field_uoffset(MSG_WORLD, world_off);
+  t.field_u8(MSG_REPLICATION, in->replication, 0);
+  if (records_vec) t.field_uoffset(MSG_RECORDS, records_vec);
+  if (entities_vec) t.field_uoffset(MSG_ENTITIES, entities_vec);
+  if (in->has_pos) {
+    b.create_vec3(in->x, in->y, in->z);
+    t.field_struct(MSG_POSITION, 0);
+  }
+  if (flex_off) t.field_uoffset(MSG_FLEX, flex_off);
+  size_t root = t.end();
+
+  // root uoffset, padded to minalign
+  b.prep(std::max<size_t>(b.minalign, 4), 4);
+  b.push_uoffset(root);
+
+  size_t n = b.offset();
+  uint8_t* mem = static_cast<uint8_t*>(std::malloc(n));
+  if (!mem) return WQL_E_ALLOC;
+  std::memcpy(mem, b.store.data() + b.head, n);
+  *out = mem;
+  *out_len = n;
+  return WQL_OK;
+}
+
+extern "C" void wql_buffer_free(uint8_t* p) { std::free(p); }
+
+extern "C" int wql_max_objs(void) { return WQL_MAX_OBJS; }

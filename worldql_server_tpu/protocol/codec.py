@@ -262,3 +262,29 @@ def deserialize_message(buf: bytes | bytearray | memoryview) -> Message:
 
 
 # endregion
+
+# region: native dispatch
+
+# Pure-Python implementations stay importable for tests and fallback.
+py_serialize_message = serialize_message
+py_deserialize_message = deserialize_message
+
+from . import native_codec as _native_codec  # noqa: E402
+
+_native = _native_codec.load()
+
+if _native is not None:
+
+    def serialize_message(message: Message) -> bytes:  # noqa: F811
+        try:
+            return _native.encode(message)
+        except _native_codec._TooManyObjects:
+            return py_serialize_message(message)
+
+    def deserialize_message(buf: bytes | bytearray | memoryview) -> Message:  # noqa: F811
+        try:
+            return _native.decode(bytes(buf), DeserializeError)
+        except _native_codec._TooManyObjects:
+            return py_deserialize_message(bytes(buf))
+
+# endregion

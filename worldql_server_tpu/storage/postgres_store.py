@@ -15,6 +15,7 @@ kept driver-thin behind ``_exec``/``_fetch`` so either driver slots in.
 from __future__ import annotations
 
 import logging
+import re
 import uuid as uuid_mod
 from datetime import datetime, timezone
 
@@ -62,6 +63,13 @@ _NAV_DDL = (
 
 UNDEFINED_TABLE = "42P01"
 
+_PLACEHOLDER = re.compile(r"\$\d+")
+
+
+def _psycopg_placeholders(sql: str) -> str:
+    """asyncpg-style $N params → psycopg %s (positional order matches)."""
+    return _PLACEHOLDER.sub("%s", sql)
+
 
 class PostgresRecordStore(RecordStore):
     def __init__(self, url: str, config):
@@ -98,21 +106,14 @@ class PostgresRecordStore(RecordStore):
         if self._driver_name == "asyncpg":
             return await self._conn.execute(sql, *params)
         async with self._conn.cursor() as cur:
-            await cur.execute(sql.replace("$1", "%s").replace("$2", "%s")
-                              .replace("$3", "%s").replace("$4", "%s")
-                              .replace("$5", "%s").replace("$6", "%s")
-                              .replace("$7", "%s").replace("$8", "%s"),
-                              params)
+            await cur.execute(_psycopg_placeholders(sql), params)
             return str(cur.rowcount)
 
     async def _fetch(self, sql: str, *params) -> list:
         if self._driver_name == "asyncpg":
             return await self._conn.fetch(sql, *params)
         async with self._conn.cursor() as cur:
-            await cur.execute(sql.replace("$1", "%s").replace("$2", "%s")
-                              .replace("$3", "%s").replace("$4", "%s")
-                              .replace("$5", "%s").replace("$6", "%s"),
-                              params)
+            await cur.execute(_psycopg_placeholders(sql), params)
             return await cur.fetchall()
 
     def _is_undefined_table(self, exc: Exception) -> bool:

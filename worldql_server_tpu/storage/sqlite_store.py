@@ -52,7 +52,12 @@ def _data_table(world: str, suffix: int) -> str:
 
 class SqliteRecordStore(RecordStore):
     def __init__(self, path: str, config):
-        self._path = path or ":memory:"
+        if not path:
+            raise ValueError(
+                "sqlite:// needs a path (sqlite://records.db); use "
+                "memory:// for a non-persistent store"
+            )
+        self._path = path
         self._math = RegionMath(config)
         cache = config.db_cache_size
         self._table_cache = LruCache(cache)
