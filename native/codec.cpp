@@ -292,9 +292,8 @@ struct Builder {
 struct TableBuilder {
   Builder& b;
   size_t start;                     // offset() at StartTable
-  uint16_t slots[16] = {0};         // field offset-from-end per slot
   int max_slot = -1;
-  size_t slot_off[16] = {0};
+  size_t slot_off[16] = {0};        // field offset-from-end per slot
 
   explicit TableBuilder(Builder& b_) : b(b_), start(b_.offset()) {}
 

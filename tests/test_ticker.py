@@ -1,0 +1,158 @@
+"""Tick-batched LocalMessage routing (engine/ticker.py)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.peers import Peer, PeerMap
+from worldql_server_tpu.engine.router import Router
+from worldql_server_tpu.engine.ticker import TickBatcher
+from worldql_server_tpu.protocol import deserialize_message
+from worldql_server_tpu.protocol.types import Instruction, Message, Vector3
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Harness:
+    def __init__(self, backend_cls, interval=0.03, max_batch=16_384):
+        config = Config()
+        self.backend = backend_cls(config.sub_region_size)
+        self.store = MemoryRecordStore(config)
+        self.peer_map = PeerMap(on_remove=self.backend.remove_peer)
+        self.ticker = TickBatcher(
+            self.backend, self.peer_map, interval, max_batch=max_batch
+        )
+        self.router = Router(
+            self.peer_map, self.backend, self.store, ticker=self.ticker
+        )
+        self.inboxes: dict[uuid.UUID, list[Message]] = {}
+
+    async def add_peer(self) -> uuid.UUID:
+        peer_uuid = uuid.uuid4()
+        inbox: list[Message] = []
+        self.inboxes[peer_uuid] = inbox
+
+        async def send_raw(data: bytes) -> None:
+            inbox.append(deserialize_message(data))
+
+        await self.peer_map.insert(Peer(peer_uuid, "loopback", send_raw, "test"))
+        return peer_uuid
+
+    def locals_for(self, peer_uuid):
+        return [
+            m for m in self.inboxes[peer_uuid]
+            if m.instruction == Instruction.LOCAL_MESSAGE
+        ]
+
+    async def subscribe(self, peer, pos):
+        await self.router.handle_message(Message(
+            instruction=Instruction.AREA_SUBSCRIBE, sender_uuid=peer,
+            world_name="world", position=pos,
+        ))
+
+    async def local(self, sender, pos, parameter=None):
+        await self.router.handle_message(Message(
+            instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+            world_name="world", position=pos, parameter=parameter,
+        ))
+
+
+@pytest.mark.parametrize("backend_cls", [CpuSpatialBackend, TpuSpatialBackend])
+def test_messages_deliver_on_tick_not_immediately(backend_cls):
+    async def scenario():
+        h = Harness(backend_cls)
+        h.ticker.start()
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+
+        await h.local(a, pos, "m1")
+        await h.local(a, pos, "m2")
+        assert h.locals_for(b) == []  # queued, not resolved yet
+
+        # > interval; generous ceiling for first-use jit compile
+        for _ in range(600):
+            await asyncio.sleep(0.05)
+            if len(h.locals_for(b)) >= 2:
+                break
+        got = h.locals_for(b)
+        assert [m.parameter for m in got] == ["m1", "m2"]  # arrival order
+        assert h.locals_for(a) == []  # EXCEPT_SELF
+        assert h.ticker.ticks >= 1
+        assert h.ticker.messages == 2
+        await h.ticker.stop()
+
+    run(scenario())
+
+
+def test_size_cap_flushes_early():
+    async def scenario():
+        h = Harness(TpuSpatialBackend, interval=60.0, max_batch=3)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+
+        for i in range(3):  # hits max_batch → immediate flush, no timer
+            await h.local(a, pos, f"m{i}")
+        assert [m.parameter for m in h.locals_for(b)] == ["m0", "m1", "m2"]
+
+    run(scenario())
+
+
+def test_stop_drains_queue():
+    async def scenario():
+        h = Harness(TpuSpatialBackend, interval=60.0)
+        h.ticker.start()
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "pending")
+        assert h.locals_for(b) == []
+        await h.ticker.stop()  # cancel timer, drain queue
+        assert [m.parameter for m in h.locals_for(b)] == ["pending"]
+
+    run(scenario())
+
+
+def test_mutations_between_ticks_apply_before_flush():
+    async def scenario():
+        h = Harness(TpuSpatialBackend, interval=60.0)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.local(a, pos, "m")  # b not subscribed yet
+        await h.subscribe(b, pos)   # subscribe lands before the flush
+        await h.ticker.flush()
+        assert [m.parameter for m in h.locals_for(b)] == ["m"]
+
+    run(scenario())
+
+
+def test_sender_disconnect_before_flush_is_safe():
+    async def scenario():
+        h = Harness(TpuSpatialBackend, interval=60.0)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "m")
+        await h.peer_map.remove(b)  # target vanishes pre-flush
+        await h.ticker.flush()      # must not raise
+        assert h.locals_for(a) == []
+
+    run(scenario())

@@ -37,10 +37,14 @@ class Router:
         peer_map: PeerMap,
         backend: SpatialBackend,
         store: RecordStore,
+        ticker=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
         self.store = store
+        # Optional TickBatcher: LocalMessages queue for a per-tick device
+        # batch instead of resolving immediately (engine/ticker.py).
+        self.ticker = ticker
 
     async def handle_message(self, message: Message) -> None:
         """Route one inbound message (thread.rs:72-108). Never raises."""
@@ -169,16 +173,16 @@ class Router:
         if world is None:
             return
 
-        [targets] = self.backend.match_local_batch(
-            [
-                LocalQuery(
-                    world=world,
-                    position=message.position,
-                    sender=message.sender_uuid,
-                    replication=message.replication,
-                )
-            ]
+        query = LocalQuery(
+            world=world,
+            position=message.position,
+            sender=message.sender_uuid,
+            replication=message.replication,
         )
+        if self.ticker is not None:
+            await self.ticker.enqueue(message, query)
+            return
+        [targets] = self.backend.match_local_batch([query])
         if targets:
             await self.peer_map.broadcast_to(message, targets)
 

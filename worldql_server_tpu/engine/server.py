@@ -45,7 +45,16 @@ class WorldQLServer:
             config.store_url, config
         )
         self.peer_map = PeerMap(on_remove=self._on_peer_remove)
-        self.router = Router(self.peer_map, self.backend, self.store)
+        self.ticker = None
+        if config.tick_interval > 0:
+            from .ticker import TickBatcher
+
+            self.ticker = TickBatcher(
+                self.backend, self.peer_map, config.tick_interval
+            )
+        self.router = Router(
+            self.peer_map, self.backend, self.store, ticker=self.ticker
+        )
         self._tasks: list[asyncio.Task] = []
         self._transports: list = []
         self._started = asyncio.Event()
@@ -89,6 +98,9 @@ class WorldQLServer:
                 asyncio.create_task(self._staleness_sweeper(), name="stale-sweep")
             )
 
+        if self.ticker is not None:
+            self.ticker.start()
+
         self._started.set()
         logger.info("worldql-server-tpu started")
 
@@ -103,6 +115,8 @@ class WorldQLServer:
                 await self.peer_map.remove(uuid)
 
     async def stop(self) -> None:
+        if self.ticker is not None:
+            await self.ticker.stop()
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:

@@ -1,0 +1,108 @@
+"""Tick batcher: the per-tick device batch at the heart of the rebuild.
+
+The reference resolves every LocalMessage the moment it arrives — one
+HashMap probe and one broadcast per message under a global lock
+(SURVEY §3.2). With ``tick_interval > 0`` this module instead collects
+a tick's worth of LocalMessages and resolves them as ONE device batch
+(SpatialBackend.dispatch/collect), then delivers each message's fan-out
+in arrival order. Trade: up to one tick of added latency buys
+per-batch instead of per-message device cost — the design the
+1M-entity target requires (BASELINE.json north star).
+
+Overlap: the dispatch (which reads loop-owned state) runs on the event
+loop; the device wait + UUID decode run on a worker thread, so the loop
+keeps serving transports while the device crunches. A full queue
+(``max_batch``) flushes early. ``tick_interval == 0`` keeps the
+reference-equivalent immediate path and never constructs this class.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..spatial.backend import LocalQuery, SpatialBackend
+from ..protocol.types import Message
+from .peers import PeerMap
+
+logger = logging.getLogger(__name__)
+
+
+class TickBatcher:
+    def __init__(
+        self,
+        backend: SpatialBackend,
+        peer_map: PeerMap,
+        interval: float,
+        max_batch: int = 16_384,
+    ):
+        self.backend = backend
+        self.peer_map = peer_map
+        self.interval = interval
+        self.max_batch = max_batch
+        self._queue: list[tuple[Message, LocalQuery]] = []
+        self._task: asyncio.Task | None = None
+        self._flushing = asyncio.Lock()
+        # stats (exposed via metrics)
+        self.ticks = 0
+        self.messages = 0
+        self.last_batch = 0
+        self.last_tick_ms = 0.0
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="tick-batcher")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.flush()  # drain whatever is left
+
+    async def enqueue(self, message: Message, query: LocalQuery) -> None:
+        self._queue.append((message, query))
+        if len(self._queue) >= self.max_batch:
+            await self.flush()
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self.flush()
+            except Exception:
+                logger.exception("tick flush failed — batch dropped")
+
+    async def flush(self) -> None:
+        """Resolve and deliver everything queued so far. Serialized so a
+        size-triggered flush can't interleave with the timer's."""
+        async with self._flushing:
+            batch, self._queue = self._queue, []
+            if not batch:
+                return
+            t0 = time.perf_counter()
+
+            try:
+                handle = self.backend.dispatch_local_batch(
+                    [query for _, query in batch]
+                )
+                targets = await asyncio.to_thread(
+                    self.backend.collect_local_batch, handle
+                )
+
+                for (message, _), tgts in zip(batch, targets):
+                    if tgts:
+                        await self.peer_map.broadcast_to(message, tgts)
+            except asyncio.CancelledError:
+                # stop() cancelled the timer mid-flush: put the batch
+                # back so the drain flush delivers it.
+                self._queue = batch + self._queue
+                raise
+
+            self.ticks += 1
+            self.messages += len(batch)
+            self.last_batch = len(batch)
+            self.last_tick_ms = (time.perf_counter() - t0) * 1e3

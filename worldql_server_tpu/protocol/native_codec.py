@@ -253,7 +253,9 @@ def load() -> NativeCodec | None:
         return None
     try:
         codec = NativeCodec(ctypes.CDLL(str(_LIB_PATH)))
-    except OSError as exc:
+    except (OSError, AttributeError) as exc:
+        # AttributeError: a stale .so missing a symbol — fall back, the
+        # server must not die on a leftover build artifact.
         logger.warning("native codec failed to load: %s", exc)
         return None
     # The ctypes struct layout bakes in MAX_OBJS; a library built with a

@@ -121,6 +121,16 @@ class SpatialBackend(abc.ABC):
         """Make all prior mutations visible to queries. No-op for
         immediate-mode backends; device-mirror backends sync here."""
 
+    # Two-phase batch API for the tick batcher: ``dispatch`` runs on the
+    # owning thread (may read mutable host state), ``collect`` only
+    # waits for results and may run on a worker thread. Immediate-mode
+    # backends resolve everything in dispatch.
+    def dispatch_local_batch(self, queries: Sequence[LocalQuery]):
+        return self.match_local_batch(queries)
+
+    def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
+        return handle
+
     # endregion
 
 

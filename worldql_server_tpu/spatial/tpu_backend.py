@@ -375,9 +375,19 @@ class TpuSpatialBackend(CpuSpatialBackend):
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
     ) -> list[list[uuid_mod.UUID]]:
+        return self.collect_local_batch(self.dispatch_local_batch(queries))
+
+    def dispatch_local_batch(self, queries: Sequence[LocalQuery]):
+        """Encode + launch a query batch without waiting for results.
+
+        Runs on the owning (event-loop) thread — it reads the interning
+        dicts, which mutate there. The returned handle goes to
+        ``collect_local_batch``, which only blocks on the device and may
+        safely run on a worker thread (tick batcher overlap).
+        """
         m = len(queries)
         if m == 0:
-            return []
+            return (0, None)
         world_ids = np.fromiter(
             (self._world_ids.get(q.world, -1) for q in queries),
             dtype=np.int32, count=m,
@@ -392,8 +402,16 @@ class TpuSpatialBackend(CpuSpatialBackend):
         repls = np.fromiter(
             (int(q.replication) for q in queries), dtype=np.int8, count=m
         )
+        return self.match_arrays_async(world_ids, positions, sender_ids, repls)
 
-        tgt = self.match_arrays(world_ids, positions, sender_ids, repls)
+    def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
+        """Wait for a dispatched batch and decode fan-out UUID lists.
+        Thread-safe against concurrent interning: peer ids are
+        append-only, so index reads stay valid."""
+        m, result = handle
+        if result is None:
+            return [[] for _ in range(m)]
+        tgt = np.asarray(result)[:m]
 
         mask = tgt >= 0
         counts = mask.sum(axis=1)
