@@ -1,0 +1,128 @@
+"""Metrics registry + /metrics endpoint."""
+
+import asyncio
+import json
+import urllib.request
+import uuid
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.metrics import Histogram, Metrics
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.protocol.types import Instruction, Message, Vector3
+
+from client_util import WsClient, free_port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_histogram_quantiles():
+    h = Histogram()
+    for v in (0.1, 0.3, 0.9, 4.0, 90.0):
+        h.observe_ms(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert abs(snap["mean_ms"] - (0.1 + 0.3 + 0.9 + 4.0 + 90.0) / 5) < 1e-9
+    assert snap["p50_ms"] <= 2.5  # bucket upper bound containing 0.9
+    assert snap["p99_ms"] >= 90.0
+
+
+def test_histogram_overflow_bucket():
+    h = Histogram()
+    h.observe_ms(10_000.0)
+    assert h.quantile(0.5) == float("inf")
+
+
+def test_counters_and_gauges():
+    m = Metrics()
+    m.inc("a")
+    m.inc("a", 2)
+    m.gauge("g", lambda: 7)
+    m.gauge("bad", lambda: 1 / 0)
+    snap = m.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 7
+    assert str(snap["gauges"]["bad"]).startswith("error")
+
+
+def test_server_metrics_endpoint():
+    async def scenario():
+        ws_port, http_port = free_port(), free_port()
+        server = WorldQLServer(Config(
+            ws_port=ws_port, http_port=http_port, zmq_enabled=False,
+            store_url="memory://", tick_interval=0.02,
+        ))
+        await server.start()
+        try:
+            a = await WsClient.connect(ws_port)
+            b = await WsClient.connect(ws_port)
+            pos = Vector3(1, 1, 1)
+            for c in (a, b):
+                await c.send(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE, sender_uuid=c.uuid,
+                    world_name="world", position=pos,
+                ))
+            await a.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, sender_uuid=a.uuid,
+                world_name="world", position=pos, parameter="x",
+            ))
+            await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=30)
+
+            def fetch():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/metrics"
+                ) as resp:
+                    return json.loads(resp.read())
+
+            snap = await asyncio.to_thread(fetch)
+            assert snap["counters"]["messages.area_subscribe"] == 2
+            assert snap["counters"]["messages.local_message"] == 1
+            assert snap["counters"]["tick.messages"] == 1
+            assert snap["gauges"]["peers"] == 2
+            assert snap["gauges"]["subscriptions"] == 2
+            assert snap["latency"]["tick.flush_ms"]["count"] >= 1
+            assert snap["gauges"]["tick"]["last_batch"] == 1
+
+            def health():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthz"
+                ) as resp:
+                    return json.loads(resp.read())
+
+            assert (await asyncio.to_thread(health)) == {"status": "ok"}
+            await a.close()
+            await b.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_metrics_endpoint_requires_auth_token():
+    async def scenario():
+        ws_port, http_port = free_port(), free_port()
+        server = WorldQLServer(Config(
+            ws_port=ws_port, http_port=http_port, zmq_enabled=False,
+            store_url="memory://", http_auth_token="sekrit",
+        ))
+        await server.start()
+        try:
+            def fetch(headers):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/metrics", headers=headers
+                )
+                try:
+                    with urllib.request.urlopen(req) as resp:
+                        return resp.status
+                except urllib.error.HTTPError as exc:
+                    return exc.code
+
+            assert await asyncio.to_thread(fetch, {}) == 401
+            assert await asyncio.to_thread(
+                fetch, {"Authorization": "Bearer sekrit"}
+            ) == 200
+        finally:
+            await server.stop()
+
+    run(scenario())

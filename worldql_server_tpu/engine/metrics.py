@@ -1,0 +1,109 @@
+"""Metrics registry: counters, latency histograms, and gauges.
+
+The reference's observability is log lines only — no counters, no
+health endpoint (SURVEY §5 "Metrics/logging/observability: logging
+only"). The rebuild's contract is structured per-tick timing and
+engine state, exposed by ``GET /metrics`` (transports/http.py) and
+importable for tests.
+
+Single-threaded by design: all writers run on the asyncio loop, so
+plain ints suffice (the tick batcher's worker thread reports through
+loop-side code). Histograms are fixed log-spaced latency buckets —
+cheap, allocation-free, good enough for p50/p99 estimates.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Callable
+
+# Bucket upper bounds in milliseconds (log-spaced), +inf implicit.
+LATENCY_BUCKETS_MS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0,
+)
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "total", "sum_ms")
+
+    def __init__(self, buckets=LATENCY_BUCKETS_MS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0
+        self.sum_ms = 0.0
+
+    def observe_ms(self, value_ms: float) -> None:
+        i = 0
+        for i, bound in enumerate(self.buckets):  # noqa: B007
+            if value_ms <= bound:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.total += 1
+        self.sum_ms += value_ms
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile from bucket counts."""
+        if self.total == 0:
+            return 0.0
+        rank = q * self.total
+        seen = 0
+        for i, count in enumerate(self.counts):
+            seen += count
+            if seen >= rank:
+                return (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else float("inf")
+                )
+        return float("inf")
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.total,
+            "mean_ms": (self.sum_ms / self.total) if self.total else 0.0,
+            "p50_ms": self.quantile(0.50),
+            "p99_ms": self.quantile(0.99),
+        }
+
+
+class Metrics:
+    """Process-wide registry; one instance per server."""
+
+    def __init__(self):
+        self.started_at = time.time()
+        self.counters: defaultdict[str, int] = defaultdict(int)
+        self.histograms: dict[str, Histogram] = {}
+        self._gauges: dict[str, Callable[[], object]] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def observe_ms(self, name: str, value_ms: float) -> None:
+        hist = self.histograms.get(name)
+        if hist is None:
+            hist = self.histograms[name] = Histogram()
+        hist.observe_ms(value_ms)
+
+    def gauge(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a pull-style gauge; evaluated at snapshot time."""
+        self._gauges[name] = fn
+
+    def snapshot(self) -> dict:
+        gauges = {}
+        for name, fn in self._gauges.items():
+            try:
+                gauges[name] = fn()
+            except Exception as exc:  # a broken gauge must not kill /metrics
+                gauges[name] = f"error: {exc}"
+        return {
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "counters": dict(self.counters),
+            "latency": {
+                name: hist.snapshot() for name, hist in self.histograms.items()
+            },
+            "gauges": gauges,
+        }

@@ -86,9 +86,10 @@ class PeerMap:
     when a peer disconnects.
     """
 
-    def __init__(self, on_remove: OnRemove | None = None):
+    def __init__(self, on_remove: OnRemove | None = None, metrics=None):
         self._map: dict[uuid_mod.UUID, Peer] = {}
         self._on_remove = on_remove
+        self.metrics = metrics
 
     # region: lookups
 
@@ -153,15 +154,23 @@ class PeerMap:
 
     async def _broadcast(self, message: Message, peers: Iterable[Peer]) -> None:
         data = serialize_message(message)
+        peers = list(peers)
         results = await asyncio.gather(
             *(p.send_raw(data) for p in peers), return_exceptions=True
         )
+        errors = 0
         for result in results:
             if isinstance(result, Exception):
+                errors += 1
                 logger.debug("broadcast error: %s", result)
+        if self.metrics is not None:
+            self.metrics.inc("broadcast.messages")
+            self.metrics.inc("broadcast.sends", len(peers) - errors)
+            if errors:
+                self.metrics.inc("broadcast.send_errors", errors)
 
     async def broadcast_all(self, message: Message) -> None:
-        await self._broadcast(message, list(self._map.values()))
+        await self._broadcast(message, self._map.values())
 
     async def broadcast_to(
         self, message: Message, uuids: Iterable[uuid_mod.UUID]

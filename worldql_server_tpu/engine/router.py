@@ -30,6 +30,9 @@ logger = logging.getLogger(__name__)
 
 NIL = uuid_mod.UUID(int=0)
 
+# Counter names precomputed: no per-message string building on the hot path.
+_MSG_COUNTERS = {i: f"messages.{i.name.lower()}" for i in Instruction}
+
 
 class Router:
     def __init__(
@@ -38,6 +41,7 @@ class Router:
         backend: SpatialBackend,
         store: RecordStore,
         ticker=None,
+        metrics=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
@@ -45,12 +49,17 @@ class Router:
         # Optional TickBatcher: LocalMessages queue for a per-tick device
         # batch instead of resolving immediately (engine/ticker.py).
         self.ticker = ticker
+        self.metrics = metrics
 
     async def handle_message(self, message: Message) -> None:
         """Route one inbound message (thread.rs:72-108). Never raises."""
+        if self.metrics is not None:
+            self.metrics.inc(_MSG_COUNTERS[message.instruction])
         try:
             await self._dispatch(message)
         except Exception:
+            if self.metrics is not None:
+                self.metrics.inc("messages.errors")
             logger.exception(
                 "error handling %s from %s — message dropped",
                 message.instruction.name,

@@ -17,6 +17,7 @@ from ..spatial.backend import SpatialBackend
 from ..spatial.cpu_backend import CpuSpatialBackend
 from ..storage.store import RecordStore, open_store
 from .config import Config
+from .metrics import Metrics
 from .peers import PeerMap
 from .router import Router
 
@@ -44,20 +45,44 @@ class WorldQLServer:
         self.store = store if store is not None else open_store(
             config.store_url, config
         )
-        self.peer_map = PeerMap(on_remove=self._on_peer_remove)
+        self.metrics = Metrics()
+        self.peer_map = PeerMap(
+            on_remove=self._on_peer_remove, metrics=self.metrics
+        )
         self.ticker = None
         if config.tick_interval > 0:
             from .ticker import TickBatcher
 
             self.ticker = TickBatcher(
-                self.backend, self.peer_map, config.tick_interval
+                self.backend, self.peer_map, config.tick_interval,
+                metrics=self.metrics,
             )
         self.router = Router(
-            self.peer_map, self.backend, self.store, ticker=self.ticker
+            self.peer_map, self.backend, self.store,
+            ticker=self.ticker, metrics=self.metrics,
         )
+        self._register_gauges()
         self._tasks: list[asyncio.Task] = []
         self._transports: list = []
         self._started = asyncio.Event()
+
+    def _register_gauges(self) -> None:
+        self.metrics.gauge("peers", self.peer_map.size)
+        self.metrics.gauge(
+            "subscriptions", self.backend.subscription_count
+            if hasattr(self.backend, "subscription_count") else lambda: None
+        )
+        if hasattr(self.backend, "device_stats"):
+            self.metrics.gauge("spatial_device", self.backend.device_stats)
+        if self.ticker is not None:
+            self.metrics.gauge(
+                "tick",
+                lambda: {
+                    "interval_s": self.ticker.interval,
+                    "last_batch": self.ticker.last_batch,
+                    "last_tick_ms": round(self.ticker.last_tick_ms, 3),
+                },
+            )
 
     def _on_peer_remove(self, uuid) -> None:
         """Disconnect cleanup: purge the spatial index (the remove_rx

@@ -36,11 +36,13 @@ class TickBatcher:
         peer_map: PeerMap,
         interval: float,
         max_batch: int = 16_384,
+        metrics=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
+        self.metrics = metrics
         self._queue: list[tuple[Message, LocalQuery]] = []
         self._task: asyncio.Task | None = None
         self._flushing = asyncio.Lock()
@@ -106,3 +108,7 @@ class TickBatcher:
             self.messages += len(batch)
             self.last_batch = len(batch)
             self.last_tick_ms = (time.perf_counter() - t0) * 1e3
+            if self.metrics is not None:
+                self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)
+                self.metrics.inc("tick.flushes")
+                self.metrics.inc("tick.messages", len(batch))

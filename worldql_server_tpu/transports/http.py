@@ -31,6 +31,10 @@ class HttpTransport:
         config = self.server.config
         app = web.Application()
         app.router.add_post("/global_message", self._post_global_message)
+        # Observability beyond the reference (SURVEY §5: it has neither
+        # a health endpoint nor metrics).
+        app.router.add_get("/healthz", self._get_healthz)
+        app.router.add_get("/metrics", self._get_metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, config.http_host, config.http_port)
@@ -44,12 +48,24 @@ class HttpTransport:
             await self._runner.cleanup()
             self._runner = None
 
-    async def _post_global_message(self, request: web.Request) -> web.Response:
+    def _authorized(self, request: web.Request) -> bool:
         token = self.server.config.http_auth_token
-        if token is not None:
-            auth = request.headers.get("Authorization", "")
-            if not auth.startswith("Bearer ") or auth[len("Bearer "):] != token:
-                return web.Response(status=401)
+        if token is None:
+            return True
+        auth = request.headers.get("Authorization", "")
+        return auth.startswith("Bearer ") and auth[len("Bearer "):] == token
+
+    async def _get_healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _get_metrics(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.Response(status=401)
+        return web.json_response(self.server.metrics.snapshot())
+
+    async def _post_global_message(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.Response(status=401)
 
         try:
             body = await request.json()
