@@ -210,7 +210,8 @@ def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
             )
         ])[0]
         got = {int(t) for t in tgt[i] if t >= 0}
-        assert got == {p.int - 1 for p in want}, f"parity diverged at query {i}"
+        want_ids = {tpu._peer_ids[p] for p in want}
+        assert got == want_ids, f"parity diverged at query {i}"
     log(f"parity check: {samples} sampled queries agree with CPU reference")
 
 

@@ -291,6 +291,47 @@ async def _durability(tmp_path):
     await s2.close()
 
 
+def test_sqlite_failed_insert_rolls_back(tmp_path):
+    """A mid-batch executemany failure must not leave partial rows (nav
+    inserts + data rows) to be committed by the next unrelated operation
+    (ADVICE r1)."""
+    run(_failed_insert_rollback(tmp_path))
+
+
+async def _failed_insert_rollback(tmp_path):
+    import sqlite3
+
+    config = make_config()
+    store = SqliteRecordStore(str(tmp_path / "rb.db"), config)
+    await store.init()
+    try:
+        real_conn = store._conn
+        calls = 0
+
+        class FlakyConn:
+            def __getattr__(self, name):
+                return getattr(real_conn, name)
+
+            def executemany(self, sql, rows):
+                nonlocal calls
+                calls += 1
+                raise sqlite3.OperationalError("disk I/O error")
+
+        store._conn = FlakyConn()
+        with pytest.raises(sqlite3.OperationalError):
+            await store.insert_records([rec(data="doomed")])
+        assert calls >= 1
+        store._conn = real_conn
+
+        # Unrelated follow-up op commits; the doomed row must not appear.
+        await store.insert_records([rec(pos=(300, 1, 1), data="ok")])
+        assert await store.get_records_in_region("world", Vector3(1, 1, 1)) == []
+        rows = await store.get_records_in_region("world", Vector3(300, 1, 1))
+        assert [sr.record.data for sr in rows] == ["ok"]
+    finally:
+        await store.close()
+
+
 def test_open_store_dispatch(tmp_path):
     config = make_config()
     assert isinstance(open_store("memory://", config), MemoryRecordStore)

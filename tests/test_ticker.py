@@ -142,6 +142,45 @@ def test_mutations_between_ticks_apply_before_flush():
     run(scenario())
 
 
+def test_cancel_mid_flush_does_not_redeliver():
+    """A stop() that lands mid-delivery re-queues only the undelivered
+    tail — already-broadcast messages must not be sent twice by the
+    drain flush (ADVICE r1)."""
+
+    async def scenario():
+        h = Harness(CpuSpatialBackend, interval=60.0)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        for i in range(4):
+            await h.local(a, pos, f"m{i}")
+
+        # Cancel the flush after two deliveries by hooking broadcast_to.
+        real_broadcast = h.peer_map.broadcast_to
+        sent = 0
+
+        async def hooked(message, targets):
+            nonlocal sent
+            await real_broadcast(message, targets)
+            sent += 1
+            if sent == 2:
+                raise asyncio.CancelledError
+
+        h.peer_map.broadcast_to = hooked
+        with pytest.raises(asyncio.CancelledError):
+            await h.ticker.flush()
+        h.peer_map.broadcast_to = real_broadcast
+
+        await h.ticker.flush()  # drain delivers only the tail
+        assert [m.parameter for m in h.locals_for(b)] == [
+            "m0", "m1", "m2", "m3"
+        ]
+
+    run(scenario())
+
+
 def test_sender_disconnect_before_flush_is_safe():
     async def scenario():
         h = Harness(TpuSpatialBackend, interval=60.0)

@@ -87,6 +87,7 @@ class TickBatcher:
                 return
             t0 = time.perf_counter()
 
+            delivered = 0
             try:
                 handle = self.backend.dispatch_local_batch(
                     [query for _, query in batch]
@@ -96,12 +97,17 @@ class TickBatcher:
                 )
 
                 for (message, _), tgts in zip(batch, targets):
+                    # Count before sending: a cancel landing inside the
+                    # broadcast means partially-sent — re-sending would
+                    # duplicate to the peers already written.
+                    delivered += 1
                     if tgts:
                         await self.peer_map.broadcast_to(message, tgts)
             except asyncio.CancelledError:
-                # stop() cancelled the timer mid-flush: put the batch
-                # back so the drain flush delivers it.
-                self._queue = batch + self._queue
+                # stop() cancelled the timer mid-flush: re-queue only the
+                # undelivered tail so the drain flush can't double-send
+                # messages already broadcast above.
+                self._queue = batch[delivered:] + self._queue
                 raise
 
             self.ticks += 1

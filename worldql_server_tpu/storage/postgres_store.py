@@ -63,6 +63,10 @@ _NAV_DDL = (
 
 UNDEFINED_TABLE = "42P01"
 
+# 7 bind params per row; stay well under the wire protocol's 32767
+# int16 parameter-count limit per statement.
+_INSERT_CHUNK_ROWS = 4000
+
 _PLACEHOLDER = re.compile(r"\$\d+")
 
 
@@ -239,23 +243,27 @@ class PostgresRecordStore(RecordStore):
 
         written = 0
         for (world, suffix), rows in table_map.items():
-            # One multi-row INSERT per table (client.rs:119-162).
-            placeholders = ",".join(
-                "(" + ",".join(f"${i * 7 + j + 1}" for j in range(7)) + ")"
-                for i in range(len(rows))
-            )
-            sql = (f'INSERT INTO "w_{world}".t_{suffix} '
-                   "(region_id, x, y, z, uuid, data, flex) "
-                   f"VALUES {placeholders}")
-            params = [v for row in rows for v in row]
-            try:
-                await self._exec(sql, *params)
-            except Exception as exc:
-                if not self._is_undefined_table(exc):
-                    raise
-                await self._create_data_table(world, suffix)
-                await self._exec(sql, *params)
-            written += len(rows)
+            # One multi-row INSERT per table (client.rs:119-162), chunked
+            # below PostgreSQL's 32767 bind-parameter ceiling (int16 in
+            # the extended protocol): 4000 rows × 7 params = 28000.
+            for start in range(0, len(rows), _INSERT_CHUNK_ROWS):
+                chunk = rows[start:start + _INSERT_CHUNK_ROWS]
+                placeholders = ",".join(
+                    "(" + ",".join(f"${i * 7 + j + 1}" for j in range(7)) + ")"
+                    for i in range(len(chunk))
+                )
+                sql = (f'INSERT INTO "w_{world}".t_{suffix} '
+                       "(region_id, x, y, z, uuid, data, flex) "
+                       f"VALUES {placeholders}")
+                params = [v for row in chunk for v in row]
+                try:
+                    await self._exec(sql, *params)
+                except Exception as exc:
+                    if not self._is_undefined_table(exc):
+                        raise
+                    await self._create_data_table(world, suffix)
+                    await self._exec(sql, *params)
+                written += len(chunk)
         return written
 
     async def get_records_in_region(

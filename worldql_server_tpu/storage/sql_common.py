@@ -44,6 +44,11 @@ class LruCache:
         if self.maxsize and len(self._map) > self.maxsize:
             self._map.popitem(last=False)
 
+    def clear(self) -> None:
+        """Drop every entry — used after a rolled-back transaction may
+        have cached ids from uncommitted navigation inserts."""
+        self._map.clear()
+
 
 class RegionMath:
     """Position → (region cell, table cell) quantization."""

@@ -188,18 +188,28 @@ class SqliteRecordStore(RecordStore):
             ))
 
         written = 0
-        for table, rows in table_map.items():
-            sql = (f"INSERT INTO {table} "
-                   "(last_modified, region_id, x, y, z, uuid, data, flex) "
-                   "VALUES (?,?,?,?,?,?,?,?)")
-            try:
-                conn.executemany(sql, rows)
-            except sqlite3.OperationalError as exc:
-                if "no such table" not in str(exc):
-                    raise
-                self._create_data_table(conn, table)
-                conn.executemany(sql, rows)
-            written += len(rows)
+        try:
+            for table, rows in table_map.items():
+                sql = (f"INSERT INTO {table} "
+                       "(last_modified, region_id, x, y, z, uuid, data, flex) "
+                       "VALUES (?,?,?,?,?,?,?,?)")
+                try:
+                    conn.executemany(sql, rows)
+                except sqlite3.OperationalError as exc:
+                    if "no such table" not in str(exc):
+                        raise
+                    self._create_data_table(conn, table)
+                    conn.executemany(sql, rows)
+                written += len(rows)
+        except Exception:
+            # Drop cached ids that may refer to the aborted transaction's
+            # navigation inserts, then abandon the partial batch so the
+            # next unrelated commit can't persist it. Caches first: a
+            # rollback() that itself raises must not leave them stale.
+            self._table_cache.clear()
+            self._region_cache.clear()
+            conn.rollback()
+            raise
         conn.commit()
         return written
 
