@@ -1,0 +1,264 @@
+"""Incremental device-index maintenance (spatial/tpu_backend.py).
+
+The round-1 design rebuilt the whole device mirror on any mutation —
+O(S) Python per flush. The incremental design must keep per-flush cost
+O(churn): base segment immutable + tombstones, delta log for adds, and
+background compaction that folds them while serving continues. These
+tests pin that machinery against the dict-based CPU oracle.
+"""
+
+import random
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.protocol.types import Replication, Vector3
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.spatial.quantize import cube_coords_batch
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+W = "world"
+
+
+def _peers(n):
+    return [uuid.UUID(int=i + 1) for i in range(n)]
+
+
+def _query(world, pos, sender):
+    return LocalQuery(world, pos, sender, Replication.EXCEPT_SELF)
+
+
+def test_small_mutation_keeps_base_segment():
+    """One add after a compacted base must not rebuild the base — it
+    lands in the delta segment."""
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    peers = _peers(40)
+    cubes = np.array([[16 * (i % 5 + 1), 16, 16] for i in range(40)])
+    b.bulk_add_subscriptions(W, peers, cubes)
+    b.flush()
+    b.wait_compaction()
+    base_dev_before = b._base_bundle["dev"][0]
+
+    extra = uuid.uuid4()
+    b.add_subscription(W, extra, Vector3(5, 5, 5))
+    b.flush()
+    assert b._base_bundle["dev"][0] is base_dev_before  # base untouched
+    assert b._delta_live == 1
+    assert extra in b.query_cube(W, Vector3(5, 5, 5))
+
+
+def test_tombstone_is_visible_after_flush():
+    b = TpuSpatialBackend(16)
+    a, c = uuid.uuid4(), uuid.uuid4()
+    pos = Vector3(5, 5, 5)
+    b.add_subscription(W, a, pos)
+    b.add_subscription(W, c, pos)
+    assert b.match_local_batch([_query(W, pos, uuid.uuid4())]) == [[a, c]]
+
+    # force rows into the base so the removal is a base tombstone
+    b._compact_sync()
+    assert b._base_live == 2 and b._delta_live == 0
+    assert b.remove_subscription(W, a, pos)
+    assert b._base_dead == 1
+    assert b.match_local_batch([_query(W, pos, uuid.uuid4())]) == [[c]]
+    assert b.query_cube(W, pos) == {c}
+
+
+def test_sync_compaction_folds_delta():
+    b = TpuSpatialBackend(16, compact_threshold=8)
+    peers = _peers(200)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * (i % 10), 5, 5))
+    b.flush()
+    b.wait_compaction()
+    assert b.compactions >= 1
+    assert b.subscription_count() == 200
+    got = b.match_local_batch([_query(W, Vector3(3, 5, 5), uuid.uuid4())])
+    want = b.query_cube(W, Vector3(3, 5, 5))
+    assert set(got[0]) == want
+
+
+def test_async_compaction_with_concurrent_mutations():
+    """Mutations landing while a compaction is in flight must survive
+    the swap: removals of snapshot rows replay onto the new base, adds
+    stay in the delta tail."""
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    cpu = CpuSpatialBackend(16)
+    peers = _peers(64)
+    for i, p in enumerate(peers):
+        pos = Vector3(16 * (i % 8), 5, 5)
+        b.add_subscription(W, p, pos)
+        cpu.add_subscription(W, p, pos)
+    b.flush()  # may start or complete compactions along the way
+
+    # force an in-flight window deterministically
+    b._start_compaction() if b._compaction is None else None
+    assert b._compaction is not None
+
+    # concurrent mutations: remove some snapshot rows, add new ones
+    for i in (0, 8, 16):
+        pos = Vector3(16 * (i % 8), 5, 5)
+        assert b.remove_subscription(W, peers[i], pos)
+        assert cpu.remove_subscription(W, peers[i], pos)
+    fresh = [uuid.uuid4() for _ in range(5)]
+    for i, p in enumerate(fresh):
+        pos = Vector3(16 * i, 200, 5)
+        b.add_subscription(W, p, pos)
+        cpu.add_subscription(W, p, pos)
+
+    b.wait_compaction()
+    assert b._compaction is None
+
+    queries = [
+        _query(W, Vector3(16 * i, 5, 5), uuid.uuid4()) for i in range(8)
+    ] + [
+        _query(W, Vector3(16 * i, 200, 5), uuid.uuid4()) for i in range(5)
+    ]
+    for got, want in zip(b.match_local_batch(queries),
+                         cpu.match_local_batch(queries)):
+        assert set(got) == set(want)
+    assert b.subscription_count() == cpu.subscription_count()
+
+
+def test_remove_peer_during_in_flight_compaction():
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    peers = _peers(20)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * (i % 4), 5, 5))
+    b.flush()
+    if b._compaction is None:
+        b._start_compaction()
+    assert b.remove_peer(peers[0])
+    b.wait_compaction()
+    got = b.match_local_batch([_query(W, Vector3(3, 5, 5), uuid.uuid4())])
+    assert peers[0] not in got[0]
+    assert not b.is_subscribed_any(W, peers[0])
+
+
+def test_bulk_add_dedupes_and_counts():
+    b = TpuSpatialBackend(16)
+    peers = _peers(10)
+    cubes = np.array([[16, 16, 16]] * 10)
+    assert b.bulk_add_subscriptions(W, peers, cubes) == 10
+    # duplicates (same peer+cube) are rejected, new cubes accepted
+    cubes2 = np.array([[16, 16, 16]] * 5 + [[32, 16, 16]] * 5)
+    assert b.bulk_add_subscriptions(W, peers, cubes2) == 5
+    assert b.subscription_count() == 15
+    # intra-batch duplicates collapse
+    p = [uuid.uuid4()] * 3
+    assert b.bulk_add_subscriptions(W, p, np.array([[48, 16, 16]] * 3)) == 1
+
+
+def test_bulk_remove_matches_single_removals():
+    b = TpuSpatialBackend(16)
+    peers = _peers(12)
+    cubes = np.array([[16 * (i % 3 + 1), 16, 16] for i in range(12)])
+    b.bulk_add_subscriptions(W, peers, cubes)
+    b.flush()
+    removed = b.bulk_remove_subscriptions(W, peers[:6], cubes[:6])
+    assert removed == 6
+    # double-remove and unknown rows are no-ops
+    assert b.bulk_remove_subscriptions(W, peers[:6], cubes[:6]) == 0
+    assert b.subscription_count() == 6
+    got = b.match_local_batch([_query(W, Vector3(20, 10, 10), uuid.uuid4())])
+    want = b.query_cube(W, (32, 16, 16))
+    assert set(got[0]) == want
+
+
+def test_bulk_load_goes_straight_to_base():
+    """A load far above the compaction threshold must fold directly
+    into the base (no delta dict churn)."""
+    b = TpuSpatialBackend(16, compact_threshold=8)
+    n = 500
+    rng = np.random.default_rng(1)
+    pos = rng.uniform(-400, 400, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    assert b.bulk_add_subscriptions(W, _peers(n), cubes) == n
+    assert b._delta_live == 0
+    assert b._base_live == n
+    b.flush()
+    assert b.device_stats()["capacity"] >= n
+
+
+def test_reseed_rebuild_preserves_semantics():
+    b = TpuSpatialBackend(16)
+    peers = _peers(30)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * i, 5, 5))
+    before = {i: b.query_cube(W, (16 * i if i else 16, 16, 16))
+              for i in range(5)}
+    seed0 = b._seed
+    b._reseed_rebuild()
+    assert b._seed == seed0 + 1
+    for i in range(5):
+        assert b.query_cube(W, (16 * i if i else 16, 16, 16)) == before[i]
+    got = b.match_local_batch([_query(W, Vector3(16, 5, 5), uuid.uuid4())])
+    assert set(got[0]) == b.query_cube(W, Vector3(16, 5, 5))
+
+
+def test_churn_property_vs_cpu_with_tiny_threshold():
+    """Randomized churn with compaction forced constantly (threshold 8)
+    — every flush exercises tombstone scatter, delta rebuild, swap and
+    replay. Must stay equivalent to the CPU oracle throughout."""
+    rng = random.Random(0xD00D)
+    cpu = CpuSpatialBackend(16)
+    tpu = TpuSpatialBackend(16, compact_threshold=8)
+    peers = _peers(30)
+    worlds = ["alpha", "beta"]
+
+    def rand_pos():
+        return Vector3(
+            rng.uniform(-100, 100), rng.uniform(-100, 100),
+            rng.uniform(-100, 100),
+        )
+
+    for _round in range(6):
+        for _ in range(120):
+            op = rng.random()
+            w = rng.choice(worlds)
+            p = rng.choice(peers)
+            if op < 0.55:
+                pos = rand_pos()
+                assert cpu.add_subscription(w, p, pos) == \
+                    tpu.add_subscription(w, p, pos)
+            elif op < 0.85:
+                pos = rand_pos()
+                assert cpu.remove_subscription(w, p, pos) == \
+                    tpu.remove_subscription(w, p, pos)
+            else:
+                assert cpu.remove_peer(p) == tpu.remove_peer(p)
+        queries = [
+            LocalQuery(
+                rng.choice(worlds + ["never"]), rand_pos(),
+                rng.choice(peers), rng.choice(list(Replication)),
+            )
+            for _ in range(80)
+        ]
+        for i, (c, t) in enumerate(zip(cpu.match_local_batch(queries),
+                                       tpu.match_local_batch(queries))):
+            assert set(c) == set(t), f"round {_round} query {i}"
+        assert tpu.subscription_count() == cpu.subscription_count()
+        if _round % 2:
+            tpu.wait_compaction()
+    assert tpu.compactions > 0
+
+
+def test_world_level_views_survive_churn():
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    cpu = CpuSpatialBackend(16)
+    peers = _peers(10)
+    for i, p in enumerate(peers):
+        for j in range(3):
+            pos = Vector3(16 * j, 16 * i, 5)
+            b.add_subscription(W, p, pos)
+            cpu.add_subscription(W, p, pos)
+    b.flush()
+    for p in peers[:5]:
+        b.remove_peer(p)
+        cpu.remove_peer(p)
+    assert b.query_world(W) == cpu.query_world(W)
+    assert b.cube_count(W) == cpu.cube_count(W)
+    for p in peers:
+        assert b.is_subscribed_any(W, p) == cpu.is_subscribed_any(W, p)

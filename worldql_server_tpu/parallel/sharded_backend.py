@@ -1,18 +1,30 @@
 """Spatial fan-out sharded over a device mesh.
 
-Scale-out design (BASELINE configs 4-5): the sorted subscription index
-is split into per-device contiguous key ranges — split points snapped
-to cube-run boundaries so every cube's subscriber run lives wholly on
-one device. Queries shard over the ``batch`` axis. Each device binary-
+Scale-out design (BASELINE configs 4-5): the sorted base segment is
+split into per-device contiguous key ranges — split points snapped to
+cube-run boundaries so every cube's subscriber run lives wholly on one
+device. Queries shard over the ``batch`` axis. Each device binary-
 searches its local range; exactly one ``space`` shard can match a given
 cube, so partial [M/b, K] results (−1 = no match) combine with a single
 ``pmax`` over ``space`` — one ICI collective per tick, no host hops.
+
+The small delta segment (rows added since the last compaction — see
+spatial/tpu_backend.py) is *replicated* across the mesh: every device
+matches the full delta locally, the partials concatenate with the base
+partials before the ``pmax``, and the merge stays one collective.
 
 SPMD via ``jax.shard_map``; XLA lays out the gathers per shard and the
 final combine as an ICI all-reduce(max). Worlds need no special
 handling: world id is part of the spatial key, so a world's cubes
 scatter across shards (load-balancing Zipf-hotspot worlds) while each
-cube stays device-local.
+cube stays device-local. Sparse / CSR result compaction runs in the
+same jit after the shard_map — XLA partitions the cumsum/scatter with
+the collectives it needs, so compacted results work identically on the
+mesh (the distributed delivery path consumes CSR).
+
+Query arrays enter as numpy with explicit ``in_shardings``, so every
+H2D transfer rides the ONE jitted dispatch — no per-array
+``device_put`` round-trips (they dominate on tunneled devices).
 """
 
 from __future__ import annotations
@@ -25,7 +37,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to
-from ..spatial.tpu_backend import TpuSpatialBackend, match_core
+from ..spatial.tpu_backend import (
+    TpuSpatialBackend,
+    _XYZ_PAD,
+    compact_csr,
+    compact_sparse,
+    match_core,
+)
 
 
 def split_at_run_boundaries(keys: np.ndarray, n_shards: int) -> list[int]:
@@ -45,61 +63,45 @@ def split_at_run_boundaries(keys: np.ndarray, n_shards: int) -> list[int]:
     return splits
 
 
-def _sharded_match(mesh: Mesh, k: int):
-    """Build the jitted shard_map kernel for this mesh and fan-out K."""
-
-    def local(sub_key, sub_world, sub_xyz, sub_peer,
-              q_key, q_world, q_xyz, q_sender, q_repl):
-        tgt = match_core(
-            sub_key[0], sub_world[0], sub_xyz[0], sub_peer[0],
-            q_key, q_world, q_xyz, q_sender, q_repl, k=k,
-        )
-        # Exactly one 'space' shard holds any cube's run; everyone else
-        # contributes -1, so max is a lossless merge.
-        return jax.lax.pmax(tgt, "space")
-
-    sub = P("space", None)
-    return jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(
-                sub, sub, P("space", None, None), sub,
-                P("batch"), P("batch"), P("batch", None),
-                P("batch"), P("batch"),
-            ),
-            out_specs=P("batch", None),
-        )
-    )
-
-
 class ShardedTpuSpatialBackend(TpuSpatialBackend):
     """Multi-chip backend: same host authority and observable semantics
-    as the single-chip backend, index sharded over ``mesh``."""
+    as the single-chip backend, base segment sharded over ``mesh``."""
 
-    def __init__(self, cube_size: int, mesh: Mesh):
-        super().__init__(cube_size)
+    def __init__(
+        self, cube_size: int, mesh: Mesh,
+        compact_threshold: int | None = None,
+    ):
+        super().__init__(cube_size, compact_threshold=compact_threshold)
         if set(mesh.axis_names) != {"batch", "space"}:
             raise ValueError("mesh must have axes ('batch', 'space')")
         self.mesh = mesh
         self.n_batch = mesh.shape["batch"]
         self.n_space = mesh.shape["space"]
-        self._kernels: dict[int, object] = {}  # k → compiled shard_map
+        self._kernels: dict[tuple, object] = {}
 
-    # region: device mirror (sharded)
+    # region: shardings
 
-    def flush(self) -> None:
-        if not self._dirty:
-            return
-        self._dirty = False
+    def _sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
 
-        built = self._build_sorted()
-        if built is None:
-            self._dev = None
-            return
-        keys, worlds, xyz, peers, cube_occupancy = built
-        self._k = next_pow2(cube_occupancy, 8)
+    def _base_specs(self):
+        return (
+            P("space", None), P("space", None),
+            P("space", None, None), P("space", None),
+        )
 
+    def _delta_specs(self):
+        return (P(None), P(None), P(None, None), P(None))
+
+    def _query_specs(self):
+        return (P("batch"), P("batch"), P("batch", None),
+                P("batch"), P("batch"))
+
+    # endregion
+
+    # region: device upload seams
+
+    def _upload_base(self, keys, wids, xyz, pids, k) -> dict:
         splits = split_at_run_boundaries(keys, self.n_space)
         cap = next_pow2(max(b - a for a, b in zip(splits, splits[1:])))
 
@@ -109,20 +111,58 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 for a, b in zip(splits, splits[1:])
             ])
 
-        def put(arr: np.ndarray, spec: P):
-            return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        sub = self._sharding("space", None)
+        return {
+            "dev": (
+                jax.device_put(stack(keys, PAD_KEY), sub),
+                jax.device_put(stack(wids, NO_WORLD), sub),
+                jax.device_put(stack(xyz, _XYZ_PAD),
+                               self._sharding("space", None, None)),
+                jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
+                               sub),
+            ),
+            "cap": self.n_space * cap,
+            "splits": np.asarray(splits, np.int64),
+            "shard_cap": cap,
+        }
 
-        sub = P("space", None)
-        self._dev = (
-            put(stack(keys, PAD_KEY), sub),
-            put(stack(worlds, NO_WORLD), sub),
-            put(stack(xyz, np.int64(-(2**62))), P("space", None, None)),
-            put(stack(peers, np.int32(-1)), sub),
-        )
+    def _upload_delta(self, keys, wids, xyz, pids, k) -> dict:
+        cap = next_pow2(keys.size)
+        rep = self._sharding()
+        return {
+            "dev": (
+                jax.device_put(pad_to(keys, cap, PAD_KEY), rep),
+                jax.device_put(pad_to(wids, cap, NO_WORLD), rep),
+                jax.device_put(pad_to(xyz, cap, _XYZ_PAD), rep),
+                jax.device_put(
+                    pad_to(pids.astype(np.int32), cap, np.int32(-1)), rep
+                ),
+            ),
+            "cap": cap,
+        }
+
+    def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
+        """Map global sorted-row indices → (shard, local) and tombstone
+        with one scatter over the [n_space, cap] peer array."""
+        splits = bundle["splits"]
+        cap = bundle["shard_cap"]
+        shard = np.searchsorted(splits, rows, side="right") - 1
+        local = rows - splits[shard]
+        pad_n = next_pow2(rows.size)
+        shard = pad_to(shard.astype(np.int32), pad_n, np.int32(self.n_space))
+        local = pad_to(local.astype(np.int32), pad_n, np.int32(cap))
+        dev = bundle["dev"]
+        kernel = self._kernels.get("scatter")
+        if kernel is None:
+            kernel = self._kernels["scatter"] = jax.jit(
+                lambda peer, s, l: peer.at[s, l].set(-1, mode="drop"),
+                out_shardings=self._sharding("space", None),
+            )
+        return {**bundle, "dev": (*dev[:3], kernel(dev[3], shard, local))}
 
     # endregion
 
-    # region: batched hot path
+    # region: dispatch
 
     def _query_cap(self, m: int) -> int:
         # Batch capacity must shard evenly over 'batch': power-of-two
@@ -131,44 +171,77 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         cap = max(next_pow2(m), self.n_batch)
         return -(-cap // self.n_batch) * self.n_batch
 
-    def _dispatch_sparse(self, queries: tuple, c: int):
-        raise NotImplementedError(
-            "sparse/CSR compaction over a sharded mesh lands with the "
-            "distributed delivery path; use the dense API here"
+    def _make_kernel(self, variant: str, kinds: tuple, ks: tuple, extra):
+        """Compile a mesh kernel: shard_map match (+ pmax merge), then
+        optional result compaction, one jit, explicit in_shardings.
+        ``kinds`` says which segments are space-sharded stacks ('base',
+        local view [1, cap]) vs replicated flat arrays ('delta')."""
+        mesh = self.mesh
+        n_seg = len(kinds)
+
+        def local(*args):
+            queries = args[4 * n_seg:]
+            parts = []
+            for i, (kind, k) in enumerate(zip(kinds, ks)):
+                seg = args[4 * i:4 * i + 4]
+                if kind == "base":
+                    seg = tuple(a[0] for a in seg)  # drop the shard dim
+                parts.append(match_core(*seg, *queries, k=k))
+            tgt = parts[0] if n_seg == 1 else jnp.concatenate(parts, axis=1)
+            # Exactly one 'space' shard holds any cube's base run, and
+            # the delta part is identical on every shard — max is a
+            # lossless merge either way.
+            return jax.lax.pmax(tgt, "space")
+
+        in_specs = tuple(
+            spec
+            for kind in kinds
+            for spec in (
+                self._base_specs() if kind == "base" else self._delta_specs()
+            )
+        ) + self._query_specs()
+        matched = jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=P("batch", None)
         )
 
-    def _dispatch_csr(self, queries: tuple, t_cap: int):
-        raise NotImplementedError(
-            "sparse/CSR compaction over a sharded mesh lands with the "
-            "distributed delivery path; use the dense API here"
-        )
+        if variant == "dense":
+            fn = matched
+        elif variant == "sparse":
+            def fn(*args):
+                return compact_sparse(matched(*args), c=extra)
+        else:
+            def fn(*args):
+                return compact_csr(matched(*args), t_cap=extra)
 
-    def _dispatch(self, queries: tuple):
-        kernel = self._kernels.get(self._k)
+        in_shardings = tuple(
+            NamedSharding(mesh, spec) for spec in in_specs
+        )
+        return jax.jit(fn, in_shardings=in_shardings)
+
+    def _kernel(self, variant: str, kinds, ks, extra=None):
+        key = (variant, kinds, ks, extra)
+        kernel = self._kernels.get(key)
         if kernel is None:
-            kernel = self._kernels[self._k] = _sharded_match(self.mesh, self._k)
+            kernel = self._kernels[key] = self._make_kernel(
+                variant, kinds, ks, extra
+            )
+        return kernel
 
-        keys, world_ids, cubes, sender_ids, repls = queries
+    def _dispatch(self, queries: tuple, segs, ks, kinds):
+        flat = [a for seg in segs for a in seg]
+        return self._kernel("dense", kinds, ks)(*flat, *queries)
 
-        def put(arr, *spec):
-            return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+    def _dispatch_sparse(self, queries: tuple, segs, ks, kinds, c: int):
+        flat = [a for seg in segs for a in seg]
+        return self._kernel("sparse", kinds, ks, c)(*flat, *queries)
 
-        return kernel(
-            *self._dev,
-            put(keys, "batch"),
-            put(world_ids, "batch"),
-            put(cubes, "batch", None),
-            put(sender_ids, "batch"),
-            put(repls, "batch"),
-        )
+    def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
+        flat = [a for seg in segs for a in seg]
+        return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
 
     # endregion
 
     def device_stats(self) -> dict:
         stats = super().device_stats()
         stats["mesh"] = {"batch": self.n_batch, "space": self.n_space}
-        if self._dev is not None:
-            stats["capacity"] = int(
-                self._dev[0].shape[0] * self._dev[0].shape[1]
-            )
         return stats

@@ -7,24 +7,37 @@ worth of queries resolves as ONE jitted device batch over a
 device-resident subscription index — the north-star design from
 BASELINE.json.
 
-Layout (SoA, device-resident, integers only — no f64 on device):
+Index layout — two segments, LSM-style, so a mutation costs O(log S)
+instead of an O(S) rebuild (the reference's AreaMap does O(1) dict
+updates, area_map.rs:72-85; this is the static-shape analog):
 
-* ``sub_key``   [S] int64 — spatial hash of (world, cube), sorted
-* ``sub_world`` [S] int32 — interned world id, in key order
-* ``sub_xyz``   [S, 3] int64 — exact cube coords, for hash verification
-* ``sub_peer``  [S] int32 — interned peer id, in key order
+* **base**: large sorted-by-key SoA (``key i64 | world i32 | cube
+  3×i64 | peer i32``). Immutable except for *tombstones*: a removal
+  sets ``peer = -1`` (host + one device scatter per flush). Keys,
+  worlds and cubes never change, so the binary-search run structure
+  and the first-row exactness probe stay valid; dead rows gather as
+  ``-1`` targets, which every consumer already filters.
+* **delta**: small insertion-ordered append log holding rows added
+  since the last compaction. Each flush sorts the *live* delta rows
+  (O(D log D), D = churn since compaction) and uploads them as a
+  second device segment; a query matches both segments and
+  concatenates the target lists.
 
-A query is two binary searches (``searchsorted`` left/right) giving the
-contiguous run of subscribers of its cube, an exactness check of
-(world, cube) against the candidate row, a fixed-degree-K gather of
-peer ids, and a replication mask — all fused by XLA into one kernel
-launch for the whole batch. K is the max cube occupancy, rounded to a
-power of two; S and M are padded to power-of-two capacity tiers so the
-number of compiled shapes stays logarithmic.
+**Compaction** folds base+delta into a fresh sorted base. It runs on a
+background thread against a snapshot while the serving index keeps
+answering (and mutating); removals that touch snapshot rows are logged
+as (key, peer) pairs and replayed against the new base at swap time,
+so the swap itself is O(replay) on the owning thread.
 
-The host keeps the authoritative dict index (inherited from
-``CpuSpatialBackend``) — point queries and membership checks stay exact
-and O(1) on host; ``flush()`` mirrors it to the device after mutations.
+A query is two binary searches per segment (``searchsorted``
+left/right) giving the contiguous run of subscribers of its cube, an
+exactness check of (world, cube) against the run's first row, a
+fixed-degree-K gather of peer ids, and a replication mask — all fused
+by XLA into one kernel launch for the whole batch. K is the max cube
+occupancy per segment, rounded to a power of two; segment and query
+capacities are power-of-two tiers so the number of compiled shapes
+stays logarithmic.
+
 Quantization always runs host-side in numpy f64 (golden semantics,
 cube_area.rs:23-44); the device only ever compares integer labels, so
 TPU fast-math cannot perturb grid assignment.
@@ -32,7 +45,9 @@ TPU fast-math cannot perturb grid assignment.
 
 from __future__ import annotations
 
+import threading
 import uuid as uuid_mod
+from collections import Counter
 from functools import partial
 from typing import Sequence
 
@@ -43,13 +58,19 @@ import jax
 import jax.numpy as jnp
 
 from ..protocol.types import Replication, Vector3
-from .backend import Cube, LocalQuery, to_cube
-from .cpu_backend import CpuSpatialBackend
+from .backend import Cube, LocalQuery, SpatialBackend, to_cube
 from .hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
 from .quantize import cube_coords_batch
 
 _REPL_EXCEPT = np.int8(int(Replication.EXCEPT_SELF))
 _REPL_ONLY = np.int8(int(Replication.ONLY_SELF))
+
+_XYZ_PAD = np.int64(-(2 ** 62))
+
+
+# --------------------------------------------------------------------
+# Device kernels
+# --------------------------------------------------------------------
 
 
 def match_core(
@@ -59,9 +80,11 @@ def match_core(
 ):
     """[M] queries × [S] sorted subscriptions → [M, K] peer ids (-1 pad).
 
-    Pure traceable core; the single-chip backend jits it directly and
-    the sharded backend (parallel/sharded_backend.py) wraps it in
-    shard_map over a device mesh.
+    Pure traceable core; the single-chip backend jits it (per segment)
+    and the sharded backend (parallel/sharded_backend.py) wraps it in
+    shard_map over a device mesh. Tombstoned rows carry ``peer == -1``
+    and fall out through the same mask that drops replication-filtered
+    rows.
     """
     s = sub_key.shape[0]
     lo = jnp.searchsorted(sub_key, q_key, side="left")
@@ -80,7 +103,7 @@ def match_core(
     offs = jnp.arange(k, dtype=lo.dtype)
     gidx = jnp.minimum(lo[:, None] + offs[None, :], s - 1)
     tgt = sub_peer[gidx]
-    valid = offs[None, :] < cnt[:, None]
+    valid = (offs[None, :] < cnt[:, None]) & (tgt >= 0)
 
     # Replication filter (local_message.rs:60-86).
     is_sender = tgt == q_sender[:, None]
@@ -93,53 +116,42 @@ def match_core(
     return jnp.where(valid, tgt, -1)
 
 
-_match_kernel = partial(jax.jit, static_argnames=("k",))(match_core)
+def _multi_match(flat_args, ks):
+    """Match against ``len(ks)`` segments, concatenating the per-query
+    target lists along the K axis. ``flat_args`` is 4 arrays per
+    segment followed by the 5 query arrays."""
+    nseg = len(ks)
+    queries = flat_args[4 * nseg:]
+    parts = [
+        match_core(*flat_args[4 * i:4 * i + 4], *queries, k=ks[i])
+        for i in range(nseg)
+    ]
+    return parts[0] if nseg == 1 else jnp.concatenate(parts, axis=1)
 
 
-def match_core_sparse(
-    sub_key, sub_world, sub_xyz, sub_peer,
-    q_key, q_world, q_xyz, q_sender, q_repl,
-    *, k: int, c: int,
-):
-    """Sparse variant: most queries resolve to an empty fan-out (an
-    entity alone in its cube broadcasting except-self), so compact the
-    non-empty rows on device and ship only those. Returns
-    ``(rows[c], targets[c, k], n_hits)``: query indices with >= 1
-    target, their target rows, and the true hit count (host re-fetches
-    dense on the rare ``n_hits > c`` overflow). Cuts device→host result
-    bytes by the hit rate — the dominant cost on PCIe, decisive on
-    tunneled devices."""
-    tgt = match_core(
-        sub_key, sub_world, sub_xyz, sub_peer,
-        q_key, q_world, q_xyz, q_sender, q_repl, k=k,
-    )
+def compact_sparse(tgt, *, c: int):
+    """Sparse compaction of a dense [M, K] target table: most queries
+    resolve to an empty fan-out (an entity alone in its cube
+    broadcasting except-self), so compact the non-empty rows on device
+    and ship only those. Returns ``(rows[c], targets[c, k], n_hits)``:
+    query indices with >= 1 target, their target rows, and the true hit
+    count (host re-fetches dense on the rare ``n_hits > c`` overflow).
+    Cuts device→host result bytes by the hit rate — the dominant cost
+    on PCIe, decisive on tunneled devices."""
     nz = jnp.any(tgt >= 0, axis=1)
     order = jnp.argsort(~nz, stable=True)  # hit rows first, in order
     rows = order[:c]
     return rows.astype(jnp.int32), tgt[rows], nz.sum(dtype=jnp.int32)
 
 
-_match_kernel_sparse = partial(jax.jit, static_argnames=("k", "c"))(
-    match_core_sparse
-)
-
-
-def match_core_csr(
-    sub_key, sub_world, sub_xyz, sub_peer,
-    q_key, q_world, q_xyz, q_sender, q_repl,
-    *, k: int, t_cap: int,
-):
-    """CSR-compacted variant: returns ``(counts[M], flat[t_cap],
-    total)`` — per-query fan-out counts and all target peer ids
-    concatenated in query order. This is the layout the host needs to
-    build per-peer frames, and it shrinks the device→host result from
-    M×K to ~total ints (the dominant cost on the wire back). On
-    ``total > t_cap`` overflow the tail is dropped; callers detect via
-    ``total`` and re-fetch dense."""
-    tgt = match_core(
-        sub_key, sub_world, sub_xyz, sub_peer,
-        q_key, q_world, q_xyz, q_sender, q_repl, k=k,
-    )
+def compact_csr(tgt, *, t_cap: int):
+    """CSR compaction of a dense [M, K] target table: returns
+    ``(counts[M], flat[t_cap], total)`` — per-query fan-out counts and
+    all target peer ids concatenated in query order. This is the layout
+    the host needs to build per-peer frames, and it shrinks the
+    device→host result from M×K to ~total ints (the dominant cost on
+    the wire back). On ``total > t_cap`` overflow the tail is dropped;
+    callers detect via ``total`` and re-fetch dense."""
     valid = tgt >= 0
     cnt = valid.sum(axis=1, dtype=jnp.int32)
     starts = jnp.cumsum(cnt) - cnt  # exclusive prefix
@@ -152,25 +164,165 @@ def match_core_csr(
     return cnt, flat[:t_cap], cnt.sum(dtype=jnp.int32)
 
 
-_match_kernel_csr = partial(jax.jit, static_argnames=("k", "t_cap"))(
-    match_core_csr
-)
+@partial(jax.jit, static_argnames=("ks",))
+def _match_dense_kernel(*flat_args, ks):
+    return _multi_match(flat_args, ks)
 
 
-class TpuSpatialBackend(CpuSpatialBackend):
-    """Device-batched backend. Mutations and point queries run on the
-    host authority; ``match_local_batch`` runs on device."""
+@partial(jax.jit, static_argnames=("ks", "c"))
+def _match_sparse_kernel(*flat_args, ks, c):
+    return compact_sparse(_multi_match(flat_args, ks), c=c)
 
-    def __init__(self, cube_size: int):
+
+@partial(jax.jit, static_argnames=("ks", "t_cap"))
+def _match_csr_kernel(*flat_args, ks, t_cap):
+    return compact_csr(_multi_match(flat_args, ks), t_cap=t_cap)
+
+
+@jax.jit
+def _scatter_dead(peer_arr, rows):
+    """Tombstone ``rows`` (padded with out-of-range indices) in a device
+    peer array. ``mode='drop'`` ignores the padding."""
+    return peer_arr.at[rows].set(-1, mode="drop")
+
+
+@jax.jit
+def _write_chunk(bufs, chunks, start):
+    """Append a host chunk into the persistent insertion-order delta
+    buffer at ``start`` (traced scalar — no recompile per position).
+    The only per-tick H2D transfer is the chunk itself."""
+    return tuple(
+        jax.lax.dynamic_update_slice(b, c, (start,) + (0,) * (b.ndim - 1))
+        for b, c in zip(bufs, chunks)
+    )
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _grow_buffers(bufs, cap):
+    """Grow the delta buffer to ``cap`` rows on device — no re-upload."""
+    pads = (PAD_KEY, NO_WORLD, _XYZ_PAD, np.int32(-1))
+    out = []
+    for b, fill in zip(bufs, pads):
+        widths = [(0, cap - b.shape[0])] + [(0, 0)] * (b.ndim - 1)
+        out.append(jnp.pad(b, widths, constant_values=fill))
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _alloc_buffers(cap):
+    """Fresh all-padding delta buffer, allocated on device (no H2D)."""
+    return (
+        jnp.full((cap,), PAD_KEY, jnp.int64),
+        jnp.full((cap,), NO_WORLD, jnp.int32),
+        jnp.full((cap, 3), _XYZ_PAD, jnp.int64),
+        jnp.full((cap,), -1, jnp.int32),
+    )
+
+
+@jax.jit
+def _sort_segment_dev(keys, wids, xyz, peers):
+    """Key-sort a segment on device (the delta buffer is insertion-
+    ordered; queries need sorted runs). Stable, so ties keep insertion
+    order — matching the host's numpy mirror."""
+    order = jnp.argsort(keys, stable=True)
+    return keys[order], wids[order], xyz[order], peers[order]
+
+
+@partial(jax.jit, static_argnames=("cap2",))
+def _device_compact(bk, bw, bxyz, bp, dk, dw, dxyz, dp, cap2):
+    """Fold base + delta into a fresh sorted base ENTIRELY on device —
+    zero host→device transfer (decisive on tunneled/remote devices
+    where a full index upload costs seconds).
+
+    Dead rows (peer < 0) get their key rewritten to the padding
+    sentinel, so the stable sort sinks them past every live run and the
+    leading ``cap2`` rows are exactly the live index plus padding. The
+    host applies the identical transform to its numpy mirror, keeping
+    row indices aligned with the device (both sorts are stable)."""
+    keys = jnp.concatenate([bk, dk])
+    wids = jnp.concatenate([bw, dw])
+    xyz = jnp.concatenate([bxyz, dxyz])
+    peers = jnp.concatenate([bp, dp])
+    keys = jnp.where(peers < 0, PAD_KEY, keys)
+    order = jnp.argsort(keys, stable=True)[:cap2]
+    return keys[order], wids[order], xyz[order], peers[order]
+
+
+class _CollisionError(Exception):
+    """A new cube's key collided with a different stored cube (expected
+    ~never at 2^-64 per pair); the caller reseeds and rebuilds."""
+
+
+# --------------------------------------------------------------------
+# Backend
+# --------------------------------------------------------------------
+
+
+class TpuSpatialBackend(SpatialBackend):
+    """Device-batched backend. The host-side numpy SoA segments are the
+    authority; point queries binary-search them, the batched hot path
+    runs on device against their mirror."""
+
+    #: delta rows (live) that trigger a background compaction, as a
+    #: fraction of base size
+    COMPACT_DELTA_FRACTION = 8
+    #: dead base rows that trigger a background compaction (fraction)
+    COMPACT_DEAD_FRACTION = 8
+    #: delta overrun factor that forces a synchronous compaction
+    SYNC_COMPACT_FACTOR = 4
+
+    def __init__(self, cube_size: int, compact_threshold: int | None = None):
         super().__init__(cube_size)
         self._world_ids: dict[str, int] = {}
         self._peer_ids: dict[uuid_mod.UUID, int] = {}
         self._peer_list: list[uuid_mod.UUID] = []
-        self._dirty = True
+        # world id → live-row refcount per peer id (query_world /
+        # is_subscribed_any in O(1), the AreaMap subscribed_peers view,
+        # area_map.rs:10-17)
+        self._world_peers: dict[int, Counter] = {}
         self._seed = 0
-        self._k = 8
-        self._n_subs = 0
-        self._dev: tuple | None = None  # (sub_key, sub_world, sub_xyz, sub_peer)
+        self._dirty = True
+        self._compact_threshold_override = compact_threshold
+
+        # base segment (host authority, sorted by key)
+        self._bk = np.empty(0, np.int64)
+        self._bw = np.empty(0, np.int32)
+        self._bxyz = np.empty((0, 3), np.int64)
+        self._bp = np.empty(0, np.int32)
+        self._base_live = 0
+        self._base_dead = 0
+        self._base_k = 1
+        self._base_bundle: dict | None = None
+        self._pending_dead: list[int] = []
+
+        # delta log (host authority, insertion order, capacity doubling)
+        self._dcap = 0
+        self._dk = np.empty(0, np.int64)
+        self._dw = np.empty(0, np.int32)
+        self._dxyz = np.empty((0, 3), np.int64)
+        self._dp = np.empty(0, np.int32)
+        self._dn = 0
+        self._delta_live = 0
+        self._delta_index: dict[tuple[int, int], int] = {}  # (key,pid)→row
+        self._delta_keyrow: dict[int, int] = {}  # key → first row (cube id)
+        self._delta_key_count: Counter = Counter()  # key → rows (incl. dead)
+        self._delta_max_run = 1
+        self._delta_stale = False
+        # device twin of the log: persistent insertion-order buffer
+        # (only new-row chunks ever transfer) + its key-sorted view
+        self._delta_buf: tuple | None = None
+        self._delta_buf_cap = 0
+        self._delta_built_n = 0  # log rows present in the device buffer
+        self._pending_delta_dead: list[int] = []
+        self._delta_bundle: dict | None = None
+        self._delta_k = 1
+
+        # background compaction
+        self._compaction: dict | None = None
+        self._replay: list[tuple[int, int]] = []
+        self._epoch = 0
+
+        self.compactions = 0
 
     # region: interning
 
@@ -178,6 +330,7 @@ class TpuSpatialBackend(CpuSpatialBackend):
         wid = self._world_ids.get(world)
         if wid is None:
             wid = self._world_ids[world] = len(self._world_ids)
+            self._world_peers[wid] = Counter()
         return wid
 
     def _peer_id(self, peer: uuid_mod.UUID) -> int:
@@ -187,100 +340,902 @@ class TpuSpatialBackend(CpuSpatialBackend):
             self._peer_list.append(peer)
         return pid
 
+    def _key_of(self, wid: int, cube: Cube) -> int:
+        return int(spatial_keys(
+            np.array([wid], np.int32),
+            np.array([cube], np.int64),
+            self._seed,
+        )[0])
+
     # endregion
 
-    # region: mutations (host authority + dirty mark)
+    # region: host search
+
+    def _base_run(self, key: int) -> tuple[int, int]:
+        lo = int(np.searchsorted(self._bk, key, side="left"))
+        hi = int(np.searchsorted(self._bk, key, side="right"))
+        return lo, hi
+
+    def _find_live_row(self, key: int, wid: int, cube: Cube, pid: int):
+        """→ ('base', row) | ('delta', row) | None. Raises
+        :class:`_CollisionError` if ``key`` is held by a different
+        cube."""
+        lo, hi = self._base_run(key)
+        if lo < hi:
+            if self._bw[lo] != wid or (
+                self._bxyz[lo, 0] != cube[0]
+                or self._bxyz[lo, 1] != cube[1]
+                or self._bxyz[lo, 2] != cube[2]
+            ):
+                raise _CollisionError
+            j = np.flatnonzero(self._bp[lo:hi] == pid)
+            if j.size:
+                return ("base", lo + int(j[0]))
+        drow = self._delta_keyrow.get(key)
+        if drow is not None:
+            if self._dw[drow] != wid or (
+                self._dxyz[drow, 0] != cube[0]
+                or self._dxyz[drow, 1] != cube[1]
+                or self._dxyz[drow, 2] != cube[2]
+            ):
+                raise _CollisionError
+            row = self._delta_index.get((key, pid))
+            if row is not None:
+                return ("delta", row)
+        return None
+
+    # endregion
+
+    # region: mutations
 
     def add_subscription(
         self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
     ) -> bool:
-        added = super().add_subscription(world, peer, pos)
-        if added:
-            self._world_id(world)
-            self._peer_id(peer)
-            self._dirty = True
-        return added
+        cube = to_cube(pos, self.cube_size)
+        wid = self._world_id(world)
+        pid = self._peer_id(peer)
+        while True:
+            key = self._key_of(wid, cube)
+            try:
+                if key == int(PAD_KEY):
+                    raise _CollisionError
+                if self._find_live_row(key, wid, cube, pid) is not None:
+                    return False
+            except _CollisionError:
+                self._reseed_rebuild()
+                continue
+            break
+        self._delta_append(key, wid, cube, pid)
+        self._world_peers[wid][pid] += 1
+        self._dirty = True
+        return True
 
     def remove_subscription(
         self, world: str, peer: uuid_mod.UUID, pos: Vector3 | Cube
     ) -> bool:
-        removed = super().remove_subscription(world, peer, pos)
-        if removed:
-            self._dirty = True
-        return removed
+        cube = to_cube(pos, self.cube_size)
+        wid = self._world_ids.get(world)
+        pid = self._peer_ids.get(peer)
+        if wid is None or pid is None:
+            return False
+        key = self._key_of(wid, cube)
+        try:
+            found = self._find_live_row(key, wid, cube, pid)
+        except _CollisionError:
+            # The colliding cube is someone else's; ours isn't stored.
+            return False
+        if found is None:
+            return False
+        self._tombstone(found, key, pid)
+        self._drop_world_peer(wid, pid, 1)
+        self._dirty = True
+        return True
 
     def remove_peer(self, peer: uuid_mod.UUID) -> bool:
-        removed = super().remove_peer(peer)
-        if removed:
-            self._dirty = True
-        return removed
+        pid = self._peer_ids.get(peer)
+        if pid is None:
+            return False
+        rows_b = np.flatnonzero(self._bp == pid)
+        rows_d = np.flatnonzero(self._dp[:self._dn] == pid)
+        if rows_b.size == 0 and rows_d.size == 0:
+            return False
+
+        in_flight = self._compaction is not None
+        if rows_b.size:
+            self._bp[rows_b] = -1
+            self._pending_dead.extend(int(r) for r in rows_b)
+            self._base_dead += int(rows_b.size)
+            self._base_live -= int(rows_b.size)
+            if in_flight:
+                self._replay.extend(
+                    (int(self._bk[r]), pid) for r in rows_b
+                )
+        if rows_d.size:
+            consumed = self._compaction["consumed_dn"] if in_flight else 0
+            for r in rows_d:
+                r = int(r)
+                self._dp[r] = -1
+                self._delta_index.pop((int(self._dk[r]), pid), None)
+                if r < self._delta_built_n:
+                    self._pending_delta_dead.append(r)
+                if in_flight and r < consumed:
+                    self._replay.append((int(self._dk[r]), pid))
+            self._delta_live -= int(rows_d.size)
+            self._delta_stale = True
+
+        # world-level refcounts: drop this peer from every touched world
+        wids = np.unique(np.concatenate([
+            self._bw[rows_b], self._dw[rows_d]
+        ])) if rows_b.size or rows_d.size else ()
+        for wid in wids:
+            self._world_peers[int(wid)].pop(pid, None)
+
+        self._dirty = True
+        return True
+
+    def _delta_append(self, key: int, wid: int, cube: Cube, pid: int) -> None:
+        if self._dn == self._dcap:
+            self._grow_delta(max(1024, self._dcap * 2))
+        row = self._dn
+        self._dk[row] = key
+        self._dw[row] = wid
+        self._dxyz[row] = cube
+        self._dp[row] = pid
+        self._dn += 1
+        self._delta_live += 1
+        self._delta_index[(key, pid)] = row
+        self._delta_keyrow.setdefault(key, row)
+        run = self._delta_key_count[key] + 1
+        self._delta_key_count[key] = run
+        if run > self._delta_max_run:
+            self._delta_max_run = run
+        self._delta_stale = True
+
+    def _grow_delta(self, cap: int) -> None:
+        def grow(arr, shape, dtype):
+            out = np.empty(shape, dtype)
+            out[:self._dn] = arr[:self._dn]
+            return out
+
+        self._dk = grow(self._dk, cap, np.int64)
+        self._dw = grow(self._dw, (cap,), np.int32)
+        self._dxyz = grow(self._dxyz, (cap, 3), np.int64)
+        self._dp = grow(self._dp, (cap,), np.int32)
+        self._dcap = cap
+
+    def _tombstone(self, found: tuple[str, int], key: int, pid: int) -> None:
+        seg, row = found
+        in_flight = self._compaction is not None
+        if seg == "base":
+            self._bp[row] = -1
+            self._pending_dead.append(row)
+            self._base_dead += 1
+            self._base_live -= 1
+            if in_flight:
+                self._replay.append((key, pid))
+        else:
+            self._dp[row] = -1
+            self._delta_live -= 1
+            self._delta_index.pop((key, pid), None)
+            if row < self._delta_built_n:
+                self._pending_delta_dead.append(row)
+            self._delta_stale = True
+            if in_flight and row < self._compaction["consumed_dn"]:
+                self._replay.append((key, pid))
+
+    def _drop_world_peer(self, wid: int, pid: int, n: int) -> None:
+        wp = self._world_peers[wid]
+        wp[pid] -= n
+        if wp[pid] <= 0:
+            del wp[pid]
 
     # endregion
 
-    # region: device mirror
+    # region: bulk mutations (vectorized loaders)
 
-    def _build_sorted(self):
-        """Gather the host authority into key-sorted numpy SoA arrays:
-        → (keys, worlds, xyz, peers, max_cube_occupancy), or None if
-        empty. Also advances the hash seed past any collision."""
-        n = self.subscription_count()
-        self._n_subs = n
+    def bulk_add_subscriptions(self, world, peers, cubes) -> int:
+        """Bulk-load peers[i] → cube rows [N, 3] (already quantized).
+        Vectorized: interning aside, no per-row Python. Loader for
+        benchmarks, churn workloads and snapshot restore."""
+        cubes = np.ascontiguousarray(cubes, dtype=np.int64)
+        n = len(cubes)
         if n == 0:
-            return None
+            return 0
+        wid = self._world_id(world)
+        pids = self._intern_peers(peers)
 
-        worlds = np.empty(n, dtype=np.int32)
-        xyz = np.empty((n, 3), dtype=np.int64)
-        peers = np.empty(n, dtype=np.int32)
-        n_cubes = 0
-        i = 0
-        for wname, w in self._worlds.items():
-            wid = self._world_ids[wname]
-            n_cubes += len(w.cubes)
-            for cube, cube_peers in w.cubes.items():
-                j = i + len(cube_peers)
-                worlds[i:j] = wid
-                xyz[i:j] = cube
-                peers[i:j] = [self._peer_ids[p] for p in cube_peers]
-                i = j
-        assert i == n
-
-        # Seed search: distinct cubes must map to distinct keys, and no
-        # real key may equal the padding sentinel (see spatial/hashing).
         while True:
-            keys = spatial_keys(worlds, xyz, self._seed)
-            uniq, counts = np.unique(keys, return_counts=True)
-            cube_occupancy = int(counts.max())
-            if uniq.size == n_cubes and (uniq[-1] if uniq.size else 0) != PAD_KEY:
-                break
-            self._seed += 1
+            keys = spatial_keys(
+                np.full(n, wid, np.int32), cubes, self._seed
+            )
+            try:
+                new_rows = self._bulk_dedupe(keys, pids, cubes, wid)
+            except _CollisionError:
+                self._reseed_rebuild()
+                continue
+            break
 
-        order = np.argsort(keys, kind="stable")
-        return keys[order], worlds[order], xyz[order], peers[order], cube_occupancy
+        if new_rows.size == 0:
+            return 0
+        self._bulk_append(
+            keys[new_rows], np.full(new_rows.size, wid, np.int32),
+            cubes[new_rows], pids[new_rows],
+        )
+        # world-level refcounts, vectorized into the Counter
+        u, c = np.unique(pids[new_rows], return_counts=True)
+        counts = dict(zip(u.tolist(), c.tolist()))
+        wp = self._world_peers[wid]
+        if wp:
+            wp.update(counts)
+        else:
+            self._world_peers[wid] = Counter(counts)
+        self._dirty = True
+        return int(new_rows.size)
+
+    def bulk_remove_subscriptions(self, world, peers, cubes) -> int:
+        """Vectorized unsubscribe of peers[i] from cube rows [N, 3].
+        Returns the number of subscriptions actually removed."""
+        cubes = np.ascontiguousarray(cubes, dtype=np.int64)
+        n = len(cubes)
+        wid = self._world_ids.get(world)
+        if n == 0 or wid is None:
+            return 0
+        pids = np.fromiter(
+            (self._peer_ids.get(p, -1) for p in peers), np.int64, count=n
+        )
+        keys = spatial_keys(np.full(n, wid, np.int32), cubes, self._seed)
+
+        # intra-batch dedupe of (key, pid) pairs, drop unknown peers
+        valid = pids >= 0
+        if not valid.any():
+            return 0
+        k_, p_ = keys[valid], pids[valid]
+        order = np.lexsort((p_, k_))
+        ks_, ps_ = k_[order], p_[order]
+        first = np.ones(ks_.size, bool)
+        first[1:] = (ks_[1:] != ks_[:-1]) | (ps_[1:] != ps_[:-1])
+        ks_, ps_ = ks_[first], ps_[first]
+
+        in_flight = self._compaction is not None
+        consumed = self._compaction["consumed_dn"] if in_flight else 0
+        removed_pids: list[np.ndarray] = []
+
+        # base rows: vectorized run-candidate join on (key, pid)
+        bn = self._bk.size
+        base_hit = np.zeros(ks_.size, bool)
+        if bn:
+            lo = np.searchsorted(self._bk, ks_, side="left")
+            hi = np.searchsorted(self._bk, ks_, side="right")
+            runs = hi - lo
+            total = int(runs.sum())
+            if total:
+                qidx = np.repeat(np.arange(ks_.size), runs)
+                rows = np.repeat(lo, runs) + (
+                    np.arange(total) - np.repeat(np.cumsum(runs) - runs, runs)
+                )
+                match = self._bp[rows] == ps_[qidx]
+                rows_found = rows[match]
+                base_hit[qidx[match]] = True
+                if rows_found.size:
+                    self._bp[rows_found] = -1
+                    self._pending_dead.extend(rows_found.tolist())
+                    self._base_dead += int(rows_found.size)
+                    self._base_live -= int(rows_found.size)
+                    removed_pids.append(ps_[qidx[match]])
+                    if in_flight:
+                        self._replay.extend(zip(
+                            self._bk[rows_found].tolist(),
+                            ps_[qidx[match]].tolist(),
+                        ))
+
+        # delta rows: dict lookups for the batch rows the base missed
+        delta_removed = []
+        if self._delta_index:
+            miss = np.flatnonzero(~base_hit)
+            for i in miss:
+                pair = (int(ks_[i]), int(ps_[i]))
+                row = self._delta_index.pop(pair, None)
+                if row is None:
+                    continue
+                self._dp[row] = -1
+                delta_removed.append(pair[1])
+                if row < self._delta_built_n:
+                    self._pending_delta_dead.append(row)
+                if in_flight and row < consumed:
+                    self._replay.append(pair)
+            if delta_removed:
+                self._delta_live -= len(delta_removed)
+                self._delta_stale = True
+                removed_pids.append(np.asarray(delta_removed, np.int64))
+
+        if not removed_pids:
+            return 0
+        all_pids = np.concatenate(removed_pids)
+        u, c = np.unique(all_pids, return_counts=True)
+        for pid, cnt in zip(u.tolist(), c.tolist()):
+            self._drop_world_peer(wid, int(pid), cnt)
+        self._dirty = True
+        return int(all_pids.size)
+
+    def _intern_peers(self, peers) -> np.ndarray:
+        peer_ids = self._peer_ids
+        peer_list = self._peer_list
+        if not peer_ids:
+            # Fresh-index fast path (1M-entity bulk load): one C-speed
+            # dict build. Intra-batch duplicate peers map to their last
+            # slot; earlier slots stay as unreferenced list entries.
+            n0 = len(peer_list)
+            peer_ids.update(zip(peers, range(n0, n0 + len(peers))))
+            peer_list.extend(peers)
+            if len(peer_ids) == len(peer_list):
+                return np.arange(n0, n0 + len(peers), dtype=np.int64)
+            return np.fromiter(
+                (peer_ids[p] for p in peers), np.int64, count=len(peers)
+            )
+        out = np.empty(len(peers), np.int64)
+        for i, p in enumerate(peers):
+            pid = peer_ids.get(p)
+            if pid is None:
+                pid = peer_ids[p] = len(peer_list)
+                peer_list.append(p)
+            out[i] = pid
+        return out
+
+    def _bulk_dedupe(self, keys, pids, cubes, wid) -> np.ndarray:
+        """Indices of rows that are new (not duplicates within the batch
+        nor of existing live rows). Raises on any key collision."""
+        n = len(keys)
+        # intra-batch: keep the first row of each (key, pid) pair
+        order = np.lexsort((pids, keys))
+        ks, ps = keys[order], pids[order]
+        first = np.ones(n, bool)
+        first[1:] = (ks[1:] != ks[:-1]) | (ps[1:] != ps[:-1])
+        # same key must mean same cube within the batch
+        same_key = ks[1:] == ks[:-1]
+        if same_key.any():
+            a, b = order[1:][same_key], order[:-1][same_key]
+            if (cubes[a] != cubes[b]).any():
+                raise _CollisionError
+        if (keys == int(PAD_KEY)).any():
+            raise _CollisionError
+        reps = order[first]
+
+        # vs existing live rows: candidate extraction (only the base
+        # runs + delta rows matching batch keys — O(hits), not O(S)),
+        # then a union-rank merge join over (key, pid)
+        self._check_batch_collisions(keys[reps], cubes[reps], wid)
+        exist_k, exist_p = self._candidate_pairs(keys[reps])
+        if exist_k.size:
+            uniq = np.unique(np.concatenate([exist_k, keys[reps]]))
+            ex_comb = (
+                np.searchsorted(uniq, exist_k).astype(np.uint64) << np.uint64(32)
+            ) | exist_p.astype(np.uint64)
+            q_comb = (
+                np.searchsorted(uniq, keys[reps]).astype(np.uint64) << np.uint64(32)
+            ) | pids[reps].astype(np.uint64)
+            ex_comb.sort()
+            pos = np.searchsorted(ex_comb, q_comb)
+            pos = np.minimum(pos, ex_comb.size - 1)
+            member = ex_comb[pos] == q_comb
+            reps = reps[~member]
+        return reps
+
+    def _candidate_pairs(self, qkeys) -> tuple[np.ndarray, np.ndarray]:
+        """Live (key, pid) rows whose key appears in ``qkeys`` —
+        the only rows a batch membership check can hit."""
+        parts_k, parts_p = [], []
+        bn = self._bk.size
+        if bn:
+            lo = np.searchsorted(self._bk, qkeys, side="left")
+            hi = np.searchsorted(self._bk, qkeys, side="right")
+            runs = hi - lo
+            total = int(runs.sum())
+            if total:
+                # row indices of every run, concatenated
+                starts = np.repeat(lo, runs)
+                offs = np.arange(total) - np.repeat(
+                    np.cumsum(runs) - runs, runs
+                )
+                rows = starts + offs
+                live = self._bp[rows] >= 0
+                parts_k.append(self._bk[rows[live]])
+                parts_p.append(self._bp[rows[live]])
+        dn = self._dn
+        if dn:
+            hit = np.isin(self._dk[:dn], qkeys) & (self._dp[:dn] >= 0)
+            if hit.any():
+                parts_k.append(self._dk[:dn][hit])
+                parts_p.append(self._dp[:dn][hit])
+        if not parts_k:
+            return np.empty(0, np.int64), np.empty(0, np.int32)
+        return np.concatenate(parts_k), np.concatenate(parts_p)
+
+    def _check_batch_collisions(self, keys, cubes, wid) -> None:
+        bn = self._bk.size
+        if bn:
+            lo = np.searchsorted(self._bk, keys, side="left")
+            li = np.minimum(lo, bn - 1)
+            hit = self._bk[li] == keys
+            if hit.any():
+                ok = (
+                    (self._bw[li[hit]] == wid)
+                    & (self._bxyz[li[hit]] == cubes[hit]).all(axis=1)
+                )
+                if not ok.all():
+                    raise _CollisionError
+        if self._delta_keyrow:
+            # only batch keys actually present in the delta need a look
+            dkeys = np.fromiter(
+                self._delta_keyrow, np.int64, count=len(self._delta_keyrow)
+            )
+            for i in np.flatnonzero(np.isin(keys, dkeys)):
+                drow = self._delta_keyrow[int(keys[i])]
+                if self._dw[drow] != wid or (
+                    self._dxyz[drow] != cubes[i]
+                ).any():
+                    raise _CollisionError
+
+    def _live_pairs(self) -> tuple[np.ndarray, np.ndarray]:
+        """All live (key, pid) rows across base + delta."""
+        live_b = self._bp >= 0
+        live_d = self._dp[:self._dn] >= 0
+        return (
+            np.concatenate([self._bk[live_b], self._dk[:self._dn][live_d]]),
+            np.concatenate([self._bp[live_b], self._dp[:self._dn][live_d]]),
+        )
+
+    def _bulk_append(self, keys, wids, cubes, pids) -> None:
+        n = len(keys)
+        threshold = self._compact_threshold()
+        if n > self.SYNC_COMPACT_FACTOR * threshold:
+            # Huge load (initial index build): fold straight into a new
+            # base — no delta dict fills, one vectorized sort.
+            self._rebuild_base_with(keys, wids, cubes, pids)
+            return
+        if self._dn + n > self._dcap:
+            self._grow_delta(next_pow2(self._dn + n, 1024))
+        a, b = self._dn, self._dn + n
+        self._dk[a:b] = keys
+        self._dw[a:b] = wids
+        self._dxyz[a:b] = cubes
+        self._dp[a:b] = pids
+        rows = range(a, b)
+        idx = self._delta_index
+        keyrow = self._delta_keyrow
+        for row, key, pid in zip(rows, keys.tolist(), pids.tolist()):
+            idx[(key, pid)] = row
+            keyrow.setdefault(key, row)
+        kc = self._delta_key_count
+        u, c = np.unique(keys, return_counts=True)
+        for key, cnt in zip(u.tolist(), c.tolist()):
+            run = kc[key] + cnt
+            kc[key] = run
+            if run > self._delta_max_run:
+                self._delta_max_run = run
+        self._dn = b
+        self._delta_live += n
+        self._delta_stale = True
+
+    def _rebuild_base_with(self, keys, wids, cubes, pids) -> None:
+        """Synchronously fold (live base + live delta + new rows) into a
+        fresh sorted base; clears the delta."""
+        if self._compaction is not None:
+            self._abandon_compaction()
+        live_b = self._bp >= 0
+        live_d = self._dp[:self._dn] >= 0
+        all_k = np.concatenate([self._bk[live_b], self._dk[:self._dn][live_d], keys])
+        all_w = np.concatenate([self._bw[live_b], self._dw[:self._dn][live_d], wids])
+        all_x = np.concatenate(
+            [self._bxyz[live_b], self._dxyz[:self._dn][live_d], cubes]
+        )
+        all_p = np.concatenate([
+            self._bp[live_b], self._dp[:self._dn][live_d],
+            pids.astype(np.int32),
+        ])
+        self._install_base(*_sort_segment(all_k, all_w, all_x, all_p))
+        self._clear_delta()
+        self._dirty = True
+
+    # endregion
+
+    # region: reseed (hash collision — expected ~never)
+
+    def _reseed_rebuild(self) -> None:
+        """A key collision was detected: bump the seed until every live
+        cube gets a distinct non-sentinel key, then rebuild the base."""
+        if self._compaction is not None:
+            self._abandon_compaction()
+        live_b = self._bp >= 0
+        live_d = self._dp[:self._dn] >= 0
+        w = np.concatenate([self._bw[live_b], self._dw[:self._dn][live_d]])
+        x = np.concatenate([self._bxyz[live_b], self._dxyz[:self._dn][live_d]])
+        p = np.concatenate([self._bp[live_b], self._dp[:self._dn][live_d]])
+        while True:
+            self._seed += 1
+            keys = spatial_keys(w.astype(np.int32), x, self._seed)
+            order = np.argsort(keys, kind="stable")
+            ks = keys[order]
+            same = ks[1:] == ks[:-1]
+            bad = (ks == int(PAD_KEY)).any()
+            if same.any():
+                a, b = order[1:][same], order[:-1][same]
+                bad = bad or (w[a] != w[b]).any() or (x[a] != x[b]).any()
+            if not bad:
+                break
+        self._install_base(ks, w[order].astype(np.int32), x[order],
+                           p[order].astype(np.int32))
+        self._clear_delta()
+        self._dirty = True
+
+    # endregion
+
+    # region: flush / compaction
+
+    def _compact_threshold(self) -> int:
+        if self._compact_threshold_override is not None:
+            return self._compact_threshold_override
+        return max(4096, self._bk.size // self.COMPACT_DELTA_FRACTION)
 
     def flush(self) -> None:
-        """Rebuild the device mirror from the host authority."""
+        """Make all prior mutations visible to device queries. Cost is
+        O(churn since last flush) plus, rarely, a compaction."""
+        if self._compaction is not None and self._compaction["done"].is_set():
+            self._swap_compaction()
         if not self._dirty:
             return
         self._dirty = False
 
-        built = self._build_sorted()
-        if built is None:
-            self._dev = None
-            return
-        keys, worlds, xyz, peers, cube_occupancy = built
+        # 1. tombstones → one device scatter
+        if self._pending_dead and self._base_bundle is not None:
+            rows = np.asarray(self._pending_dead, np.int32)
+            self._base_bundle = self._scatter_base_dead(self._base_bundle, rows)
+        self._pending_dead.clear()
 
-        self._k = next_pow2(cube_occupancy, 8)
-        cap = next_pow2(len(keys))
-        self._dev = (
-            jnp.asarray(pad_to(keys, cap, PAD_KEY)),
-            jnp.asarray(pad_to(worlds, cap, NO_WORLD)),
-            jnp.asarray(pad_to(xyz, cap, np.int64(-(2**62)))),
-            jnp.asarray(pad_to(peers, cap, np.int32(-1))),
+        # 2. delta device twin: upload new rows, scatter tombstones,
+        # re-sort on device — O(churn) transfer
+        if self._delta_stale:
+            self._delta_stale = False
+            self._sync_delta()
+
+        # 3. compaction policy
+        threshold = self._compact_threshold()
+        dead_threshold = max(
+            4096, self._bk.size // self.COMPACT_DEAD_FRACTION
         )
+        if self._delta_live > self.SYNC_COMPACT_FACTOR * threshold:
+            self._compact_sync()
+        elif (
+            (self._delta_live > threshold or self._base_dead > dead_threshold)
+            and self._compaction is None
+            and (self._base_dead or self._delta_live)
+        ):
+            self._start_compaction()
+
+    def _sync_delta(self) -> None:
+        """Bring the device delta twin up to date with the host log.
+        Transfers only the NEW rows chunk + tombstone indices; the
+        key-sort runs on device (one fused launch per flush)."""
+        dn = self._dn
+        if dn == 0:
+            self._delta_buf = None
+            self._delta_buf_cap = 0
+            self._delta_built_n = 0
+            self._delta_bundle = None
+            self._pending_delta_dead.clear()
+            return
+
+        built = self._delta_built_n
+        chunk_n = next_pow2(dn - built, 8) if dn > built else 0
+        cap_needed = next_pow2(max(dn, built + chunk_n), 1024)
+        if self._delta_buf is None:
+            self._delta_buf = self._alloc_delta_buffer(cap_needed)
+            self._delta_buf_cap = cap_needed
+        elif cap_needed > self._delta_buf_cap:
+            self._delta_buf = self._grow_delta_buffer(
+                self._delta_buf, cap_needed
+            )
+            self._delta_buf_cap = cap_needed
+
+        if dn > built:
+            chunk = (
+                pad_to(self._dk[built:dn], chunk_n, PAD_KEY),
+                pad_to(self._dw[built:dn], chunk_n, NO_WORLD),
+                pad_to(self._dxyz[built:dn], chunk_n, _XYZ_PAD),
+                pad_to(self._dp[built:dn], chunk_n, np.int32(-1)),
+            )
+            self._delta_buf = self._write_delta_chunk(
+                self._delta_buf, chunk, built
+            )
+            self._delta_built_n = dn
+
+        if self._pending_delta_dead:
+            rows = np.asarray(self._pending_delta_dead, np.int32)
+            rows = pad_to(rows, next_pow2(rows.size),
+                          np.int32(self._delta_buf_cap))
+            self._delta_buf = (
+                *self._delta_buf[:3],
+                self._scatter_delta_dead(self._delta_buf[3], rows),
+            )
+            self._pending_delta_dead.clear()
+
+        self._delta_k = next_pow2(self._delta_max_run, 8)
+        self._delta_bundle = {
+            "dev": self._sort_delta(self._delta_buf),
+            "cap": self._delta_buf_cap,
+        }
+
+    # -- delta device-op seams (sharded backend overrides with
+    # replicated shardings) --
+
+    def _alloc_delta_buffer(self, cap: int) -> tuple:
+        return _alloc_buffers(cap)
+
+    def _grow_delta_buffer(self, bufs: tuple, cap: int) -> tuple:
+        return _grow_buffers(bufs, cap)
+
+    def _write_delta_chunk(self, bufs: tuple, chunk: tuple, start: int):
+        return _write_chunk(bufs, chunk, np.int32(start))
+
+    def _scatter_delta_dead(self, peer_buf, rows: np.ndarray):
+        return _scatter_dead(peer_buf, rows)
+
+    def _sort_delta(self, bufs: tuple) -> tuple:
+        return _sort_segment_dev(*bufs)
+
+    def _compact_sync(self) -> None:
+        if self._compaction is not None:
+            self._abandon_compaction()
+        self._rebuild_base_with(
+            np.empty(0, np.int64), np.empty(0, np.int32),
+            np.empty((0, 3), np.int64), np.empty(0, np.int64),
+        )
+        self.compactions += 1
+        # the rebuild marked dirty; complete the flush for the new state
+        self._dirty = False
+        self._pending_dead.clear()
+        self._delta_stale = False
+        self._delta_bundle = None
+
+    def _start_compaction(self) -> None:
+        """Fold base + device-resident delta into a fresh base on a
+        worker thread. The DEVICE side sorts its own resident arrays —
+        zero host→device transfer; the host applies the identical
+        stable transform to its numpy mirror so row indices stay
+        aligned. Must run right after ``_sync_delta`` (flush order), so
+        device state == host state up to ``_delta_built_n``."""
+        consumed = self._delta_built_n
+        snap = {
+            "bk": self._bk, "bw": self._bw, "bxyz": self._bxyz,
+            "bp": self._bp.copy(),
+            "dk": self._dk[:consumed].copy(),
+            "dw": self._dw[:consumed].copy(),
+            "dxyz": self._dxyz[:consumed].copy(),
+            "dp": self._dp[:consumed].copy(),
+            "delta_cap": self._delta_buf_cap,
+            "base_bundle": self._base_bundle,
+            "delta_buf": self._delta_buf,
+        }
+        state = {
+            "done": threading.Event(),
+            "epoch": self._epoch,
+            "consumed_dn": consumed,
+            "result": None,
+        }
+
+        def work():
+            state["result"] = self._compact_work(snap)
+            state["done"].set()
+
+        state["thread"] = threading.Thread(
+            target=work, name="index-compaction", daemon=True
+        )
+        self._compaction = state
+        self._replay = []
+        state["thread"].start()
+
+    def _compact_work(self, snap: dict) -> tuple:
+        """Build the compacted base: host mirror (numpy) + device twin.
+        Runs off the owning thread; touches only the snapshot."""
+        # host mirror: full-capacity views matching the device layout
+        dcap = snap["delta_cap"]
+        dk = pad_to(snap["dk"], dcap, PAD_KEY)
+        dw = pad_to(snap["dw"], dcap, NO_WORLD)
+        dxyz = pad_to(snap["dxyz"], dcap, _XYZ_PAD)
+        dp = pad_to(snap["dp"], dcap, np.int32(-1))
+        keys = np.concatenate([snap["bk"], dk])
+        wids = np.concatenate([snap["bw"], dw])
+        xyz = np.concatenate([snap["bxyz"], dxyz])
+        peers = np.concatenate([snap["bp"], dp])
+        keys = np.where(peers < 0, PAD_KEY, keys)
+        live_total = int((peers >= 0).sum())
+        if live_total == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int32),
+                    np.empty((0, 3), np.int64), np.empty(0, np.int32),
+                    1, None, 0)
+        cap2 = next_pow2(live_total)
+        order = np.argsort(keys, kind="stable")[:cap2]
+        hk, hw, hx, hp = keys[order], wids[order], xyz[order], peers[order]
+        k = next_pow2(_max_run(hk[:live_total]), 8)
+        bundle = self._compact_device(snap, cap2, (hk, hw, hx, hp), k)
+        return (hk, hw, hx, hp, k, bundle, live_total)
+
+    def _compact_device(self, snap: dict, cap2: int, host_arrays, k) -> dict:
+        """Device side of compaction. Single-chip: fold the resident
+        arrays in place (no transfer). Falls back to uploading the host
+        mirror when base or delta has no device twin yet."""
+        base = snap["base_bundle"]
+        dbuf = snap["delta_buf"]
+        if base is not None and dbuf is not None:
+            dev = _device_compact(*base["dev"], *dbuf, cap2=cap2)
+            return {"dev": dev, "cap": cap2}
+        if base is not None and dbuf is None:
+            dev = _device_compact(
+                *base["dev"], *_alloc_buffers(8), cap2=cap2
+            )
+            return {"dev": dev, "cap": cap2}
+        return self._upload_base(*host_arrays, k)
+
+    def wait_compaction(self) -> None:
+        """Block until no compaction is in flight (tests, benchmarks,
+        shutdown). The post-swap flush may start a follow-up compaction
+        over the delta tail; loop until quiescent."""
+        while self._compaction is not None:
+            self._compaction["done"].wait()
+            self._swap_compaction()
+            self._dirty = True
+            self.flush()
+
+    def _swap_compaction(self) -> None:
+        state = self._compaction
+        self._compaction = None
+        if state["epoch"] != self._epoch:
+            return  # a reseed/sync rebuild superseded this run
+        keys, wids, xyz, pids, k, bundle, live_total = state["result"]
+        self._bk, self._bw, self._bxyz, self._bp = keys, wids, xyz, pids
+        self._base_k = k
+        self._base_bundle = bundle
+        self._base_live = live_total
+        self._base_dead = 0
+        self._pending_dead = []
+        self.compactions += 1
+
+        # replay removals that touched snapshot rows
+        if self._replay:
+            for key, pid in self._replay:
+                lo, hi = self._base_run(key)
+                j = np.flatnonzero(self._bp[lo:hi] == pid)
+                if j.size:
+                    row = lo + int(j[0])
+                    self._bp[row] = -1
+                    self._pending_dead.append(row)
+                    self._base_dead += 1
+                    self._base_live -= 1
+            self._replay = []
+
+        # shift the unconsumed delta tail to the front; the device
+        # buffer restarts from scratch (the tail is small — rows added
+        # while the compaction ran)
+        consumed = state["consumed_dn"]
+        rem = self._dn - consumed
+        if rem:
+            self._dk[:rem] = self._dk[consumed:self._dn]
+            self._dw[:rem] = self._dw[consumed:self._dn]
+            self._dxyz[:rem] = self._dxyz[consumed:self._dn]
+            self._dp[:rem] = self._dp[consumed:self._dn]
+        self._dn = rem
+        self._delta_live = int((self._dp[:rem] >= 0).sum())
+        self._delta_index = {
+            (int(self._dk[r]), int(self._dp[r])): r
+            for r in range(rem) if self._dp[r] >= 0
+        }
+        keyrow: dict[int, int] = {}
+        kc: Counter = Counter()
+        for r in range(rem):
+            key = int(self._dk[r])
+            keyrow.setdefault(key, r)
+            kc[key] += 1
+        self._delta_keyrow = keyrow
+        self._delta_key_count = kc
+        self._delta_max_run = max(kc.values(), default=1)
+        self._delta_buf = None
+        self._delta_buf_cap = 0
+        self._delta_built_n = 0
+        self._pending_delta_dead = []
+        self._delta_bundle = None
+        self._delta_stale = True
+        self._dirty = True
+
+    def _abandon_compaction(self) -> None:
+        """Invalidate an in-flight compaction (reseed/sync rebuild is
+        about to replace the base wholesale)."""
+        self._epoch += 1
+        self._compaction = None
+        self._replay = []
+
+    def _install_base(self, keys, wids, xyz, pids) -> None:
+        """Install a freshly sorted base from live rows (bulk load /
+        reseed), padding host arrays to the device capacity so host row
+        indices always mirror the device layout."""
+        self._epoch += 1
+        n = int(keys.size)
+        self._base_live = n
+        self._base_dead = 0
+        self._base_k = next_pow2(_max_run(keys), 8) if n else 1
+        if n:
+            cap = next_pow2(n)
+            self._bk = pad_to(keys, cap, PAD_KEY)
+            self._bw = pad_to(wids.astype(np.int32, copy=False), cap, NO_WORLD)
+            self._bxyz = pad_to(xyz, cap, _XYZ_PAD)
+            self._bp = pad_to(pids.astype(np.int32, copy=False), cap,
+                              np.int32(-1))
+            self._base_bundle = self._upload_base(
+                self._bk, self._bw, self._bxyz, self._bp, self._base_k
+            )
+        else:
+            self._bk = np.empty(0, np.int64)
+            self._bw = np.empty(0, np.int32)
+            self._bxyz = np.empty((0, 3), np.int64)
+            self._bp = np.empty(0, np.int32)
+            self._base_bundle = None
+        self._pending_dead = []
+        self._replay = []
+
+    def _clear_delta(self) -> None:
+        self._dn = 0
+        self._delta_live = 0
+        self._delta_index = {}
+        self._delta_keyrow = {}
+        self._delta_key_count = Counter()
+        self._delta_max_run = 1
+        self._delta_buf = None
+        self._delta_buf_cap = 0
+        self._delta_built_n = 0
+        self._pending_delta_dead = []
+        self._delta_bundle = None
+        self._delta_stale = False
+
+    # endregion
+
+    # region: device upload seams (overridden by the sharded backend)
+
+    def _upload_base(self, keys, wids, xyz, pids, k) -> dict:
+        cap = next_pow2(keys.size)
+        return {
+            "dev": (
+                jnp.asarray(pad_to(keys, cap, PAD_KEY)),
+                jnp.asarray(pad_to(wids, cap, NO_WORLD)),
+                jnp.asarray(pad_to(xyz, cap, _XYZ_PAD)),
+                jnp.asarray(pad_to(pids.astype(np.int32), cap, np.int32(-1))),
+            ),
+            "cap": cap,
+        }
+
+    _upload_delta = _upload_base
+
+    def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
+        dev = bundle["dev"]
+        cap = bundle["cap"]
+        padded = pad_to(rows, next_pow2(rows.size), np.int32(cap))
+        return {**bundle, "dev": (*dev[:3], _scatter_dead(dev[3], padded))}
 
     # endregion
 
     # region: batched hot path
+
+    def _segments(self):
+        """→ (device array tuples, K per segment, segment kinds). Kinds
+        matter to the sharded backend: the base is space-sharded, the
+        delta replicated."""
+        segs, ks, kinds = [], [], []
+        if self._base_bundle is not None:
+            segs.append(self._base_bundle["dev"])
+            ks.append(self._base_k)
+            kinds.append("base")
+        if self._delta_bundle is not None:
+            segs.append(self._delta_bundle["dev"])
+            ks.append(self._delta_k)
+            kinds.append("delta")
+        return segs, tuple(ks), tuple(kinds)
 
     def match_arrays(
         self,
@@ -326,7 +1281,8 @@ class TpuSpatialBackend(CpuSpatialBackend):
         overlap)."""
         self.flush()
         m = len(world_ids)
-        if self._dev is None or m == 0:
+        segs, ks, kinds = self._segments()
+        if not segs or m == 0:
             return m, None
 
         cubes = cube_coords_batch(positions, self.cube_size)
@@ -341,11 +1297,15 @@ class TpuSpatialBackend(CpuSpatialBackend):
             pad_to(repls.astype(np.int8), cap, np.int8(0)),
         )
         if csr_cap is not None:
-            result = self._dispatch_csr(queries, next_pow2(csr_cap))
+            result = self._dispatch_csr(
+                queries, segs, ks, kinds, next_pow2(csr_cap)
+            )
         elif max_hits is not None:
-            result = self._dispatch_sparse(queries, next_pow2(max_hits))
+            result = self._dispatch_sparse(
+                queries, segs, ks, kinds, next_pow2(max_hits)
+            )
         else:
-            result = (self._dispatch(queries),)
+            result = (self._dispatch(queries, segs, ks, kinds),)
         # Enqueue D2H now: by the time a pipelined caller reads the
         # result, the copy has landed — the read costs no round-trip.
         for r in result:
@@ -359,18 +1319,21 @@ class TpuSpatialBackend(CpuSpatialBackend):
         their batch-axis divisibility."""
         return next_pow2(m)
 
-    def _dispatch(self, queries: tuple):
-        """Run the padded query arrays against the device mirror. Numpy
-        args go straight into the jitted call so all five H2D transfers
-        ride one dispatch — on tunneled/remote devices per-array
-        ``device_put`` round-trips dominate otherwise."""
-        return _match_kernel(*self._dev, *queries, k=self._k)
+    def _dispatch(self, queries: tuple, segs, ks, kinds):
+        """Run the padded query arrays against the device segments.
+        Numpy args go straight into the jitted call so all H2D
+        transfers ride one dispatch — on tunneled/remote devices
+        per-array ``device_put`` round-trips dominate otherwise."""
+        flat = [a for seg in segs for a in seg]
+        return _match_dense_kernel(*flat, *queries, ks=ks)
 
-    def _dispatch_sparse(self, queries: tuple, c: int):
-        return _match_kernel_sparse(*self._dev, *queries, k=self._k, c=c)
+    def _dispatch_sparse(self, queries: tuple, segs, ks, kinds, c: int):
+        flat = [a for seg in segs for a in seg]
+        return _match_sparse_kernel(*flat, *queries, ks=ks, c=c)
 
-    def _dispatch_csr(self, queries: tuple, t_cap: int):
-        return _match_kernel_csr(*self._dev, *queries, k=self._k, t_cap=t_cap)
+    def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
+        flat = [a for seg in segs for a in seg]
+        return _match_csr_kernel(*flat, *queries, ks=ks, t_cap=t_cap)
 
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
@@ -426,17 +1389,114 @@ class TpuSpatialBackend(CpuSpatialBackend):
 
     # endregion
 
-    # region: introspection
+    # region: point queries (host authority)
+
+    def query_cube(self, world: str, pos: Vector3 | Cube) -> set[uuid_mod.UUID]:
+        cube = to_cube(pos, self.cube_size)
+        wid = self._world_ids.get(world)
+        if wid is None:
+            return set()
+        key = self._key_of(wid, cube)
+        out: set[uuid_mod.UUID] = set()
+        try:
+            lo, hi = self._base_run(key)
+            if lo < hi and (
+                self._bw[lo] == wid
+                and self._bxyz[lo, 0] == cube[0]
+                and self._bxyz[lo, 1] == cube[1]
+                and self._bxyz[lo, 2] == cube[2]
+            ):
+                for pid in self._bp[lo:hi]:
+                    if pid >= 0:
+                        out.add(self._peer_list[pid])
+            drow = self._delta_keyrow.get(key)
+            if drow is not None and (
+                self._dw[drow] == wid
+                and not (self._dxyz[drow] != np.asarray(cube)).any()
+            ):
+                rows = np.flatnonzero(self._dk[:self._dn] == key)
+                for r in rows:
+                    pid = self._dp[r]
+                    if pid >= 0:
+                        out.add(self._peer_list[pid])
+        except _CollisionError:  # pragma: no cover — defensive
+            pass
+        return out
+
+    def query_world(self, world: str) -> set[uuid_mod.UUID]:
+        wid = self._world_ids.get(world)
+        if wid is None:
+            return set()
+        return {self._peer_list[pid] for pid in self._world_peers[wid]}
+
+    # endregion
+
+    # region: introspection (tests, metrics)
+
+    def world_names(self) -> list[str]:
+        return list(self._world_ids.keys())
+
+    def cube_count(self, world: str) -> int:
+        wid = self._world_ids.get(world)
+        if wid is None:
+            return 0
+        live_b = (self._bp >= 0) & (self._bw == wid)
+        live_d = (self._dp[:self._dn] >= 0) & (self._dw[:self._dn] == wid)
+        return int(np.unique(np.concatenate([
+            self._bk[live_b], self._dk[:self._dn][live_d]
+        ])).size)
+
+    def subscription_count(self) -> int:
+        return self._base_live + self._delta_live
 
     def device_stats(self) -> dict:
         return {
-            "subscriptions": self._n_subs,
-            "capacity": 0 if self._dev is None else int(self._dev[0].shape[0]),
-            "max_fanout_k": self._k,
+            "subscriptions": self.subscription_count(),
+            "capacity": (
+                (0 if self._base_bundle is None else self._base_bundle["cap"])
+                + (0 if self._delta_bundle is None
+                   else self._delta_bundle["cap"])
+            ),
+            "max_fanout_k": self._base_k + (
+                self._delta_k if self._delta_bundle is not None else 0
+            ),
             "worlds": len(self._world_ids),
             "peers": len(self._peer_list),
             "hash_seed": self._seed,
             "dirty": self._dirty,
+            "base_rows": int(self._bk.size),
+            "base_dead": self._base_dead,
+            "delta_rows": self._dn,
+            "delta_live": self._delta_live,
+            "compactions": self.compactions,
+            "compaction_in_flight": self._compaction is not None,
         }
 
     # endregion
+
+
+# --------------------------------------------------------------------
+# Host helpers
+# --------------------------------------------------------------------
+
+
+def _sort_segment(keys, wids, xyz, pids):
+    """Stable key-sort of a row set → contiguous cube runs."""
+    order = np.argsort(keys, kind="stable")
+    return (
+        np.ascontiguousarray(keys[order]),
+        np.ascontiguousarray(wids[order].astype(np.int32, copy=False)),
+        np.ascontiguousarray(xyz[order]),
+        np.ascontiguousarray(pids[order].astype(np.int32, copy=False)),
+    )
+
+
+def _max_run(sorted_keys: np.ndarray) -> int:
+    """Longest equal-key run in a sorted key array (max cube occupancy
+    → the gather degree K)."""
+    n = sorted_keys.size
+    if n == 0:
+        return 1
+    starts = np.flatnonzero(np.diff(sorted_keys) != 0) + 1
+    bounds = np.concatenate([[0], starts, [n]])
+    return int(np.diff(bounds).max())
