@@ -191,8 +191,12 @@ def _write_chunk(bufs, chunks, start):
     """Append a host chunk into the persistent insertion-order delta
     buffer at ``start`` (traced scalar — no recompile per position).
     The only per-tick H2D transfer is the chunk itself."""
+    # Every index must share ``start``'s dtype: a Python-int 0 would
+    # weak-type to int64 under x64 and dynamic_update_slice rejects
+    # mixed index dtypes.
+    zero = jnp.zeros_like(start)
     return tuple(
-        jax.lax.dynamic_update_slice(b, c, (start,) + (0,) * (b.ndim - 1))
+        jax.lax.dynamic_update_slice(b, c, (start,) + (zero,) * (b.ndim - 1))
         for b, c in zip(bufs, chunks)
     )
 
