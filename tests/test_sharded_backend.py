@@ -108,3 +108,83 @@ def test_make_fanout_mesh_validation():
         make_fanout_mesh(4, 4)  # 16 > 8
     mesh = make_fanout_mesh(2)
     assert mesh.shape == {"batch": 2, "space": 4}
+
+
+def test_sharded_repeated_compaction_churn():
+    """≥2 background compactions against a POPULATED device-resident
+    base (regression: the second compaction used to rank-mismatch the
+    [n_space, cap] base stacks against the flat delta buffer, killing
+    the worker and wedging wait_compaction forever)."""
+    _require_devices(8)
+    mesh = make_fanout_mesh(2, 4)
+    rng = random.Random(7)
+    cpu = CpuSpatialBackend(16)
+    b = ShardedTpuSpatialBackend(16, mesh, compact_threshold=64)
+    peers = [uuid.uuid4() for _ in range(64)]
+
+    def rand_pos():
+        return Vector3(
+            rng.uniform(-300, 300), rng.uniform(-300, 300), rng.uniform(-300, 300)
+        )
+
+    for _ in range(4):
+        for _ in range(200):
+            w = f"w{rng.randrange(3)}"
+            p, pos = rng.choice(peers), rand_pos()
+            assert cpu.add_subscription(w, p, pos) == b.add_subscription(w, p, pos)
+            if rng.random() < 0.2:
+                w2, p2, pos2 = f"w{rng.randrange(3)}", rng.choice(peers), rand_pos()
+                assert cpu.remove_subscription(w2, p2, pos2) == b.remove_subscription(
+                    w2, p2, pos2
+                )
+        b.flush()
+        b.wait_compaction()
+
+    assert b.compactions >= 2, b.device_stats()
+    assert b.compaction_failures == 0
+
+    queries = [
+        LocalQuery(f"w{rng.randrange(3)}", rand_pos(), rng.choice(peers))
+        for _ in range(64)
+    ]
+    for c, t in zip(cpu.match_local_batch(queries), b.match_local_batch(queries)):
+        assert set(c) == set(t)
+
+
+def test_compaction_worker_failure_surfaces_and_recovers():
+    """A worker exception must not wedge the backend: wait_compaction
+    raises (instead of hanging), flush keeps serving, and once the
+    fault clears the next compaction succeeds."""
+    _require_devices(8)
+    mesh = make_fanout_mesh(2, 4)
+    b = ShardedTpuSpatialBackend(16, mesh, compact_threshold=8)
+    sender = uuid.uuid4()
+    peers = [uuid.uuid4() for _ in range(32)]
+    pos = Vector3(5, 5, 5)
+
+    real_work = b._compact_work
+    b._compact_work = lambda snap: (_ for _ in ()).throw(RuntimeError("boom"))
+
+    for p in peers[:16]:
+        b.add_subscription(W, p, pos)
+    b.flush()  # starts the (doomed) background compaction
+    assert b._compaction is not None
+    with pytest.raises(RuntimeError):
+        b.wait_compaction()
+    assert b._compaction is None
+    assert b.compaction_failures == 1
+
+    # still serving, and the host authority never corrupted
+    assert set(b.match_local_batch([LocalQuery(W, pos, sender)])[0]) == set(peers[:16])
+
+    # fault clears → a quiet flush (NO new mutations) must still retry
+    b._compact_work = real_work
+    b.flush()
+    assert b._compaction is not None, "failed compaction not re-armed"
+    b.wait_compaction()
+    for p in peers[16:]:
+        b.add_subscription(W, p, pos)
+    b.flush()
+    b.wait_compaction()
+    assert b.compactions >= 1
+    assert set(b.match_local_batch([LocalQuery(W, pos, sender)])[0]) == set(peers)

@@ -40,6 +40,11 @@ from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to
 from ..spatial.tpu_backend import (
     TpuSpatialBackend,
     _XYZ_PAD,
+    _alloc_buffers,
+    _grow_buffers,
+    _scatter_dead,
+    _sort_segment_dev,
+    _write_chunk,
     compact_csr,
     compact_sparse,
     match_core,
@@ -126,20 +131,54 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             "shard_cap": cap,
         }
 
-    def _upload_delta(self, keys, wids, xyz, pids, k) -> dict:
-        cap = next_pow2(keys.size)
-        rep = self._sharding()
-        return {
-            "dev": (
-                jax.device_put(pad_to(keys, cap, PAD_KEY), rep),
-                jax.device_put(pad_to(wids, cap, NO_WORLD), rep),
-                jax.device_put(pad_to(xyz, cap, _XYZ_PAD), rep),
-                jax.device_put(
-                    pad_to(pids.astype(np.int32), cap, np.int32(-1)), rep
-                ),
-            ),
-            "cap": cap,
-        }
+    def _compact_device(self, snap: dict, cap2: int, host_arrays, k) -> dict:
+        """Mesh-aware compaction: the resident base is a [n_space, cap]
+        per-shard stack while the delta is flat, and the folded index
+        needs fresh run-boundary split points — which only the host
+        mirror (already folded by ``_compact_work``'s identical stable
+        transform) knows. So re-shard from the host: ``_upload_base``
+        recomputes the splits and lays out new space-sharded stacks.
+        Runs on the compaction worker thread, so the upload never
+        touches the owning event loop."""
+        hk, hw, hx, hp = host_arrays
+        return self._upload_base(hk, hw, hx, hp, k)
+
+    # -- delta seams: the delta segment is replicated across the mesh,
+    # so allocate/write/sort with explicit replicated out_shardings —
+    # otherwise the buffers commit to device 0 and every dispatch
+    # re-transfers them to the other shards. --
+
+    def _rep_kernel(self, name: str, fn, static=(), spec=()):
+        kernel = self._kernels.get(name)
+        if kernel is None:
+            kernel = self._kernels[name] = jax.jit(
+                fn, static_argnames=static,
+                out_shardings=self._sharding(*spec),
+            )
+        return kernel
+
+    def _alloc_delta_buffer(self, cap: int) -> tuple:
+        return self._rep_kernel("alloc_delta", _alloc_buffers, ("cap",))(
+            cap=cap
+        )
+
+    def _grow_delta_buffer(self, bufs: tuple, cap: int) -> tuple:
+        return self._rep_kernel("grow_delta", _grow_buffers, ("cap",))(
+            bufs, cap=cap
+        )
+
+    def _write_delta_chunk(self, bufs: tuple, chunk: tuple, start: int):
+        return self._rep_kernel("write_delta", _write_chunk)(
+            bufs, chunk, np.int32(start)
+        )
+
+    def _scatter_delta_dead(self, peer_buf, rows: np.ndarray):
+        return self._rep_kernel("scatter_delta", _scatter_dead)(
+            peer_buf, rows
+        )
+
+    def _sort_delta(self, bufs: tuple) -> tuple:
+        return self._rep_kernel("sort_delta", _sort_segment_dev)(*bufs)
 
     def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
         """Map global sorted-row indices → (shard, local) and tombstone
@@ -152,12 +191,11 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         shard = pad_to(shard.astype(np.int32), pad_n, np.int32(self.n_space))
         local = pad_to(local.astype(np.int32), pad_n, np.int32(cap))
         dev = bundle["dev"]
-        kernel = self._kernels.get("scatter")
-        if kernel is None:
-            kernel = self._kernels["scatter"] = jax.jit(
-                lambda peer, s, l: peer.at[s, l].set(-1, mode="drop"),
-                out_shardings=self._sharding("space", None),
-            )
+        kernel = self._rep_kernel(
+            "scatter",
+            lambda peer, s, l: peer.at[s, l].set(-1, mode="drop"),
+            spec=("space", None),
+        )
         return {**bundle, "dev": (*dev[:3], kernel(dev[3], shard, local))}
 
     # endregion

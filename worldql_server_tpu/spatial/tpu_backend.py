@@ -45,6 +45,7 @@ TPU fast-math cannot perturb grid assignment.
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid as uuid_mod
 from collections import Counter
@@ -61,6 +62,8 @@ from ..protocol.types import Replication, Vector3
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
 from .hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
 from .quantize import cube_coords_batch
+
+_log = logging.getLogger(__name__)
 
 _REPL_EXCEPT = np.int8(int(Replication.EXCEPT_SELF))
 _REPL_ONLY = np.int8(int(Replication.ONLY_SELF))
@@ -327,6 +330,7 @@ class TpuSpatialBackend(SpatialBackend):
         self._epoch = 0
 
         self.compactions = 0
+        self.compaction_failures = 0
 
     # region: interning
 
@@ -884,7 +888,9 @@ class TpuSpatialBackend(SpatialBackend):
         """Make all prior mutations visible to device queries. Cost is
         O(churn since last flush) plus, rarely, a compaction."""
         if self._compaction is not None and self._compaction["done"].is_set():
-            self._swap_compaction()
+            err = self._swap_compaction()
+            if err is not None:
+                _log.warning("background compaction failed, will retry: %s", err)
         if not self._dirty:
             return
         self._dirty = False
@@ -1024,11 +1030,19 @@ class TpuSpatialBackend(SpatialBackend):
             "epoch": self._epoch,
             "consumed_dn": consumed,
             "result": None,
+            "error": None,
         }
 
         def work():
-            state["result"] = self._compact_work(snap)
-            state["done"].set()
+            # done must be set on EVERY exit: an unset event would wedge
+            # wait_compaction forever and block future compactions (the
+            # guard requires _compaction is None).
+            try:
+                state["result"] = self._compact_work(snap)
+            except BaseException as exc:  # noqa: BLE001 — surfaced at swap
+                state["error"] = exc
+            finally:
+                state["done"].set()
 
         state["thread"] = threading.Thread(
             target=work, name="index-compaction", daemon=True
@@ -1082,18 +1096,34 @@ class TpuSpatialBackend(SpatialBackend):
     def wait_compaction(self) -> None:
         """Block until no compaction is in flight (tests, benchmarks,
         shutdown). The post-swap flush may start a follow-up compaction
-        over the delta tail; loop until quiescent."""
+        over the delta tail; loop until quiescent. A failed compaction
+        raises here (a silent retry could spin this loop forever)."""
         while self._compaction is not None:
             self._compaction["done"].wait()
-            self._swap_compaction()
+            err = self._swap_compaction()
+            if err is not None:
+                raise RuntimeError("background compaction failed") from err
             self._dirty = True
             self.flush()
 
-    def _swap_compaction(self) -> None:
+    def _swap_compaction(self) -> BaseException | None:
+        """Install a finished compaction; returns the worker's error, if
+        any. On failure the host authority is untouched (the worker only
+        reads its snapshot), so recovery is: drop the attempt and let
+        the flush policy retry in the background — a persistent failure
+        eventually overruns the delta and surfaces synchronously on the
+        owning thread via ``_compact_sync``."""
         state = self._compaction
         self._compaction = None
         if state["epoch"] != self._epoch:
-            return  # a reseed/sync rebuild superseded this run
+            return None  # a reseed/sync rebuild superseded this run
+        if state["error"] is not None:
+            self._replay = []
+            self.compaction_failures += 1
+            # Re-arm the flush policy step: with no new mutations an
+            # un-dirty flush would early-return and never retry.
+            self._dirty = True
+            return state["error"]
         keys, wids, xyz, pids, k, bundle, live_total = state["result"]
         self._bk, self._bw, self._bxyz, self._bp = keys, wids, xyz, pids
         self._base_k = k
@@ -1213,8 +1243,6 @@ class TpuSpatialBackend(SpatialBackend):
             ),
             "cap": cap,
         }
-
-    _upload_delta = _upload_base
 
     def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
         dev = bundle["dev"]
@@ -1473,6 +1501,7 @@ class TpuSpatialBackend(SpatialBackend):
             "delta_rows": self._dn,
             "delta_live": self._delta_live,
             "compactions": self.compactions,
+            "compaction_failures": self.compaction_failures,
             "compaction_in_flight": self._compaction is not None,
         }
 
