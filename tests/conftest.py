@@ -4,10 +4,9 @@ TPU hardware.
 
 This environment auto-imports jax at interpreter startup (an `axon`
 plugin .pth hook), so JAX_PLATFORMS/JAX_PLATFORM_NAME set here are too
-late and ignored. `jax.config.update` after import still works, and
-XLA_FLAGS is only read at (lazy) backend initialization — so set the
-flag, then override the platform via config before any test touches a
-device.
+late and ignored. XLA_FLAGS is only read at (lazy) backend
+initialization — so set the flag here, then let jaxconf's shared env
+sniffing switch the platform to cpu before any test touches a device.
 """
 
 import os
@@ -18,6 +17,4 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platform_name", "cpu")
+from worldql_server_tpu.spatial import jaxconf  # noqa: E402,F401

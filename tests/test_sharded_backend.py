@@ -174,14 +174,17 @@ def test_compaction_worker_failure_surfaces_and_recovers():
     assert b._compaction is None
     assert b.compaction_failures == 1
 
-    # still serving, and the host authority never corrupted
-    assert set(b.match_local_batch([LocalQuery(W, pos, sender)])[0]) == set(peers[:16])
-
-    # fault clears → a quiet flush (NO new mutations) must still retry
+    # fault clears → a quiet flush (NO new mutations) must still retry.
+    # Restore BEFORE any query: match_local_batch flushes internally and
+    # would re-arm a doomed run racing the restore below.
     b._compact_work = real_work
     b.flush()
     assert b._compaction is not None, "failed compaction not re-armed"
     b.wait_compaction()
+
+    # still serving, and the host authority never corrupted
+    assert set(b.match_local_batch([LocalQuery(W, pos, sender)])[0]) == set(peers[:16])
+
     for p in peers[16:]:
         b.add_subscription(W, p, pos)
     b.flush()

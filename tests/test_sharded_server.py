@@ -1,0 +1,173 @@
+"""End-to-end: the SERVER running the sharded multi-chip backend
+(spatial_backend='sharded' in Config, mesh built by build_backend) on
+the 8-device virtual CPU mesh, driven by real WebSocket clients through
+the tick batcher — BASELINE config-4's shape through the product, not
+the bench harness.
+"""
+
+import asyncio
+
+import pytest
+
+from tests.client_util import WsClient, free_port
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.server import WorldQLServer, build_backend
+from worldql_server_tpu.parallel import ShardedTpuSpatialBackend
+from worldql_server_tpu.protocol import Instruction, Message, Replication, Vector3
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _require_devices(n: int):
+    import jax
+
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def make_sharded_server(**overrides) -> WorldQLServer:
+    config = Config()
+    config.store_url = "memory://"
+    config.http_port = free_port()
+    config.ws_port = free_port()
+    config.zmq_enabled = False
+    config.spatial_backend = "sharded"
+    config.mesh_batch = 2
+    config.mesh_space = 4
+    config.tick_interval = 0.02
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return WorldQLServer(config)
+
+
+def test_build_backend_sharded_from_config():
+    _require_devices(8)
+    config = Config()
+    config.spatial_backend = "sharded"
+    config.mesh_batch = 2
+    config.mesh_space = 0  # auto: all remaining devices
+    config.validate()
+    backend = build_backend(config)
+    assert isinstance(backend, ShardedTpuSpatialBackend)
+    assert backend.n_batch == 2 and backend.n_space == 4
+
+
+def test_config_rejects_bad_mesh():
+    config = Config()
+    config.spatial_backend = "sharded"
+    config.mesh_batch = 0
+    with pytest.raises(ValueError):
+        config.validate()
+    config.mesh_batch = 1
+    config.mesh_space = -1
+    with pytest.raises(ValueError):
+        config.validate()
+
+
+def test_sharded_server_ws_fanout_through_ticker():
+    """Multi-world fan-out through the full product stack: WS transport
+    → router → tick batcher → sharded mesh backend → broadcast."""
+    _require_devices(8)
+
+    async def scenario():
+        server = make_sharded_server()
+        assert isinstance(server.backend, ShardedTpuSpatialBackend)
+        assert server.ticker is not None
+        await server.start()
+        try:
+            sender = await WsClient.connect(server.config.ws_port)
+            subs = [await WsClient.connect(server.config.ws_port)
+                    for _ in range(4)]
+            worlds = ["alpha", "alpha", "beta", "beta"]
+            pos = Vector3(8.0, 8.0, 8.0)
+            for client, world in zip(subs, worlds):
+                await client.send(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    world_name=world, position=pos,
+                ))
+            await asyncio.sleep(0.2)
+
+            for i, world in enumerate(("alpha", "beta")):
+                await sender.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name=world, position=pos,
+                    parameter=f"msg-{world}",
+                    replication=Replication.EXCEPT_SELF,
+                ))
+            for client, world in zip(subs, worlds):
+                got = await client.recv_until(
+                    Instruction.LOCAL_MESSAGE, timeout=10
+                )
+                assert got.parameter == f"msg-{world}"
+                assert got.world_name == world
+
+            # disconnect cleanup flows into the mesh index
+            await subs[0].close()
+            await asyncio.sleep(0.3)
+            await sender.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="alpha", position=pos, parameter="after-drop",
+            ))
+            got = await subs[1].recv_until(Instruction.LOCAL_MESSAGE, timeout=10)
+            assert got.parameter == "after-drop"
+        finally:
+            await server.stop()
+        return True
+
+    assert run(scenario())
+
+
+def test_sharded_server_survives_churn_with_compaction():
+    """Server-mode churn: enough subscribe traffic to force background
+    compactions of the mesh index while the server keeps serving."""
+    _require_devices(8)
+
+    async def scenario():
+        server = make_sharded_server()
+        server.backend._compact_threshold_override = 64
+        await server.start()
+        try:
+            client = await WsClient.connect(server.config.ws_port)
+            listener = await WsClient.connect(server.config.ws_port)
+            probe = Vector3(4.0, 4.0, 4.0)
+            await listener.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="hot", position=probe,
+            ))
+            # Interleave probes with the churn: each probe rides a
+            # ticker flush, which is what arms/swaps compactions.
+            for i in range(300):
+                await client.send(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    world_name=f"w{i % 5}",
+                    position=Vector3(
+                        float((i * 37) % 500), 0.0, float((i * 91) % 500)
+                    ),
+                ))
+                if i % 50 == 49:
+                    await client.send(Message(
+                        instruction=Instruction.LOCAL_MESSAGE,
+                        world_name="hot", position=probe,
+                        parameter=f"probe-{i}",
+                    ))
+                    got = await listener.recv_until(
+                        Instruction.LOCAL_MESSAGE, timeout=10
+                    )
+                    assert got.parameter == f"probe-{i}"
+            server.backend.wait_compaction()
+            assert server.backend.compactions >= 1
+            assert server.backend.compaction_failures == 0
+
+            await client.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="hot", position=probe, parameter="still-alive",
+            ))
+            got = await listener.recv_until(Instruction.LOCAL_MESSAGE, timeout=10)
+            assert got.parameter == "still-alive"
+        finally:
+            await server.stop()
+        return True
+
+    assert run(scenario())

@@ -42,8 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zmq-server-port", type=int)
     p.add_argument("--zmq-timeout-secs", type=int)
     p.add_argument("--no-zmq", action="store_true")
-    p.add_argument("--spatial-backend", choices=["cpu", "tpu"])
+    p.add_argument("--spatial-backend", choices=["cpu", "tpu", "sharded"])
     p.add_argument("--tick-interval", type=float)
+    p.add_argument("--mesh-batch", type=int,
+                   help="sharded backend: data-parallel query axis size")
+    p.add_argument("--mesh-space", type=int,
+                   help="sharded backend: space-shard axis size (0 = rest)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -53,6 +57,7 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
+    "mesh_batch", "mesh_space",
 ]
 
 
@@ -84,6 +89,18 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print(f"config error: {exc}", file=sys.stderr)
         return 1
+
+    if config.spatial_backend == "sharded":
+        # Mesh construction can reject shapes validate() can't see
+        # (device count not divisible by mesh_batch); fail it as a
+        # config error rather than a traceback from server bring-up.
+        from .parallel.mesh import make_fanout_mesh
+
+        try:
+            make_fanout_mesh(config.mesh_batch, config.mesh_space or None)
+        except ValueError as exc:
+            print(f"config error: {exc}", file=sys.stderr)
+            return 1
 
     server = WorldQLServer(config)
     try:

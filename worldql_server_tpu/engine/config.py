@@ -84,7 +84,8 @@ class Config:
     verbose: int = 0
 
     # --- rebuild-specific knobs ------------------------------------
-    # 'cpu' | 'tpu' — which SpatialBackend answers proximity queries.
+    # 'cpu' | 'tpu' | 'sharded' — which SpatialBackend answers
+    # proximity queries ('sharded' = multi-chip over a device mesh).
     spatial_backend: str = field(
         default_factory=lambda: _env("WQL_SPATIAL_BACKEND", "cpu")
     )
@@ -92,6 +93,15 @@ class Config:
     # per message (reference-equivalent immediate semantics).
     tick_interval: float = field(
         default_factory=lambda: float(_env("WQL_TICK_INTERVAL", "0"))
+    )
+    # Device-mesh shape for spatial_backend='sharded': data-parallel
+    # query batch axis × space-sharded index axis. mesh_space=0 means
+    # "all remaining devices" (parallel/mesh.py).
+    mesh_batch: int = field(
+        default_factory=lambda: int(_env("WQL_MESH_BATCH", "1"))
+    )
+    mesh_space: int = field(
+        default_factory=lambda: int(_env("WQL_MESH_SPACE", "0"))
     )
 
     def validate(self) -> None:
@@ -140,10 +150,14 @@ class Config:
             else:
                 seen[port] = name
 
-        if self.spatial_backend not in ("cpu", "tpu"):
-            errors.append("spatial_backend must be 'cpu' or 'tpu'")
+        if self.spatial_backend not in ("cpu", "tpu", "sharded"):
+            errors.append("spatial_backend must be 'cpu', 'tpu' or 'sharded'")
         if self.tick_interval < 0:
             errors.append("tick_interval must be >= 0")
+        if self.mesh_batch <= 0:
+            errors.append("mesh_batch must be greater than 0")
+        if self.mesh_space < 0:
+            errors.append("mesh_space must be >= 0 (0 = all remaining devices)")
 
         if errors:
             raise ValueError("; ".join(errors))

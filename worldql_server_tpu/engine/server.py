@@ -29,6 +29,17 @@ def build_backend(config: Config) -> SpatialBackend:
         from ..spatial.tpu_backend import TpuSpatialBackend
 
         return TpuSpatialBackend(config.sub_region_size)
+    if config.spatial_backend == "sharded":
+        from ..parallel import ShardedTpuSpatialBackend, make_fanout_mesh
+
+        mesh = make_fanout_mesh(
+            config.mesh_batch, config.mesh_space or None
+        )
+        logger.info(
+            "sharded spatial backend on mesh batch=%d space=%d",
+            mesh.shape["batch"], mesh.shape["space"],
+        )
+        return ShardedTpuSpatialBackend(config.sub_region_size, mesh)
     return CpuSpatialBackend(config.sub_region_size)
 
 

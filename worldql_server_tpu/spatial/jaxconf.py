@@ -12,6 +12,19 @@ Import this module before any ``import jax`` in accelerated code.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Honor a virtual-CPU-mesh request (tests, multi-chip dry runs on hosts
+# without a TPU slice). The TPU plugin in this image registers itself
+# at interpreter startup via a .pth hook, so JAX_PLATFORMS from the
+# environment arrives too late to stop it — inspect the env here and
+# override via config before the first backend initialization.
+if (
+    "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+    or os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+):
+    jax.config.update("jax_platform_name", "cpu")
