@@ -1,22 +1,33 @@
-"""North-star benchmark: batched LocalMessage fan-out at 1M entities.
+"""BASELINE benchmark harness — all five load configs (BASELINE.md).
 
-Measures end-to-end per-tick latency of the device fan-out engine —
-host-side f64 quantization + key hashing, host→device transfer, the
-fused match kernel, and device→host result transfer — against the
-dict-based CPU reference backend resolving the identical queries
-(the reference's per-message architecture, SURVEY §3.2).
+Default (no --config) runs config 5, the north star: batched
+LocalMessage fan-out at 1M entities. Prints ONE JSON line on stdout:
 
-Workload (BASELINE config-5 shape): N subscriptions across 8 worlds,
-95% uniform over a ±800 box (≈1M cubes at size 16) + 5% Zipf-style
-hotspot in a ±40 box (dense cubes, large fan-outs); M queries per tick
-drawn from the same mixture.
-
-The engine runs pipelined (depth-8 double buffering, CSR-compacted
-results, async D2H) — the sustained per-tick time is the steady-state
-tick latency of a real deployment. Prints ONE JSON line on stdout:
   {"metric": "local_fanout_sustained_tick_ms", "value": ..., "unit": "ms",
-   "vs_baseline": <cpu_p99 / tpu_sustained>}
-Diagnostics go to stderr. Flags: --subs, --queries, --ticks, --quick.
+   "vs_baseline": <cpu_p99 / tpu_sustained>, "p50_ms_depth1": ...,
+   "p99_ms_depth1": ..., "p50_ms_depth2": ..., "p99_ms_depth2": ...,
+   "target_p99_ms": 5.0}
+
+The p50/p99 keys are per-tick dispatch→collect wall time at pipeline
+depth 1 (unpipelined: the honest request latency) and depth 2 (double
+buffered: the deployment shape) — the literal north-star metric, held
+against BASELINE's <5 ms budget. ``vs_baseline`` for config 5 is the
+CPU reference backend's p99 over our sustained tick (throughput
+advantage); for the latency-budget configs (1, 2, 3, 4) it is
+budget/actual, so > 1.0 means the budget is met.
+
+`--config N` selects a BASELINE config (one JSON line each):
+  1  256 WS clients echo loop through the REAL server on the CPU
+     backend — correctness oracle + CPU transport baseline
+     (metric: end-to-end delivery p99 vs the 5 ms budget)
+  2  10k random-walk clients, churn resubscribes + radius broadcast,
+     20 tick/s budget on the device backend
+  3  100k entities, fully-on-device kNN (k=32) tick, single chip
+  4  64 worlds x 10k clients on the mesh-sharded backend
+  5  1M-entity Zipf-hotspot fan-out (default)
+`--all` runs every config, one JSON line per config, config order.
+
+Diagnostics go to stderr. --quick shrinks every shape for smoke runs.
 """
 
 from __future__ import annotations
@@ -26,12 +37,30 @@ import json
 import sys
 import time
 import uuid as uuid_mod
+from collections import deque
 
 import numpy as np
 
 
+TARGET_P99_MS = 5.0  # BASELINE.md: p99 broadcast fan-out < 5 ms
+TICK_BUDGET_MS = 50.0  # BASELINE.md: 20 ticks/s
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+
+
+def pctl(samples_ms, q: float) -> float:
+    return float(np.percentile(np.asarray(samples_ms), q))
+
+
+# --------------------------------------------------------------------
+# shared workload generation (configs 2, 4, 5)
+# --------------------------------------------------------------------
 
 
 def make_positions(rng: np.random.Generator, n: int) -> np.ndarray:
@@ -71,31 +100,56 @@ def make_query_batch(rng, sub_positions, sub_world_ids, m: int):
     return world_ids, positions, senders.astype(np.int32), np.zeros(m, np.int8)
 
 
-def _drain(inflight, total_fanout, overflow, csr_cap):
-    m, (counts, flat, total) = inflight.popleft()
-    n = int(total)
-    if n > csr_cap:
-        overflow += 1
-    # Static-shape fetches, host-side trim (a device-side dynamic slice
-    # would recompile per distinct total).
+def _force(result) -> int:
+    """Materialize a CSR result triple on host; returns total fan-out."""
+    counts, flat, total = result
     np.asarray(counts)
     np.asarray(flat)
-    total_fanout += n
-    return total_fanout, overflow
+    return int(total)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--subs", type=int, default=1_000_000)
-    ap.add_argument("--queries", type=int, default=16_384)
-    ap.add_argument("--ticks", type=int, default=50)
-    ap.add_argument("--cpu-ticks", type=int, default=5)
-    ap.add_argument("--quick", action="store_true",
-                    help="small shapes for smoke-testing the harness")
-    args = ap.parse_args()
-    if args.quick:
-        args.subs, args.queries, args.ticks = 20_000, 1_024, 10
+def run_pipelined(backend, batches, csr_cap: int, depth: int):
+    """Drive the fan-out engine at a fixed pipeline depth.
 
+    Returns ``(per_tick_latency_ms, sustained_ms, total_fanout)`` where
+    latency is each tick's dispatch→collect wall time (the fan-out
+    latency a client observes) and sustained is wall/ticks (the
+    throughput figure). depth=1 is the unpipelined request latency;
+    deeper overlaps transfer and compute of adjacent ticks.
+    """
+    lat, inflight, total_fanout = [], deque(), 0
+    overflow = 0
+    t_start = time.perf_counter()
+
+    def drain():
+        nonlocal total_fanout, overflow
+        t0, (m, result) = inflight.popleft()
+        n = _force(result)
+        if n > csr_cap:
+            overflow += 1
+        total_fanout += n
+        lat.append((time.perf_counter() - t0) * 1e3)
+
+    for b in batches:
+        inflight.append(
+            (time.perf_counter(),
+             backend.match_arrays_async(*b, csr_cap=csr_cap))
+        )
+        if len(inflight) >= depth:
+            drain()
+    while inflight:
+        drain()
+    sustained = (time.perf_counter() - t_start) / len(batches) * 1e3
+    assert overflow == 0, "csr_cap overflow — raise the headroom"
+    return np.asarray(lat), sustained, total_fanout
+
+
+# --------------------------------------------------------------------
+# config 5 (default): 1M-entity Zipf-hotspot fan-out
+# --------------------------------------------------------------------
+
+
+def bench_config5(args) -> dict:
     from worldql_server_tpu.spatial.backend import LocalQuery
     from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
     from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
@@ -115,47 +169,29 @@ def main() -> None:
     log(f"device flush: {time.perf_counter() - t0:.1f}s "
         f"stats={tpu.device_stats()} device={jax.devices()[0].platform}")
 
-    # Pre-draw per-tick query batches (workload generation is not the
-    # thing under test).
     batches = [
         make_query_batch(rng, sub_positions, sub_world_ids, args.queries)
         for _ in range(args.ticks)
     ]
-
-    csr_cap = args.queries * 4  # total fan-out pairs per tick headroom
+    csr_cap = args.queries * 4
 
     # Warmup: compile every shape tier.
     for b in batches[:2]:
         _, res = tpu.match_arrays_async(*b, csr_cap=csr_cap)
-        jax.block_until_ready(res)
+        _force(res)
 
-    # Pipelined steady state: dispatch tick t+DEPTH while fetching tick
-    # t, overlapping host encode, transfer and device compute the way a
-    # double-buffered server tick loop does.
-    from collections import deque
-
-    depth = 8
-    inflight = deque()
-    total_fanout = 0
-    overflow = 0
-    t_start = time.perf_counter()
-    for b in batches:
-        inflight.append(tpu.match_arrays_async(*b, csr_cap=csr_cap))
-        if len(inflight) >= depth:
-            total_fanout, overflow = _drain(
-                inflight, total_fanout, overflow, csr_cap
-            )
-    while inflight:
-        total_fanout, overflow = _drain(
-            inflight, total_fanout, overflow, csr_cap
-        )
-    t_total = time.perf_counter() - t_start
-
-    sustained = t_total / len(batches) * 1e3
-    assert overflow == 0, "csr_cap overflow — raise the headroom"
+    _, sustained, total_fanout = run_pipelined(tpu, batches, csr_cap, depth=8)
     log(f"tpu: sustained {sustained:.2f} ms/tick  "
         f"avg fan-out {total_fanout / (len(batches) * args.queries):.2f}  "
-        f"({args.queries / (t_total / len(batches)):,.0f} queries/s)")
+        f"({args.queries / (sustained / 1e3):,.0f} queries/s)")
+
+    # The north-star metric: per-tick fan-out latency, unpipelined and
+    # double-buffered.
+    lat1, _, _ = run_pipelined(tpu, batches, csr_cap, depth=1)
+    lat2, _, _ = run_pipelined(tpu, batches, csr_cap, depth=2)
+    log(f"latency depth1: p50 {pctl(lat1, 50):.2f} p99 {pctl(lat1, 99):.2f} ms"
+        f"  depth2: p50 {pctl(lat2, 50):.2f} p99 {pctl(lat2, 99):.2f} ms"
+        f"  (budget {TARGET_P99_MS} ms)")
 
     # CPU reference baseline: identical index + queries, per-message
     # dict resolution like the reference's hot path.
@@ -179,18 +215,23 @@ def main() -> None:
         cpu.match_local_batch(queries)
         cpu_times.append(time.perf_counter() - t0)
     cpu_times_ms = np.array(cpu_times) * 1e3
-    cpu_p99 = float(np.percentile(cpu_times_ms, 99))
+    cpu_p99 = pctl(cpu_times_ms, 99)
     log(f"cpu: mean {cpu_times_ms.mean():.2f} ms  p99 {cpu_p99:.2f} ms")
 
-    # Parity spot-check so a broken kernel can't post a good number.
     _parity_check(tpu, cpu, peers, batches[0])
 
-    print(json.dumps({
+    return {
         "metric": "local_fanout_sustained_tick_ms",
         "value": round(sustained, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_p99 / sustained, 2),
-    }))
+        "p50_ms_depth1": round(pctl(lat1, 50), 3),
+        "p99_ms_depth1": round(pctl(lat1, 99), 3),
+        "p50_ms_depth2": round(pctl(lat2, 50), 3),
+        "p99_ms_depth2": round(pctl(lat2, 99), 3),
+        "target_p99_ms": TARGET_P99_MS,
+        "config": 5,
+    }
 
 
 def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
@@ -213,6 +254,334 @@ def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
         want_ids = {tpu._peer_ids[p] for p in want}
         assert got == want_ids, f"parity diverged at query {i}"
     log(f"parity check: {samples} sampled queries agree with CPU reference")
+
+
+# --------------------------------------------------------------------
+# config 1: 256 WS clients echo loop through the real server
+# --------------------------------------------------------------------
+
+
+def bench_config1(args) -> dict:
+    import asyncio
+
+    n_clients = 64 if args.quick else 256
+    rounds = 5 if args.quick else 20
+    group = 8  # co-located clients per cube: each message fans to 7
+
+    async def scenario():
+        from tests.client_util import WsClient, free_port
+        from worldql_server_tpu.engine.config import Config
+        from worldql_server_tpu.engine.server import WorldQLServer
+        from worldql_server_tpu.protocol.types import (
+            Instruction, Message, Replication, Vector3,
+        )
+
+        config = Config()
+        config.store_url = "memory://"
+        config.ws_port = free_port()
+        config.http_enabled = False
+        config.zmq_enabled = False
+        config.spatial_backend = "cpu"
+        server = WorldQLServer(config)
+        await server.start()
+        latencies: list[float] = []
+        try:
+            clients = []
+            for i in range(n_clients):
+                c = await WsClient.connect(config.ws_port)
+                clients.append(c)
+            positions = [
+                Vector3(100.0 * (i // group), 5.0, 5.0)
+                for i in range(n_clients)
+            ]
+            for c, pos in zip(clients, positions):
+                await c.send(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    world_name="bench", position=pos,
+                ))
+            await asyncio.sleep(0.3)
+
+            expected_per_client = group - 1
+
+            async def recv_all(c):
+                got = 0
+                while got < expected_per_client * rounds:
+                    m = await asyncio.wait_for(c.recv(timeout=30), 30)
+                    if m.instruction != Instruction.LOCAL_MESSAGE:
+                        continue
+                    sent_at = float(m.parameter)
+                    latencies.append((time.perf_counter() - sent_at) * 1e3)
+                    got += 1
+
+            receivers = [asyncio.create_task(recv_all(c)) for c in clients]
+            # Rounds are paced by COMPLETION, not a fixed sleep: each
+            # round's wall time runs from the first send until every
+            # delivery of that round has landed, so the throughput
+            # figure is the server's, not the pacer's.
+            elapsed = 0.0
+            expected_total = n_clients * expected_per_client
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                for c, pos in zip(clients, positions):
+                    await c.send(Message(
+                        instruction=Instruction.LOCAL_MESSAGE,
+                        world_name="bench", position=pos,
+                        parameter=repr(time.perf_counter()),
+                        replication=Replication.EXCEPT_SELF,
+                    ))
+                while len(latencies) < expected_total * (r + 1):
+                    await asyncio.sleep(0.002)
+                elapsed += time.perf_counter() - t0
+            await asyncio.gather(*receivers)
+            for c in clients:
+                await c.close()
+            return latencies, elapsed
+        finally:
+            await server.stop()
+
+    latencies, elapsed = asyncio.run(scenario())
+    deliveries = len(latencies)
+    p50, p99 = pctl(latencies, 50), pctl(latencies, 99)
+    log(f"ws echo: {n_clients} clients, {deliveries} deliveries in "
+        f"{elapsed:.2f}s ({deliveries / elapsed:,.0f}/s)  "
+        f"p50 {p50:.2f} ms  p99 {p99:.2f} ms")
+    return {
+        "metric": "ws_echo_delivery_p99_ms",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_P99_MS / p99, 2),
+        "p50_ms": round(p50, 3),
+        "deliveries_per_s": round(deliveries / elapsed, 1),
+        "clients": n_clients,
+        "target_p99_ms": TARGET_P99_MS,
+        "config": 1,
+    }
+
+
+# --------------------------------------------------------------------
+# config 2: 10k random-walk clients, churn + broadcast @ 20 tick/s
+# --------------------------------------------------------------------
+
+
+def bench_config2(args) -> dict:
+    from worldql_server_tpu.spatial.quantize import cube_coords_batch
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+    n = 1_000 if args.quick else 10_000
+    ticks = 10 if args.quick else 50
+    world = "walk"
+    rng = np.random.default_rng(11)
+
+    backend = TpuSpatialBackend(cube_size=16)
+    positions = rng.uniform(-400.0, 400.0, (n, 3))
+    velocities = rng.uniform(-30.0, 30.0, (n, 3))
+    peers = [uuid_mod.UUID(int=i + 1) for i in range(n)]
+    peer_arr = np.array(peers)
+    cubes = cube_coords_batch(positions, backend.cube_size)
+    backend.bulk_add_subscriptions(world, peers, cubes)
+    backend.flush()
+
+    world_ids = np.zeros(n, np.int32)
+    sender_ids = np.arange(n, dtype=np.int32)
+    repls = np.zeros(n, np.int8)
+    csr_cap = n * 8
+
+    def churn_tick() -> int:
+        nonlocal positions
+        positions += velocities * 0.05
+        out = np.abs(positions) > 400.0
+        velocities[out] = -velocities[out]
+        np.clip(positions, -400.0, 400.0, out=positions)
+        new_cubes = cube_coords_batch(positions, backend.cube_size)
+        moved = (new_cubes != cubes).any(axis=1)
+        n_moved = 0
+        if moved.any():
+            midx = np.flatnonzero(moved)
+            backend.bulk_remove_subscriptions(
+                world, peer_arr[midx].tolist(), cubes[midx]
+            )
+            backend.bulk_add_subscriptions(
+                world, peer_arr[midx].tolist(), new_cubes[midx]
+            )
+            cubes[midx] = new_cubes[midx]
+            n_moved = int(midx.size)
+        total = _force(backend.match_arrays_async(
+            world_ids, positions, sender_ids, repls, csr_cap=csr_cap
+        )[1])
+        assert total <= csr_cap, "csr_cap overflow — raise the headroom"
+        return n_moved
+
+    # Warmup: churn until the index has been through a full compaction
+    # cycle, so every delta-buffer shape tier the steady state touches
+    # is compiled before measurement.
+    warm = 0
+    while warm < 40 and (backend.compactions < 2 or warm < 3):
+        churn_tick()
+        warm += 1
+    backend.wait_compaction()
+    log(f"warmup: {warm} churn ticks, {backend.compactions} compactions")
+
+    lat = []
+    churn_total = 0
+    t_start = time.perf_counter()
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        churn_total += churn_tick()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    sustained = (time.perf_counter() - t_start) / ticks * 1e3
+    p50, p99 = pctl(lat, 50), pctl(lat, 99)
+    log(f"random-walk: {n} clients, {churn_total / ticks:.0f} resubs/tick, "
+        f"sustained {sustained:.2f} ms/tick  p50 {p50:.2f}  p99 {p99:.2f} "
+        f"(budget {TICK_BUDGET_MS} ms)")
+    return {
+        "metric": "random_walk_tick_ms",
+        "value": round(sustained, 3),
+        "unit": "ms",
+        "vs_baseline": round(TICK_BUDGET_MS / max(p99, 1e-9), 2),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "clients": n,
+        "resubs_per_tick": round(churn_total / ticks, 1),
+        "budget_ms": TICK_BUDGET_MS,
+        "config": 2,
+    }
+
+
+# --------------------------------------------------------------------
+# config 3: 100k entities, on-device kNN (k=32) tick, single chip
+# --------------------------------------------------------------------
+
+
+def bench_config3(args) -> dict:
+    import jax
+
+    from worldql_server_tpu.ops.tick import example_state, make_tick_fn
+
+    n = 8_192 if args.quick else 100_000
+    ticks = 10 if args.quick else 30
+    tick = jax.jit(make_tick_fn(cube_size=16, k=32))
+    state = example_state(n=n, n_worlds=8)
+
+    # warmup / compile
+    state, targets, counts = tick(state)
+    jax.block_until_ready(targets)
+
+    lat = []
+    t_start = time.perf_counter()
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        state, targets, counts = tick(state)
+        jax.block_until_ready(targets)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    sustained = (time.perf_counter() - t_start) / ticks * 1e3
+    p50, p99 = pctl(lat, 50), pctl(lat, 99)
+    rate = n / (sustained / 1e3)
+    log(f"knn tick: {n} entities k=32, sustained {sustained:.2f} ms/tick "
+        f"p50 {p50:.2f} p99 {p99:.2f} ({rate:,.0f} entity-queries/s)")
+    return {
+        "metric": "knn_tick_ms",
+        "value": round(sustained, 3),
+        "unit": "ms",
+        "vs_baseline": round(TICK_BUDGET_MS / max(p99, 1e-9), 2),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "entities": n,
+        "entity_queries_per_s": round(rate),
+        "budget_ms": TICK_BUDGET_MS,
+        "config": 3,
+    }
+
+
+# --------------------------------------------------------------------
+# config 4: 64 worlds x 10k clients, mesh-sharded backend
+# --------------------------------------------------------------------
+
+
+def bench_config4(args) -> dict:
+    import jax
+
+    from worldql_server_tpu.parallel import (
+        ShardedTpuSpatialBackend, make_fanout_mesh,
+    )
+
+    n_worlds = 8 if args.quick else 64
+    per_world = 1_000 if args.quick else 10_000
+    n_subs = n_worlds * per_world
+    queries = 2_048 if args.quick else 16_384
+    ticks = 10 if args.quick else 30
+
+    mesh = make_fanout_mesh(1, len(jax.devices()))
+    backend = ShardedTpuSpatialBackend(cube_size=16, mesh=mesh)
+    rng = np.random.default_rng(21)
+    peers, sub_positions, sub_world_ids = build_index(
+        backend, rng, n_subs, n_worlds
+    )
+    t0 = time.perf_counter()
+    backend.flush()
+    log(f"device flush: {time.perf_counter() - t0:.1f}s "
+        f"mesh={dict(mesh.shape)} stats={backend.device_stats()}")
+
+    batches = [
+        make_query_batch(rng, sub_positions, sub_world_ids, queries)
+        for _ in range(ticks)
+    ]
+    csr_cap = queries * 4
+    for b in batches[:2]:
+        _force(backend.match_arrays_async(*b, csr_cap=csr_cap)[1])
+
+    _, sustained, total_fanout = run_pipelined(
+        backend, batches, csr_cap, depth=8
+    )
+    lat2, _, _ = run_pipelined(backend, batches, csr_cap, depth=2)
+    p50, p99 = pctl(lat2, 50), pctl(lat2, 99)
+    log(f"sharded {n_worlds} worlds: sustained {sustained:.2f} ms/tick  "
+        f"depth2 p50 {p50:.2f} p99 {p99:.2f}  "
+        f"avg fan-out {total_fanout / (ticks * queries):.2f}")
+    return {
+        "metric": "sharded_worlds_tick_ms",
+        "value": round(sustained, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_P99_MS / max(p99, 1e-9), 2),
+        "p50_ms_depth2": round(p50, 3),
+        "p99_ms_depth2": round(p99, 3),
+        "worlds": n_worlds,
+        "subscriptions": n_subs,
+        "mesh": dict(mesh.shape),
+        "target_p99_ms": TARGET_P99_MS,
+        "config": 4,
+    }
+
+
+# --------------------------------------------------------------------
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],
+                    help="BASELINE config to run (default: 5)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every config, one JSON line each")
+    ap.add_argument("--subs", type=int, default=1_000_000)
+    ap.add_argument("--queries", type=int, default=16_384)
+    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--cpu-ticks", type=int, default=5)
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for smoke-testing the harness")
+    args = ap.parse_args()
+    if args.quick:
+        args.subs, args.queries, args.ticks = 20_000, 1_024, 10
+
+    benches = {
+        1: bench_config1, 2: bench_config2, 3: bench_config3,
+        4: bench_config4, 5: bench_config5,
+    }
+    if args.all:
+        selected = [1, 2, 3, 4, 5]
+    else:
+        selected = [args.config or 5]
+    for n in selected:
+        log(f"=== BASELINE config {n} ===")
+        emit(benches[n](args))
 
 
 if __name__ == "__main__":

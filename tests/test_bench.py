@@ -1,5 +1,5 @@
-"""bench.py harness smoke test: runs tiny shapes, checks the JSON
-contract line (driver protocol: ONE json object on stdout)."""
+"""bench.py harness smoke tests: run tiny shapes, check the JSON
+contract (driver protocol: one json object per line on stdout)."""
 
 import json
 import subprocess
@@ -8,22 +8,67 @@ from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 
+ENV = {
+    "PATH": "/usr/bin:/bin:/usr/local/bin",
+    "JAX_PLATFORM_NAME": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
 
-def test_bench_contract():
+
+def run_bench(*argv: str) -> tuple[list[dict], str]:
     out = subprocess.run(
-        [sys.executable, str(ROOT / "bench.py"), "--subs", "4000",
-         "--queries", "256", "--ticks", "6", "--cpu-ticks", "2"],
-        capture_output=True, text=True, timeout=600, cwd=ROOT,
-        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
-             "JAX_PLATFORM_NAME": "cpu",
-             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+        [sys.executable, str(ROOT / "bench.py"), *argv],
+        capture_output=True, text=True, timeout=600, cwd=ROOT, env=ENV,
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 1, f"stdout must be one JSON line, got: {lines}"
-    rec = json.loads(lines[0])
+    records = [json.loads(l) for l in lines]
+    for rec in records:
+        assert rec["value"] > 0
+        assert rec["unit"] == "ms"
+        assert "vs_baseline" in rec
+    return records, out.stderr
+
+
+def test_bench_default_contract():
+    """Default invocation: ONE line, the config-5 headline metric, now
+    carrying the north-star p50/p99 latency keys (VERDICT r2 #3)."""
+    records, stderr = run_bench(
+        "--subs", "4000", "--queries", "256", "--ticks", "6",
+        "--cpu-ticks", "2",
+    )
+    assert len(records) == 1, records
+    rec = records[0]
     assert rec["metric"] == "local_fanout_sustained_tick_ms"
-    assert rec["unit"] == "ms"
-    assert rec["value"] > 0
-    assert "vs_baseline" in rec
-    assert "parity check" in out.stderr
+    assert rec["p99_ms_depth1"] > 0
+    assert rec["p99_ms_depth2"] > 0
+    assert rec["p50_ms_depth1"] <= rec["p99_ms_depth1"]
+    assert rec["target_p99_ms"] == 5.0
+    # the correctness oracle must have actually run
+    assert "parity check" in stderr
+
+
+def test_bench_config1_ws_echo():
+    """Config 1: the real server + WS clients echo loop."""
+    records, _ = run_bench("--config", "1", "--quick")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "ws_echo_delivery_p99_ms"
+    assert rec["deliveries_per_s"] > 0
+    assert rec["clients"] == 64
+
+
+def test_bench_config3_knn():
+    records, _ = run_bench("--config", "3", "--quick")
+    rec = records[0]
+    assert rec["metric"] == "knn_tick_ms"
+    assert rec["entities"] == 8192
+    assert rec["entity_queries_per_s"] > 0
+
+
+def test_bench_config4_sharded():
+    records, _ = run_bench("--config", "4", "--quick")
+    rec = records[0]
+    assert rec["metric"] == "sharded_worlds_tick_ms"
+    assert rec["worlds"] == 8
+    assert rec["mesh"] == {"batch": 1, "space": 1}

@@ -907,17 +907,26 @@ class TpuSpatialBackend(SpatialBackend):
             self._delta_stale = False
             self._sync_delta()
 
-        # 3. compaction policy
+        # 3. compaction policy. delta_dead matters too: under steady
+        # resubscribe churn (move out of a cube, into another) the live
+        # count stays flat while tombstoned log rows pile up — without
+        # the delta_dead trigger the log, its device buffer and the
+        # per-flush device sort grow without bound.
         threshold = self._compact_threshold()
         dead_threshold = max(
             4096, self._bk.size // self.COMPACT_DEAD_FRACTION
         )
+        delta_dead = self._dn - self._delta_live
         if self._delta_live > self.SYNC_COMPACT_FACTOR * threshold:
             self._compact_sync()
         elif (
-            (self._delta_live > threshold or self._base_dead > dead_threshold)
+            (
+                self._delta_live > threshold
+                or self._base_dead > dead_threshold
+                or delta_dead > dead_threshold
+            )
             and self._compaction is None
-            and (self._base_dead or self._delta_live)
+            and (self._base_dead or self._dn)
         ):
             self._start_compaction()
 
