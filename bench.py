@@ -329,7 +329,23 @@ def bench_config1(args) -> dict:
                         parameter=repr(time.perf_counter()),
                         replication=Replication.EXCEPT_SELF,
                     ))
+                # Bounded wait that surfaces receiver failures: a lost
+                # delivery (e.g. a subscription that raced round 0) must
+                # fail crisply, not spin this loop forever.
+                deadline = t0 + 60.0
                 while len(latencies) < expected_total * (r + 1):
+                    dead = next(
+                        (t for t in receivers
+                         if t.done() and t.exception() is not None),
+                        None,
+                    )
+                    if dead is not None:
+                        raise dead.exception()
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            f"config1 round {r}: {len(latencies)} of "
+                            f"{expected_total * (r + 1)} deliveries after 60s"
+                        )
                     await asyncio.sleep(0.002)
                 elapsed += time.perf_counter() - t0
             await asyncio.gather(*receivers)
@@ -561,15 +577,19 @@ def main() -> None:
                     help="BASELINE config to run (default: 5)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
-    ap.add_argument("--subs", type=int, default=1_000_000)
-    ap.add_argument("--queries", type=int, default=16_384)
-    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--subs", type=int, default=None)
+    ap.add_argument("--queries", type=int, default=None)
+    ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--cpu-ticks", type=int, default=5)
     ap.add_argument("--quick", action="store_true",
                     help="small shapes for smoke-testing the harness")
     args = ap.parse_args()
-    if args.quick:
-        args.subs, args.queries, args.ticks = 20_000, 1_024, 10
+    # --quick shrinks the DEFAULT shapes; explicit flags still win
+    quick_defaults = (20_000, 1_024, 10) if args.quick \
+        else (1_000_000, 16_384, 50)
+    for name, dflt in zip(("subs", "queries", "ticks"), quick_defaults):
+        if getattr(args, name) is None:
+            setattr(args, name, dflt)
 
     benches = {
         1: bench_config1, 2: bench_config2, 3: bench_config3,

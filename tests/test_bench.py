@@ -58,6 +58,19 @@ def test_bench_config1_ws_echo():
     assert rec["clients"] == 64
 
 
+def test_bench_config2_random_walk():
+    """Config 2: bulk resubscribe churn through compaction warmup —
+    the riskiest index path the harness drives."""
+    records, stderr = run_bench("--config", "2", "--quick")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "random_walk_tick_ms"
+    assert rec["clients"] == 1000
+    assert rec["resubs_per_tick"] > 0
+    assert rec["p50_ms"] <= rec["p99_ms"]
+    assert "warmup" in stderr
+
+
 def test_bench_config3_knn():
     records, _ = run_bench("--config", "3", "--quick")
     rec = records[0]
@@ -72,3 +85,13 @@ def test_bench_config4_sharded():
     assert rec["metric"] == "sharded_worlds_tick_ms"
     assert rec["worlds"] == 8
     assert rec["mesh"] == {"batch": 1, "space": 1}
+
+
+def test_bench_all_emits_one_line_per_config():
+    """--all: five configs, five JSON lines, in config order."""
+    records, _ = run_bench(
+        "--all", "--quick", "--subs", "4000", "--queries", "256",
+        "--ticks", "6", "--cpu-ticks", "2",
+    )
+    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5]
+    assert len({rec["metric"] for rec in records}) == 5

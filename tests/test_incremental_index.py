@@ -245,6 +245,144 @@ def test_churn_property_vs_cpu_with_tiny_threshold():
     assert tpu.compactions > 0
 
 
+def test_delta_overrun_stays_off_owning_thread():
+    """A delta overrun (churn outpacing compaction) must NOT fold
+    synchronously on the owning thread — the flush hands the work to
+    the background worker and keeps serving from the oversized delta.
+    The worker is gated on an event we control, so this is structural,
+    not a timing race."""
+    import threading
+
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    gate = threading.Event()
+    real_work = b._compact_work
+
+    def gated_work(snap):
+        gate.wait(timeout=30)
+        return real_work(snap)
+
+    b._compact_work = gated_work
+    peers = _peers(100)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * (i % 10), 5, 5))
+    assert b._delta_live > b.SYNC_COMPACT_FACTOR * b._compact_threshold()
+    b.flush()  # must return with the fold still pending on the worker
+    assert b._compaction is not None
+    assert not b._compaction["done"].is_set()
+    assert b._delta_live == 100  # still serving from the delta
+    got = b.match_local_batch([_query(W, Vector3(3, 5, 5), uuid.uuid4())])
+    assert set(got[0]) == b.query_cube(W, Vector3(3, 5, 5))
+    gate.set()
+    b.wait_compaction()
+    assert b.compactions >= 1
+    assert b.subscription_count() == 100
+
+
+def test_persistent_compaction_failure_falls_back_to_sync():
+    """If the background worker keeps failing AND the delta overran,
+    the flush folds synchronously as a last resort (correctness over
+    latency) instead of growing the delta forever."""
+    b = TpuSpatialBackend(16, compact_threshold=4)
+
+    def broken_work(snap):
+        raise RuntimeError("injected device fault")
+
+    b._compact_work = broken_work
+    peers = _peers(80)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * (i % 8), 5, 5))
+
+    # Each flush either starts a background attempt, swaps in a failure
+    # (re-arming the policy), or — once the streak hits the fallback
+    # bound — folds synchronously. Drive until the fold happens.
+    for _ in range(4 * b.SYNC_FALLBACK_FAILURES):
+        if b._compaction is not None:
+            b._compaction["done"].wait(timeout=30)
+        b.flush()
+        if b.compactions:
+            break
+    assert b._compaction is None
+    assert b.compactions == 1
+    assert b.compaction_failures == b.SYNC_FALLBACK_FAILURES
+    assert b._delta_live == 0 and b._base_live == 80
+    assert b._failed_streak == 0
+    got = b.match_local_batch([_query(W, Vector3(3, 5, 5), uuid.uuid4())])
+    assert set(got[0]) == b.query_cube(W, Vector3(3, 5, 5))
+
+
+def test_dead_dominated_churn_also_falls_back_to_sync():
+    """Resubscribe churn (remove+add pairs) keeps _delta_live flat
+    while tombstoned log rows pile up; with a persistently failing
+    worker the fallback must gate on the dead overrun too, or the log
+    grows without bound."""
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    b._compact_work = lambda snap: (_ for _ in ()).throw(
+        RuntimeError("injected device fault")
+    )
+    p = _peers(4)
+    for i, q in enumerate(p):
+        b.add_subscription(W, q, Vector3(16 * i, 5, 5))
+
+    # churn: each round moves every peer to a fresh cube (remove+add),
+    # so live count stays 4 while dead log rows accumulate past
+    # SYNC_COMPACT_FACTOR * dead_threshold (dead_threshold = 4096 floor
+    # is too big for a unit test — shrink it via the class knobs)
+    b._compact_threshold_override = 4
+    dead_bound = b.SYNC_COMPACT_FACTOR * 4096
+    rounds = 0
+    while b.compactions == 0 and rounds < 20000:
+        y = 16 * (rounds + 1)
+        for i, q in enumerate(p):
+            assert b.remove_subscription(
+                W, q, Vector3(16 * i, 16 * rounds, 5)
+            )
+            assert b.add_subscription(W, q, Vector3(16 * i, y, 5))
+        rounds += 1
+        if rounds % 64 == 0:
+            if b._compaction is not None:
+                b._compaction["done"].wait(timeout=30)
+            b.flush()
+    assert b.compactions == 1, f"no sync fold after {rounds} rounds"
+    assert b._dn - b._delta_live <= dead_bound + 8 * len(p)
+    assert b.subscription_count() == 4
+
+
+def test_eviction_storm_reuses_pid_index():
+    """remove_peer must not scan the whole base per eviction: the
+    pid-sorted view is built once per base epoch and shared by every
+    eviction in a storm."""
+    b = TpuSpatialBackend(16, compact_threshold=8)
+    cpu = CpuSpatialBackend(16)
+    n = 600
+    peers = _peers(n)
+    rng = np.random.default_rng(7)
+    pos = rng.uniform(-300, 300, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    b.bulk_add_subscriptions(W, peers, cubes)
+    for p, c in zip(peers, cubes):
+        cpu.add_subscription(W, p, tuple(int(v) for v in c))
+    b.flush()
+    b.wait_compaction()
+    assert b._base_live == n
+
+    assert b.remove_peer(peers[0]) and cpu.remove_peer(peers[0])
+    cache = b._base_pid_order
+    assert cache is not None
+    for p in peers[1:200]:
+        assert b.remove_peer(p) == cpu.remove_peer(p)
+    assert b._base_pid_order is cache  # one build served the storm
+    # double-eviction is a no-op through the index too
+    assert not b.remove_peer(peers[0])
+    assert b.query_world(W) == cpu.query_world(W)
+    assert b.subscription_count() == cpu.subscription_count()
+    queries = [
+        _query(W, Vector3(*pos[i]), uuid.uuid4()) for i in range(0, n, 17)
+    ]
+    for got, want in zip(b.match_local_batch(queries),
+                         cpu.match_local_batch(queries)):
+        assert set(got) == set(want)
+
+
 def test_world_level_views_survive_churn():
     b = TpuSpatialBackend(16, compact_threshold=4)
     cpu = CpuSpatialBackend(16)

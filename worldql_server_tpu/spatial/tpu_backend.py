@@ -275,8 +275,15 @@ class TpuSpatialBackend(SpatialBackend):
     COMPACT_DELTA_FRACTION = 8
     #: dead base rows that trigger a background compaction (fraction)
     COMPACT_DEAD_FRACTION = 8
-    #: delta overrun factor that forces a synchronous compaction
+    #: delta overrun factor past which bulk loads fold straight into the
+    #: base and a delta overrun falls back to a synchronous fold if the
+    #: background worker keeps failing
     SYNC_COMPACT_FACTOR = 4
+    #: consecutive background-compaction failures before a delta overrun
+    #: is allowed to fold synchronously on the owning thread (last
+    #: resort: the device is persistently failing, correctness over
+    #: latency)
+    SYNC_FALLBACK_FAILURES = 3
 
     def __init__(self, cube_size: int, compact_threshold: int | None = None):
         super().__init__(cube_size)
@@ -331,6 +338,17 @@ class TpuSpatialBackend(SpatialBackend):
 
         self.compactions = 0
         self.compaction_failures = 0
+        self._failed_streak = 0
+
+        # pid → base rows: lazily built per base epoch (argsort of the
+        # peer column, O(S log S) once), then each eviction is two
+        # binary searches + a small gather instead of an O(S) scan.
+        # Tombstones only ever rewrite peers to -1, so entries can go
+        # stale-dead but never point at a *different* peer; lookups
+        # re-check liveness against the current peer column.
+        self._base_pid_order: tuple[np.ndarray, np.ndarray] | None = None
+        # pid → delta rows, maintained incrementally on append.
+        self._delta_pid_rows: dict[int, list[int]] = {}
 
     # region: interning
 
@@ -439,12 +457,36 @@ class TpuSpatialBackend(SpatialBackend):
         self._dirty = True
         return True
 
+    def _peer_base_rows(self, pid: int) -> np.ndarray:
+        """Live base rows held by ``pid``: two binary searches + a small
+        gather against a per-epoch pid-sorted view (built lazily, once
+        per base install) instead of an O(S) column scan per eviction —
+        a disconnect storm at 1M rows would otherwise stall the event
+        loop scanning 4 MB per peer."""
+        if self._bp.size == 0:
+            return np.empty(0, np.intp)
+        if self._base_pid_order is None:
+            order = np.argsort(self._bp, kind="stable")
+            self._base_pid_order = (order, self._bp[order])
+        order, sorted_p = self._base_pid_order
+        lo = int(np.searchsorted(sorted_p, pid, side="left"))
+        hi = int(np.searchsorted(sorted_p, pid, side="right"))
+        rows = order[lo:hi]
+        # ``sorted_p`` is a build-time snapshot: rows tombstoned since
+        # then still appear under their old pid — re-check liveness.
+        return rows[self._bp[rows] == pid]
+
     def remove_peer(self, peer: uuid_mod.UUID) -> bool:
         pid = self._peer_ids.get(peer)
         if pid is None:
             return False
-        rows_b = np.flatnonzero(self._bp == pid)
-        rows_d = np.flatnonzero(self._dp[:self._dn] == pid)
+        rows_b = self._peer_base_rows(pid)
+        drows = self._delta_pid_rows.pop(pid, None)
+        if drows is not None:
+            rows_d = np.asarray(drows, np.intp)
+            rows_d = rows_d[self._dp[rows_d] == pid]
+        else:
+            rows_d = np.empty(0, np.intp)
         if rows_b.size == 0 and rows_d.size == 0:
             return False
 
@@ -492,6 +534,7 @@ class TpuSpatialBackend(SpatialBackend):
         self._dn += 1
         self._delta_live += 1
         self._delta_index[(key, pid)] = row
+        self._delta_pid_rows.setdefault(pid, []).append(row)
         self._delta_keyrow.setdefault(key, row)
         run = self._delta_key_count[key] + 1
         self._delta_key_count[key] = run
@@ -810,9 +853,11 @@ class TpuSpatialBackend(SpatialBackend):
         rows = range(a, b)
         idx = self._delta_index
         keyrow = self._delta_keyrow
+        pid_rows = self._delta_pid_rows
         for row, key, pid in zip(rows, keys.tolist(), pids.tolist()):
             idx[(key, pid)] = row
             keyrow.setdefault(key, row)
+            pid_rows.setdefault(pid, []).append(row)
         kc = self._delta_key_count
         u, c = np.unique(keys, return_counts=True)
         for key, cnt in zip(u.tolist(), c.tolist()):
@@ -917,7 +962,23 @@ class TpuSpatialBackend(SpatialBackend):
             4096, self._bk.size // self.COMPACT_DEAD_FRACTION
         )
         delta_dead = self._dn - self._delta_live
-        if self._delta_live > self.SYNC_COMPACT_FACTOR * threshold:
+        if (
+            (
+                self._delta_live > self.SYNC_COMPACT_FACTOR * threshold
+                # tombstone-dominated churn overruns via dead rows while
+                # _delta_live stays flat — the log (_dn) must bound too
+                or delta_dead > self.SYNC_COMPACT_FACTOR * dead_threshold
+            )
+            and self._compaction is None
+            and self._failed_streak >= self.SYNC_FALLBACK_FAILURES
+        ):
+            # Last resort: the delta overran AND the background worker
+            # failed repeatedly — fold on the owning thread so a
+            # persistent device fault surfaces synchronously instead of
+            # the delta growing forever. A healthy overrun (churn
+            # outpacing one compaction) stays off the event loop: the
+            # oversized delta keeps serving correctly while the next
+            # background fold catches up.
             self._compact_sync()
         elif (
             (
@@ -1009,6 +1070,7 @@ class TpuSpatialBackend(SpatialBackend):
             np.empty((0, 3), np.int64), np.empty(0, np.int64),
         )
         self.compactions += 1
+        self._failed_streak = 0
         # the rebuild marked dirty; complete the flush for the new state
         self._dirty = False
         self._pending_dead.clear()
@@ -1129,12 +1191,15 @@ class TpuSpatialBackend(SpatialBackend):
         if state["error"] is not None:
             self._replay = []
             self.compaction_failures += 1
+            self._failed_streak += 1
             # Re-arm the flush policy step: with no new mutations an
             # un-dirty flush would early-return and never retry.
             self._dirty = True
             return state["error"]
         keys, wids, xyz, pids, k, bundle, live_total = state["result"]
+        self._failed_streak = 0
         self._bk, self._bw, self._bxyz, self._bp = keys, wids, xyz, pids
+        self._base_pid_order = None
         self._base_k = k
         self._base_bundle = bundle
         self._base_live = live_total
@@ -1173,12 +1238,17 @@ class TpuSpatialBackend(SpatialBackend):
         }
         keyrow: dict[int, int] = {}
         kc: Counter = Counter()
+        pid_rows: dict[int, list[int]] = {}
         for r in range(rem):
             key = int(self._dk[r])
             keyrow.setdefault(key, r)
             kc[key] += 1
+            pid = int(self._dp[r])
+            if pid >= 0:
+                pid_rows.setdefault(pid, []).append(r)
         self._delta_keyrow = keyrow
         self._delta_key_count = kc
+        self._delta_pid_rows = pid_rows
         self._delta_max_run = max(kc.values(), default=1)
         self._delta_buf = None
         self._delta_buf_cap = 0
@@ -1201,6 +1271,7 @@ class TpuSpatialBackend(SpatialBackend):
         indices always mirror the device layout."""
         self._epoch += 1
         n = int(keys.size)
+        self._base_pid_order = None
         self._base_live = n
         self._base_dead = 0
         self._base_k = next_pow2(_max_run(keys), 8) if n else 1
@@ -1230,6 +1301,7 @@ class TpuSpatialBackend(SpatialBackend):
         self._delta_keyrow = {}
         self._delta_key_count = Counter()
         self._delta_max_run = 1
+        self._delta_pid_rows = {}
         self._delta_buf = None
         self._delta_buf_cap = 0
         self._delta_built_n = 0
