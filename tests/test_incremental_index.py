@@ -347,6 +347,74 @@ def test_dead_dominated_churn_also_falls_back_to_sync():
     assert b.subscription_count() == 4
 
 
+def test_wedged_worker_is_abandoned_and_overrun_folds_sync():
+    """A worker that HANGS (never sets done) must not block the policy
+    forever: an overrun flush abandons it after the stall timeout,
+    counts a failure, and — once the streak hits the bound — folds
+    synchronously so the log stays bounded."""
+    import threading
+    import time as time_mod
+
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    b.COMPACT_STALL_SECS = 0.01  # instance attr shadows the class knob
+    gate = threading.Event()
+    b._compact_work = lambda snap: gate.wait(timeout=60)
+    peers = _peers(100)
+    for i, p in enumerate(peers):
+        b.add_subscription(W, p, Vector3(16 * (i % 10), 5, 5))
+
+    folds = 0
+    for _ in range(2 * b.SYNC_FALLBACK_FAILURES + 2):
+        b.flush()
+        if b.compactions:
+            folds = b.compactions
+            break
+        b._dirty = True  # keep the policy step running
+        time_mod.sleep(0.03)  # outlive the stall timeout
+    gate.set()
+    assert folds == 1
+    assert b.compaction_failures == b.SYNC_FALLBACK_FAILURES
+    assert b._compaction is None
+    assert b._delta_live == 0 and b._base_live == 100
+    got = b.match_local_batch([_query(W, Vector3(3, 5, 5), uuid.uuid4())])
+    assert set(got[0]) == b.query_cube(W, Vector3(3, 5, 5))
+
+
+def test_wait_compaction_raises_on_wedged_worker():
+    """wait_compaction (shutdown path) must never hang: a worker that
+    makes no progress within the stall timeout is abandoned and
+    surfaced as an error."""
+    import threading
+
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    b.COMPACT_STALL_SECS = 0.01
+    gate = threading.Event()
+    b._compact_work = lambda snap: gate.wait(timeout=60)
+    for i, p in enumerate(_peers(20)):
+        b.add_subscription(W, p, Vector3(16 * (i % 4), 5, 5))
+    b.flush()
+    assert b._compaction is not None
+    with pytest.raises(RuntimeError, match="wedged"):
+        b.wait_compaction()
+    gate.set()
+    assert b._compaction is None
+    assert b.compaction_failures == 1
+
+
+def test_successful_rebuild_resets_failure_streak():
+    """A successful base install (e.g. a huge bulk load folding straight
+    into the base) proves the path healthy — a stale streak must not
+    force the NEXT overrun onto the owning thread."""
+    b = TpuSpatialBackend(16, compact_threshold=4)
+    b._failed_streak = b.SYNC_FALLBACK_FAILURES
+    n = 200  # > SYNC_COMPACT_FACTOR * threshold → direct base fold
+    rng = np.random.default_rng(3)
+    cubes = cube_coords_batch(rng.uniform(-300, 300, (n, 3)), 16)
+    b.bulk_add_subscriptions(W, _peers(n), cubes)
+    assert b._base_live == n
+    assert b._failed_streak == 0
+
+
 def test_eviction_storm_reuses_pid_index():
     """remove_peer must not scan the whole base per eviction: the
     pid-sorted view is built once per base epoch and shared by every

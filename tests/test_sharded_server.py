@@ -98,7 +98,7 @@ def test_sharded_server_ws_fanout_through_ticker():
                 ))
             for client, world in zip(subs, worlds):
                 got = await client.recv_until(
-                    Instruction.LOCAL_MESSAGE, timeout=10
+                    Instruction.LOCAL_MESSAGE, timeout=30
                 )
                 assert got.parameter == f"msg-{world}"
                 assert got.world_name == world
@@ -110,7 +110,7 @@ def test_sharded_server_ws_fanout_through_ticker():
                 instruction=Instruction.LOCAL_MESSAGE,
                 world_name="alpha", position=pos, parameter="after-drop",
             ))
-            got = await subs[1].recv_until(Instruction.LOCAL_MESSAGE, timeout=10)
+            got = await subs[1].recv_until(Instruction.LOCAL_MESSAGE, timeout=30)
             assert got.parameter == "after-drop"
         finally:
             await server.stop()
@@ -153,7 +153,7 @@ def test_sharded_server_survives_churn_with_compaction():
                         parameter=f"probe-{i}",
                     ))
                     got = await listener.recv_until(
-                        Instruction.LOCAL_MESSAGE, timeout=10
+                        Instruction.LOCAL_MESSAGE, timeout=30
                     )
                     assert got.parameter == f"probe-{i}"
             server.backend.wait_compaction()
@@ -164,7 +164,7 @@ def test_sharded_server_survives_churn_with_compaction():
                 instruction=Instruction.LOCAL_MESSAGE,
                 world_name="hot", position=probe, parameter="still-alive",
             ))
-            got = await listener.recv_until(Instruction.LOCAL_MESSAGE, timeout=10)
+            got = await listener.recv_until(Instruction.LOCAL_MESSAGE, timeout=30)
             assert got.parameter == "still-alive"
         finally:
             await server.stop()

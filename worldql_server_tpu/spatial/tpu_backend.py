@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import uuid as uuid_mod
 from collections import Counter
 from functools import partial
@@ -284,6 +285,10 @@ class TpuSpatialBackend(SpatialBackend):
     #: resort: the device is persistently failing, correctness over
     #: latency)
     SYNC_FALLBACK_FAILURES = 3
+    #: seconds an in-flight compaction may run before an OVERRUN flush
+    #: treats it as wedged and abandons it — a hung device call must not
+    #: let the delta log grow without bound
+    COMPACT_STALL_SECS = 120.0
 
     def __init__(self, cube_size: int, compact_threshold: int | None = None):
         super().__init__(cube_size)
@@ -962,34 +967,45 @@ class TpuSpatialBackend(SpatialBackend):
             4096, self._bk.size // self.COMPACT_DEAD_FRACTION
         )
         delta_dead = self._dn - self._delta_live
-        if (
-            (
-                self._delta_live > self.SYNC_COMPACT_FACTOR * threshold
-                # tombstone-dominated churn overruns via dead rows while
-                # _delta_live stays flat — the log (_dn) must bound too
-                or delta_dead > self.SYNC_COMPACT_FACTOR * dead_threshold
-            )
-            and self._compaction is None
-            and self._failed_streak >= self.SYNC_FALLBACK_FAILURES
-        ):
-            # Last resort: the delta overran AND the background worker
-            # failed repeatedly — fold on the owning thread so a
-            # persistent device fault surfaces synchronously instead of
-            # the delta growing forever. A healthy overrun (churn
-            # outpacing one compaction) stays off the event loop: the
-            # oversized delta keeps serving correctly while the next
-            # background fold catches up.
-            self._compact_sync()
-        elif (
-            (
-                self._delta_live > threshold
-                or self._base_dead > dead_threshold
-                or delta_dead > dead_threshold
-            )
-            and self._compaction is None
-            and (self._base_dead or self._dn)
-        ):
-            self._start_compaction()
+        # live OR tombstone-dominated overrun: under resubscribe churn
+        # _delta_live stays flat while dead log rows pile up — the log
+        # (_dn) must bound too
+        overrun = (
+            self._delta_live > self.SYNC_COMPACT_FACTOR * threshold
+            or delta_dead > self.SYNC_COMPACT_FACTOR * dead_threshold
+        )
+        if overrun and self._compaction is not None:
+            stalled = time.monotonic() - self._compaction["started"]
+            if stalled > self.COMPACT_STALL_SECS:
+                # A worker that hangs (device call never returns) would
+                # otherwise block both policy branches forever while the
+                # delta grows without bound. Orphan it: the epoch bump
+                # means its eventual result can never swap in.
+                _log.warning(
+                    "abandoning wedged compaction after %.0fs", stalled
+                )
+                self._abandon_compaction()
+                self.compaction_failures += 1
+                self._failed_streak += 1
+        if self._compaction is None:
+            if overrun and self._failed_streak >= self.SYNC_FALLBACK_FAILURES:
+                # Last resort: the delta overran AND the background
+                # worker keeps failing or hanging — fold on the owning
+                # thread so a persistent device fault surfaces
+                # synchronously instead of the delta growing forever. A
+                # healthy overrun (churn outpacing one compaction) stays
+                # off the event loop: the oversized delta keeps serving
+                # correctly while the next background fold catches up.
+                self._compact_sync()
+            elif (
+                (
+                    self._delta_live > threshold
+                    or self._base_dead > dead_threshold
+                    or delta_dead > dead_threshold
+                )
+                and (self._base_dead or self._dn)
+            ):
+                self._start_compaction()
 
     def _sync_delta(self) -> None:
         """Bring the device delta twin up to date with the host log.
@@ -1070,7 +1086,6 @@ class TpuSpatialBackend(SpatialBackend):
             np.empty((0, 3), np.int64), np.empty(0, np.int64),
         )
         self.compactions += 1
-        self._failed_streak = 0
         # the rebuild marked dirty; complete the flush for the new state
         self._dirty = False
         self._pending_dead.clear()
@@ -1100,6 +1115,7 @@ class TpuSpatialBackend(SpatialBackend):
             "done": threading.Event(),
             "epoch": self._epoch,
             "consumed_dn": consumed,
+            "started": time.monotonic(),
             "result": None,
             "error": None,
         }
@@ -1168,9 +1184,17 @@ class TpuSpatialBackend(SpatialBackend):
         """Block until no compaction is in flight (tests, benchmarks,
         shutdown). The post-swap flush may start a follow-up compaction
         over the delta tail; loop until quiescent. A failed compaction
-        raises here (a silent retry could spin this loop forever)."""
+        raises here (a silent retry could spin this loop forever), and
+        so does a wedged one — an unbounded wait would hang shutdown."""
         while self._compaction is not None:
-            self._compaction["done"].wait()
+            if not self._compaction["done"].wait(self.COMPACT_STALL_SECS):
+                self._abandon_compaction()
+                self.compaction_failures += 1
+                self._failed_streak += 1
+                raise RuntimeError(
+                    "compaction wedged: no progress within "
+                    f"{self.COMPACT_STALL_SECS}s"
+                )
             err = self._swap_compaction()
             if err is not None:
                 raise RuntimeError("background compaction failed") from err
@@ -1272,6 +1296,10 @@ class TpuSpatialBackend(SpatialBackend):
         self._epoch += 1
         n = int(keys.size)
         self._base_pid_order = None
+        # any successful base install (bulk fold, reseed, sync fold)
+        # proves the path healthy again — a stale failure streak must
+        # not force future overruns onto the owning thread
+        self._failed_streak = 0
         self._base_live = n
         self._base_dead = 0
         self._base_k = next_pow2(_max_run(keys), 8) if n else 1
