@@ -1,0 +1,165 @@
+"""CLI entry-point behavior: dotenv loading, git-hash version, and the
+port pre-check (main.rs:51, build.rs:4-11, main.rs:73-98 parity)."""
+
+import socket
+
+import pytest
+
+from worldql_server_tpu.__main__ import check_ports, main
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.utils.dotenv import load_dotenv, parse_dotenv
+from worldql_server_tpu.utils.version import full_version, git_short_hash
+
+
+# region: dotenv
+
+
+def test_parse_dotenv_dialect():
+    text = """
+# comment
+WQL_WS_PORT=9001
+export WQL_HTTP_PORT=9002
+QUOTED="hello world"
+SINGLE='x=y'
+TRAILING=value # comment
+EMPTY=
+BAD LINE IGNORED
+=alsobad
+"""
+    env = parse_dotenv(text)
+    assert env == {
+        "WQL_WS_PORT": "9001",
+        "WQL_HTTP_PORT": "9002",
+        "QUOTED": "hello world",
+        "SINGLE": "x=y",
+        "TRAILING": "value",
+        "EMPTY": "",
+    }
+
+
+def test_load_dotenv_never_overrides(tmp_path, monkeypatch):
+    envfile = tmp_path / ".env"
+    envfile.write_text("WQL_TEST_A=file\nWQL_TEST_B=file\n")
+    monkeypatch.setenv("WQL_TEST_A", "live")
+    monkeypatch.delenv("WQL_TEST_B", raising=False)
+    assert load_dotenv(str(envfile)) == 1
+    import os
+    assert os.environ["WQL_TEST_A"] == "live"  # live environment wins
+    assert os.environ["WQL_TEST_B"] == "file"
+    monkeypatch.delenv("WQL_TEST_B")
+
+
+def test_load_dotenv_missing_file_is_fine(tmp_path):
+    assert load_dotenv(str(tmp_path / "nope.env")) == 0
+
+
+def test_dotenv_feeds_config(tmp_path, monkeypatch):
+    """A .env in the working directory supplies WQL_* fallbacks, the
+    same as the reference's dotenv() before Args::parse."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".env").write_text("WQL_SUBSCRIPTION_REGION_CUBE_SIZE=48\n")
+    monkeypatch.delenv("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", raising=False)
+    load_dotenv()
+    try:
+        assert Config().sub_region_size == 48
+    finally:
+        monkeypatch.delenv("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", raising=False)
+
+
+# endregion
+
+# region: version
+
+
+def test_git_hash_from_env(monkeypatch):
+    monkeypatch.setenv("WQL_GIT_HASH", "abcdef1234")
+    assert git_short_hash() == "abcdef1"
+    assert full_version("0.1.0") == "0.1.0 (abcdef1)"
+
+
+def test_git_hash_from_checkout(monkeypatch):
+    """The package lives inside a git checkout here, so the live
+    rev-parse path must produce a short hash."""
+    monkeypatch.delenv("WQL_GIT_HASH", raising=False)
+    h = git_short_hash()
+    assert h is not None and len(h) == 7
+    assert int(h, 16) is not None  # hex
+
+
+# endregion
+
+# region: port pre-check
+
+
+def make_quiet_config(**kw) -> Config:
+    config = Config(store_url="memory://")
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_enabled = False
+    for key, value in kw.items():
+        setattr(config, key, value)
+    return config
+
+
+def test_check_ports_free():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    config = make_quiet_config(
+        ws_enabled=True, ws_host="127.0.0.1", ws_port=free
+    )
+    assert check_ports(config) is None
+
+
+@pytest.mark.parametrize("which,flag", [
+    ("ws", "--ws-port"),
+    ("http", "--http-port"),
+    ("zmq_server", "--zmq-server-port"),
+])
+def test_check_ports_busy_names_the_flag(which, flag):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        busy = s.getsockname()[1]
+        enabled = "zmq" if which == "zmq_server" else which
+        config = make_quiet_config(**{
+            f"{enabled}_enabled": True,
+            f"{which}_host": "127.0.0.1",
+            f"{which}_port": busy,
+        })
+        error = check_ports(config)
+    assert error is not None and flag in error and str(busy) in error
+
+
+def test_main_exits_1_on_busy_port(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)  # no stray .env, no sqlite litter
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        busy = s.getsockname()[1]
+        rc = main([
+            "--store-url", "memory://",
+            "--no-http", "--no-zmq",
+            "--ws-host", "127.0.0.1", "--ws-port", str(busy),
+        ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "--ws-port" in err and "already in use" in err
+
+
+def test_main_exits_1_on_config_error(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--store-url", "memory://", "--sub-region-size", "0"])
+    assert rc == 1
+    assert "config error" in capsys.readouterr().err
+
+
+def test_version_flag(capsys, monkeypatch):
+    monkeypatch.setenv("WQL_GIT_HASH", "feedc0d")
+    with pytest.raises(SystemExit) as exc:
+        main(["--version"])
+    assert exc.value.code == 0
+    assert "(feedc0d)" in capsys.readouterr().out
+
+
+# endregion

@@ -2,20 +2,37 @@
 
 Flag surface mirrors the reference's clap Args (worldql_server/src/
 args.rs:21-129): every flag falls back to a ``WQL_*`` environment
-variable (handled in Config), ``-v`` stacks verbosity
-(main.rs:54-65), and validation failures exit 1 (main.rs:101-104).
+variable (handled in Config), a ``.env`` file loads before anything
+reads the environment (main.rs:51), ``-v`` stacks verbosity
+(main.rs:54-65), validation failures exit 1 (main.rs:101-104), and
+each configured listening port is probed before bring-up so a busy
+port dies with a named error instead of a bind traceback
+(main.rs:73-98).
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import errno
 import logging
+import socket
 import sys
 
 from .engine.config import Config
 from .engine.server import WorldQLServer
+from .utils.dotenv import load_dotenv
+from .utils.version import full_version
 from . import __version__
+
+
+class _VersionAction(argparse.Action):
+    """Resolve the git hash only when --version is actually requested —
+    the subprocess probe must not tax every server startup."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        print(full_version(__version__))
+        parser.exit(0)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -23,7 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="worldql-server-tpu",
         description="TPU-native real-time spatial message broker",
     )
-    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--version", action=_VersionAction, nargs=0)
     p.add_argument("--store-url", help="record store url (sqlite://PATH, memory://, postgres://…)")
     p.add_argument("--sub-region-size", type=int, help="subscription cube size (default 16)")
     p.add_argument("--db-region-x-size", type=int)
@@ -74,7 +91,49 @@ def config_from_args(args: argparse.Namespace) -> Config:
     return config
 
 
+def _port_is_free(host: str, port: int) -> bool:
+    """True unless the port is definitely taken. Resolves the address
+    family (IPv6 hosts probe as IPv6), and treats only EADDRINUSE as
+    busy — any other failure (unresolvable host, privileged port) is
+    deferred to the real bind, which reports it accurately."""
+    try:
+        infos = socket.getaddrinfo(
+            host or None, port, type=socket.SOCK_STREAM,
+            flags=socket.AI_PASSIVE,
+        )
+    except socket.gaierror:
+        return True
+    family, type_, proto, _, addr = infos[0]
+    try:
+        with socket.socket(family, type_, proto) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(addr)
+    except OSError as exc:
+        return exc.errno != errno.EADDRINUSE
+    return True
+
+
+def check_ports(config: Config) -> str | None:
+    """Probe each enabled listening port; returns an error naming the
+    offending flag, or None (main.rs:73-98 portpicker parity)."""
+    probes = []
+    if config.ws_enabled:
+        probes.append(("WebSocket server", "--ws-port",
+                       config.ws_host, config.ws_port))
+    if config.http_enabled:
+        probes.append(("HTTP server", "--http-port",
+                       config.http_host, config.http_port))
+    if config.zmq_enabled:
+        probes.append(("ZeroMQ server", "--zmq-server-port",
+                       config.zmq_server_host, config.zmq_server_port))
+    for what, flag, host, port in probes:
+        if not _port_is_free(host, port):
+            return f"{what} port {port} ({flag}) is already in use"
+    return None
+
+
 def main(argv: list[str] | None = None) -> int:
+    load_dotenv()
     args = build_parser().parse_args(argv)
 
     level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
@@ -88,6 +147,11 @@ def main(argv: list[str] | None = None) -> int:
         config.validate()
     except ValueError as exc:
         print(f"config error: {exc}", file=sys.stderr)
+        return 1
+
+    port_error = check_ports(config)
+    if port_error is not None:
+        print(f"config error: {port_error}", file=sys.stderr)
         return 1
 
     if config.spatial_backend == "sharded":

@@ -246,13 +246,24 @@ class _TooManyObjects(Exception):
 
 def load() -> NativeCodec | None:
     """Load the native codec, or None (pure-Python fallback).
-    Set WQL_NATIVE_CODEC=0 to force the fallback."""
-    if os.environ.get("WQL_NATIVE_CODEC", "1") == "0":
+    WQL_NATIVE_CODEC: '0' forces the fallback, '1'/unset uses the
+    in-tree build, any other value is a path to the shared library
+    (containers install it outside the source tree)."""
+    env = os.environ.get("WQL_NATIVE_CODEC", "1")
+    if env == "0":
         return None
-    if not _LIB_PATH.exists():
+    lib_path = _LIB_PATH if env == "1" else Path(env)
+    if not lib_path.exists():
+        if env != "1":
+            # An explicitly configured path that is missing is a
+            # misconfiguration — don't fall back silently.
+            logger.warning(
+                "WQL_NATIVE_CODEC=%s does not exist; using Python codec",
+                env,
+            )
         return None
     try:
-        codec = NativeCodec(ctypes.CDLL(str(_LIB_PATH)))
+        codec = NativeCodec(ctypes.CDLL(str(lib_path)))
     except (OSError, AttributeError) as exc:
         # AttributeError: a stale .so missing a symbol — fall back, the
         # server must not die on a leftover build artifact.

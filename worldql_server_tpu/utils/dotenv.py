@@ -1,0 +1,58 @@
+"""Minimal ``.env`` loader.
+
+The reference loads a dotenv file before parsing args (main.rs:51), so
+``WQL_*`` fallbacks work from a file as well as the live environment.
+No third-party dependency: the dialect is the common intersection —
+``KEY=VALUE`` lines, ``#`` comments, optional ``export`` prefix,
+single/double quotes stripped, no interpolation. Existing environment
+variables always win (dotenv-rs semantics: ``dotenv()`` never
+overrides).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_QUOTES = ("'", '"')
+
+
+def parse_dotenv(text: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):].lstrip()
+        key, sep, value = line.partition("=")
+        key = key.strip()
+        if not sep or not key or any(c.isspace() for c in key):
+            logger.warning(".env line %d ignored: %r", lineno, raw)
+            continue
+        value = value.strip()
+        if len(value) >= 2 and value[0] in _QUOTES and value[-1] == value[0]:
+            value = value[1:-1]
+        else:
+            # unquoted values: strip trailing comments
+            value = value.split(" #", 1)[0].rstrip()
+        out[key] = value
+    return out
+
+
+def load_dotenv(path: str = ".env") -> int:
+    """Load ``path`` into ``os.environ`` (existing vars win). Returns
+    the number of variables actually set; a missing file is fine."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except (FileNotFoundError, IsADirectoryError):
+        return 0
+    loaded = 0
+    for key, value in parse_dotenv(text).items():
+        if key not in os.environ:
+            os.environ[key] = value
+            loaded += 1
+    return loaded

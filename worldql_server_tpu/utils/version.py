@@ -1,0 +1,47 @@
+"""Version string with the git short hash.
+
+The reference embeds the commit hash at build time (build.rs:4-11) and
+clap renders ``worldql_server x.y.z (abc1234)``. Python has no build
+step, so resolve in order: the ``WQL_GIT_HASH`` environment variable
+(stamped into container images at build time, Dockerfile), then a live
+``git rev-parse`` against the package checkout, then the bare version.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+
+def _git(args: list[str], cwd: Path) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", *args], capture_output=True, text=True, timeout=5,
+            cwd=cwd,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    value = out.stdout.strip()
+    return value if out.returncode == 0 and value else None
+
+
+def git_short_hash() -> str | None:
+    env = os.environ.get("WQL_GIT_HASH")
+    if env:
+        return env[:7]
+    pkg_root = Path(__file__).resolve().parents[1]
+    # Guard against an UNRELATED enclosing repo: a package installed
+    # into a venv nested inside someone else's checkout would otherwise
+    # stamp that project's HEAD. Only report a hash when the repo
+    # toplevel is exactly the directory containing this package (the
+    # source-checkout layout).
+    top = _git(["rev-parse", "--show-toplevel"], pkg_root)
+    if top is None or Path(top).resolve() != pkg_root.parent:
+        return None
+    return _git(["rev-parse", "--short=7", "HEAD"], pkg_root)
+
+
+def full_version(base: str) -> str:
+    hash_ = git_short_hash()
+    return f"{base} ({hash_})" if hash_ else base
