@@ -22,11 +22,6 @@ COPY worldql_server_tpu ./worldql_server_tpu
 # Native wire codec (pure-Python fallback exists, but ship the fast path)
 RUN make -C native
 
-# Stamp the build's git hash for --version (build.rs:4-11 parity);
-# docker build --build-arg WQL_GIT_HASH=$(git rev-parse --short HEAD)
-ARG WQL_GIT_HASH=
-ENV WQL_GIT_HASH=${WQL_GIT_HASH}
-
 RUN pip install --no-cache-dir --prefix=/install .
 
 # ---
@@ -43,6 +38,10 @@ COPY --from=builder --chown=1001:1001 /install /usr/local
 COPY --from=builder --chown=1001:1001 /app/native/libwqlcodec.so /opt/worldql/native/libwqlcodec.so
 ENV WQL_NATIVE_CODEC=/opt/worldql/native/libwqlcodec.so
 
+# Stamp the build's git hash for --version (build.rs:4-11 parity);
+# docker build --build-arg WQL_GIT_HASH=$(git rev-parse --short HEAD).
+# Runtime stage only — a changed hash must not bust the builder's
+# dependency-install layer cache.
 ARG WQL_GIT_HASH=
 ENV WQL_GIT_HASH=${WQL_GIT_HASH}
 

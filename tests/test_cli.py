@@ -21,6 +21,8 @@ WQL_WS_PORT=9001
 export WQL_HTTP_PORT=9002
 QUOTED="hello world"
 SINGLE='x=y'
+QUOTED_COMMENT="127.0.0.1" # loopback
+UNCLOSED="oops
 TRAILING=value # comment
 EMPTY=
 BAD LINE IGNORED
@@ -32,6 +34,7 @@ BAD LINE IGNORED
         "WQL_HTTP_PORT": "9002",
         "QUOTED": "hello world",
         "SINGLE": "x=y",
+        "QUOTED_COMMENT": "127.0.0.1",
         "TRAILING": "value",
         "EMPTY": "",
     }

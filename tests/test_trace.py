@@ -1,0 +1,107 @@
+"""Per-packet trace channel (utils/trace.py; trace_packet.rs parity)."""
+
+import asyncio
+import logging
+import uuid
+
+import pytest
+
+from worldql_server_tpu.utils import trace
+
+
+@pytest.fixture(autouse=True)
+def reset_trace():
+    was = trace.is_enabled()
+    yield
+    (trace.enable if was else trace.disable)()
+
+
+def test_disabled_by_default_and_formats_nothing(caplog):
+    class Exploding:
+        def __str__(self):
+            raise AssertionError("formatted while disabled")
+
+    trace.disable()
+    with caplog.at_level(trace.TRACE_LEVEL, "worldql_server_tpu.packets"):
+        trace.trace_packet(Exploding())  # must not touch __str__
+    assert caplog.records == []
+
+
+def test_enabled_emits_at_trace_level(caplog):
+    trace.enable()
+    with caplog.at_level(trace.TRACE_LEVEL, "worldql_server_tpu.packets"):
+        trace.trace_packet("pkt-content")
+    [rec] = caplog.records
+    assert rec.levelno == trace.TRACE_LEVEL
+    assert rec.levelname == "TRACE"
+    assert "pkt-content" in rec.getMessage()
+
+
+def test_router_traces_every_inbound_message(caplog):
+    """The router's single dispatch choke point stands in for the
+    reference's per-handler trace_packet! calls."""
+    from tests.test_engine import Harness
+    from worldql_server_tpu.protocol.types import Instruction, Message, Vector3
+
+    async def scenario():
+        h = Harness()
+        peer = await h.add_peer()
+        trace.enable()
+        with caplog.at_level(trace.TRACE_LEVEL, "worldql_server_tpu.packets"):
+            await h.router.handle_message(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                sender_uuid=peer, world_name="w",
+                position=Vector3(1.0, 2.0, 3.0),
+            ))
+            await h.router.handle_message(Message(
+                instruction=Instruction.HEARTBEAT, sender_uuid=peer,
+            ))
+        texts = [r.getMessage() for r in caplog.records]
+        assert len(texts) == 2
+        assert "AREA_SUBSCRIBE" in texts[0] or "AreaSubscribe" in texts[0]
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_verbosity_3_enables_packet_channel(monkeypatch):
+    from worldql_server_tpu.__main__ import main
+
+    trace.disable()
+    monkeypatch.setattr(logging, "basicConfig", lambda **kw: None)
+
+    # verbose < 3 leaves the channel off; use a config error for a fast
+    # exit after the logging setup has run
+    assert main(["-v", "-v", "--sub-region-size", "0"]) == 1
+    assert not trace.is_enabled()
+    assert main(["-v", "-v", "-v", "--sub-region-size", "0"]) == 1
+    assert trace.is_enabled()
+
+
+def test_env_var_from_dotenv_enables(tmp_path, monkeypatch):
+    """WQL_TRACE_PACKETS=1 in a .env file must work even though trace
+    is imported (and reads the live env) before load_dotenv() runs."""
+    import logging as logging_mod
+
+    from worldql_server_tpu.__main__ import main
+
+    trace.disable()
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".env").write_text("WQL_TRACE_PACKETS=1\n")
+    monkeypatch.delenv("WQL_TRACE_PACKETS", raising=False)
+    monkeypatch.setattr(logging_mod, "basicConfig", lambda **kw: None)
+    assert main(["--sub-region-size", "0"]) == 1  # fast config-error exit
+    assert trace.is_enabled()
+    monkeypatch.delenv("WQL_TRACE_PACKETS", raising=False)
+
+
+def test_env_var_enables_at_import(monkeypatch):
+    import importlib
+
+    monkeypatch.setenv("WQL_TRACE_PACKETS", "1")
+    mod = importlib.reload(trace)
+    try:
+        assert mod.is_enabled()
+    finally:
+        monkeypatch.delenv("WQL_TRACE_PACKETS")
+        importlib.reload(trace)

@@ -16,11 +16,13 @@ import argparse
 import asyncio
 import errno
 import logging
+import os
 import socket
 import sys
 
 from .engine.config import Config
 from .engine.server import WorldQLServer
+from .utils import trace
 from .utils.dotenv import load_dotenv
 from .utils.version import full_version
 from . import __version__
@@ -136,11 +138,17 @@ def main(argv: list[str] | None = None) -> int:
     load_dotenv()
     args = build_parser().parse_args(argv)
 
-    level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    # -v stacks: warning → info → debug → trace-with-packet-dumps
+    # (main.rs:54-65: verbosity 3 turns on the per-packet channel)
+    levels = [logging.WARNING, logging.INFO, logging.DEBUG, trace.TRACE_LEVEL]
     logging.basicConfig(
-        level=level,
+        level=levels[min(args.verbose, 3)],
         format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
     )
+    # re-check after load_dotenv(): the env var may have come from the
+    # .env file, which loads after trace.py's import-time read
+    if args.verbose >= 3 or os.environ.get("WQL_TRACE_PACKETS") == "1":
+        trace.enable()
 
     config = config_from_args(args)
     try:

@@ -24,6 +24,7 @@ from ..spatial.backend import LocalQuery, SpatialBackend
 from ..storage.store import RecordStore
 from ..utils.names import GLOBAL_WORLD, SanitizeError, sanitize_world_name
 from ..utils.timeutil import parse_epoch_millis
+from ..utils.trace import trace_packet
 from .peers import PeerMap
 
 logger = logging.getLogger(__name__)
@@ -53,6 +54,9 @@ class Router:
 
     async def handle_message(self, message: Message) -> None:
         """Route one inbound message (thread.rs:72-108). Never raises."""
+        # Single choke point == the reference's trace_packet! call at
+        # the top of every handler (e.g. heartbeat.rs:10).
+        trace_packet(message)
         if self.metrics is not None:
             self.metrics.inc(_MSG_COUNTERS[message.instruction])
         try:

@@ -33,8 +33,14 @@ def parse_dotenv(text: str) -> dict[str, str]:
             logger.warning(".env line %d ignored: %r", lineno, raw)
             continue
         value = value.strip()
-        if len(value) >= 2 and value[0] in _QUOTES and value[-1] == value[0]:
-            value = value[1:-1]
+        if value[:1] in _QUOTES:
+            quote = value[0]
+            end = value.find(quote, 1)
+            if end < 0:
+                logger.warning(".env line %d ignored: %r", lineno, raw)
+                continue
+            # anything after the closing quote (e.g. a comment) drops
+            value = value[1:end]
         else:
             # unquoted values: strip trailing comments
             value = value.split(" #", 1)[0].rstrip()

@@ -1,0 +1,50 @@
+"""Per-packet trace channel.
+
+Rebuild of the reference's ``trace_packet!`` macro
+(utils/trace_packet.rs:1-7): every inbound packet can be dumped in
+full for protocol debugging, and the channel costs one predictable
+branch per message when off (the reference compiles it out entirely;
+Python's equivalent is a module-level flag checked before any
+formatting work happens — the message is never stringified unless
+enabled).
+
+Enable with ``-v -v -v`` (main.rs:54-65: verbosity 3 = trace) or
+``WQL_TRACE_PACKETS=1``. Records land on the
+``worldql_server_tpu.packets`` logger at the custom TRACE level (5,
+below DEBUG) so they can be filtered or shipped independently of
+application logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+TRACE_LEVEL = 5
+
+logging.addLevelName(TRACE_LEVEL, "TRACE")
+
+_log = logging.getLogger("worldql_server_tpu.packets")
+
+_enabled = os.environ.get("WQL_TRACE_PACKETS") == "1"
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def trace_packet(message) -> None:
+    """Dump one packet. The guard runs before any formatting, so the
+    disabled path does no work beyond this call + branch."""
+    if _enabled:
+        _log.log(TRACE_LEVEL, "%s", message)
