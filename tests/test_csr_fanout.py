@@ -1,0 +1,180 @@
+"""CSR / sparse result-compaction paths (spatial/tpu_backend.py).
+
+The CSR layout is what the bench and distributed delivery consume; the
+two-tier gather (tier 1 at CSR_K_LO, hot runs re-gathered at full K)
+must be indistinguishable from the dense result for every workload
+shape. These tests pin that equivalence against the dense path and the
+CPU oracle, including the overflow-tier sentinel contract.
+"""
+
+import uuid
+
+import numpy as np
+
+from worldql_server_tpu.protocol.types import Replication
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+W = "world"
+
+
+def _peers(n, base=0):
+    return [uuid.UUID(int=base + i + 1) for i in range(n)]
+
+
+def csr_lists(counts, flat, m):
+    counts = np.asarray(counts)[:m]
+    flat = np.asarray(flat)
+    out, pos = [], 0
+    for c in counts:
+        out.append(sorted(int(t) for t in flat[pos:pos + c]))
+        pos += c
+    return out
+
+
+def dense_lists(tgt):
+    return [sorted(int(t) for t in row if t >= 0) for row in tgt]
+
+
+def build_hot_cold(hot_cubes=6, hot_occupancy=40, cold=200):
+    """Index with a few hot cubes (runs far above CSR_K_LO) and many
+    singleton cubes — the Zipf shape the two-tier gather exists for."""
+    b = TpuSpatialBackend(16, compact_threshold=32)
+    rng = np.random.default_rng(3)
+    cubes, peers = [], []
+    pid = 0
+    for h in range(hot_cubes):
+        for _ in range(hot_occupancy):
+            cubes.append([16 * (h + 1), 16, 16])
+            peers.append(uuid.UUID(int=pid + 1))
+            pid += 1
+    for c in range(cold):
+        cubes.append([16 * (c + 1), 16 * 50, 16])
+        peers.append(uuid.UUID(int=pid + 1))
+        pid += 1
+    b.bulk_add_subscriptions(W, peers, np.asarray(cubes, np.int64))
+    b.flush()
+    b.wait_compaction()
+    assert b._base_k > b.CSR_K_LO  # two-tier actually engages
+    # cube labels are max-corner multiples: label c covers (c-16, c],
+    # so c - 0.5 is a position inside cube c
+    return b, np.asarray(cubes, np.float64) - 0.5, peers
+
+
+def query_batch(b, positions, senders, repl=Replication.EXCEPT_SELF):
+    m = len(positions)
+    world_ids = np.zeros(m, np.int32)
+    sender_ids = np.asarray(
+        [b._peer_ids.get(s, -1) for s in senders], np.int32
+    )
+    repls = np.full(m, int(repl), np.int8)
+    return world_ids, np.asarray(positions, np.float64), sender_ids, repls
+
+
+def test_csr_matches_dense_with_hot_cubes():
+    b, sub_pos, peers = build_hot_cold()
+    rng = np.random.default_rng(7)
+    qidx = rng.integers(0, len(sub_pos), 300)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
+
+    dense = b.match_arrays(*batch)
+    # csr_cap sized so the overflow tier (t_cap // 64) fits this
+    # hot-heavy workload (~half the queries hit a hot cube)
+    m, res = b.match_arrays_async(*batch, csr_cap=16384)
+    counts, flat, total = res
+    assert int(total) <= 16384
+    got = csr_lists(counts, flat, m)
+    want = dense_lists(dense)
+    assert got == want
+    # hot queries really did overflow tier 1
+    assert max(len(x) for x in want) > b.CSR_K_LO
+
+
+def test_csr_matches_dense_across_segments_and_replication():
+    """Delta segment + base segment + every replication mode."""
+    b, sub_pos, peers = build_hot_cold(hot_cubes=3, hot_occupancy=30)
+    # post-compaction adds land in the delta segment, one of them hot
+    extra = _peers(25, base=10_000)
+    for p in extra:
+        b.add_subscription(W, p, (16 * 1, 16, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+
+    rng = np.random.default_rng(11)
+    for repl in Replication:
+        qidx = rng.integers(0, len(sub_pos), 120)
+        batch = query_batch(
+            b, sub_pos[qidx], [peers[i] for i in qidx], repl
+        )
+        dense = b.match_arrays(*batch)
+        m, res = b.match_arrays_async(*batch, csr_cap=8192)
+        counts, flat, total = res
+        assert csr_lists(counts, flat, m) == dense_lists(dense)
+
+
+def test_csr_agrees_with_cpu_oracle():
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=4, hot_occupancy=24)
+    cpu = CpuSpatialBackend(16)
+    for p, pos in zip(peers, sub_pos):
+        cpu.add_subscription(W, p, Vector3(*pos))
+
+    rng = np.random.default_rng(13)
+    qidx = rng.integers(0, len(sub_pos), 200)
+    senders = [peers[i] for i in qidx]
+    batch = query_batch(b, sub_pos[qidx], senders)
+    m, res = b.match_arrays_async(*batch, csr_cap=8192)
+    counts, flat, _ = res
+    got = csr_lists(counts, flat, m)
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
+                   Replication.EXCEPT_SELF)
+        for i in qidx
+    ]
+    for g, want in zip(got, cpu.match_local_batch(queries)):
+        assert g == sorted(b._peer_ids[p] for p in want)
+
+
+def test_overflow_tier_exhaustion_signals_retry():
+    """More overflowing (hot) queries than h_cap slots → total returns
+    the impossible t_cap + 1 so callers retry with doubled capacity —
+    never a silently truncated result."""
+    hot_cubes = 80  # > h_cap = max(64, 4096 // 64) = 64
+    b, sub_pos, peers = build_hot_cold(
+        hot_cubes=hot_cubes, hot_occupancy=20, cold=10
+    )
+    # one query per hot cube → 80 overflow rows
+    qpos = np.asarray(
+        [[16 * (h + 1) - 0.5, 15.5, 15.5] for h in range(hot_cubes)]
+    )
+    batch = query_batch(b, qpos, [uuid.uuid4()] * hot_cubes)
+    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    counts, flat, total = res
+    t_cap = 4096
+    assert int(total) == t_cap + 1  # sentinel, not silent truncation
+
+    # the documented retry (doubled capacity) succeeds and is exact
+    m, res = b.match_arrays_async(*batch, csr_cap=2 * t_cap)
+    counts, flat, total = res
+    assert int(total) == hot_cubes * 20
+    dense = b.match_arrays(*batch)
+    assert csr_lists(counts, flat, m) == dense_lists(dense)
+
+
+def test_sparse_path_matches_dense():
+    b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
+    rng = np.random.default_rng(17)
+    qidx = rng.integers(0, len(sub_pos), 100)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
+    dense = b.match_arrays(*batch)
+    m, res = b.match_arrays_async(*batch, max_hits=256)
+    rows, targets, n_hits = res
+    rows = np.asarray(rows)[:int(n_hits)]
+    targets = np.asarray(targets)[:int(n_hits)]
+    want = dense_lists(dense)
+    got = {int(r): sorted(int(t) for t in row if t >= 0)
+           for r, row in zip(rows, targets)}
+    for i, w in enumerate(want):
+        assert got.get(i, []) == w

@@ -36,10 +36,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..spatial.hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to
+from ..spatial.hashing import PAD_KEY, next_pow2, pad_to
 from ..spatial.tpu_backend import (
     TpuSpatialBackend,
-    _XYZ_PAD,
     _alloc_buffers,
     _grow_buffers,
     _scatter_dead,
@@ -90,23 +89,21 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         return NamedSharding(self.mesh, P(*spec))
 
     def _base_specs(self):
-        return (
-            P("space", None), P("space", None),
-            P("space", None, None), P("space", None),
-        )
+        # (key, key2, peer) — all 1-D per-shard stacks
+        return (P("space", None), P("space", None), P("space", None))
 
     def _delta_specs(self):
-        return (P(None), P(None), P(None, None), P(None))
+        return (P(None), P(None), P(None))
 
     def _query_specs(self):
-        return (P("batch"), P("batch"), P("batch", None),
-                P("batch"), P("batch"))
+        # (key, key2, sender, repl)
+        return (P("batch"), P("batch"), P("batch"), P("batch"))
 
     # endregion
 
     # region: device upload seams
 
-    def _upload_base(self, keys, wids, xyz, pids, k) -> dict:
+    def _upload_base(self, keys, keys2, pids, k) -> dict:
         splits = split_at_run_boundaries(keys, self.n_space)
         cap = next_pow2(max(b - a for a, b in zip(splits, splits[1:])))
 
@@ -120,9 +117,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         return {
             "dev": (
                 jax.device_put(stack(keys, PAD_KEY), sub),
-                jax.device_put(stack(wids, NO_WORLD), sub),
-                jax.device_put(stack(xyz, _XYZ_PAD),
-                               self._sharding("space", None, None)),
+                jax.device_put(stack(keys2, np.int64(0)), sub),
                 jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
                                sub),
             ),
@@ -140,8 +135,8 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         recomputes the splits and lays out new space-sharded stacks.
         Runs on the compaction worker thread, so the upload never
         touches the owning event loop."""
-        hk, hw, hx, hp = host_arrays
-        return self._upload_base(hk, hw, hx, hp, k)
+        hk, hk2, hp = host_arrays
+        return self._upload_base(hk, hk2, hp, k)
 
     # -- delta seams: the delta segment is replicated across the mesh,
     # so allocate/write/sort with explicit replicated out_shardings —
@@ -196,7 +191,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             lambda peer, s, l: peer.at[s, l].set(-1, mode="drop"),
             spec=("space", None),
         )
-        return {**bundle, "dev": (*dev[:3], kernel(dev[3], shard, local))}
+        return {**bundle, "dev": (*dev[:2], kernel(dev[2], shard, local))}
 
     # endregion
 
@@ -218,10 +213,10 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         n_seg = len(kinds)
 
         def local(*args):
-            queries = args[4 * n_seg:]
+            queries = args[3 * n_seg:]
             parts = []
             for i, (kind, k) in enumerate(zip(kinds, ks)):
-                seg = args[4 * i:4 * i + 4]
+                seg = args[3 * i:3 * i + 3]
                 if kind == "base":
                     seg = tuple(a[0] for a in seg)  # drop the shard dim
                 parts.append(match_core(*seg, *queries, k=k))

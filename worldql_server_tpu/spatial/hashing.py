@@ -3,14 +3,19 @@
 The device index orders subscriptions by a single scalar key so range
 lookups are two ``searchsorted`` binary searches. A cube identity is
 128+ bits (world i32 + three i64 cube coords), so the key is a seeded
-splitmix64-style hash. Exactness is preserved anyway:
+splitmix64-style hash. Exactness is preserved:
 
 * at flush time the host checks that distinct cubes got distinct keys
   and rehashes with the next seed on collision (expected ~never:
   ~C²/2⁶⁴), so stored cells are injective per epoch;
-* every query verifies the exact (world, cx, cy, cz) against the
-  candidate row, so a query for an absent cube that collides with a
-  stored one is rejected, not mis-routed.
+* every query carries a SECOND independent 64-bit key
+  (:func:`spatial_keys2`) that the device compares against the
+  candidate run's stored second key. A query for an absent cube is
+  mis-routed only if it collides with a stored cube under BOTH hashes
+  (~2⁻¹²⁸ per pair — beyond cosmic-ray territory). Shipping 16 key
+  bytes instead of the raw 28-byte (world, cube) identity halves the
+  per-query transfer and the device index row width — host↔device
+  bandwidth is the fan-out engine's scaling limit, not FLOPs.
 
 All functions are vectorized numpy over uint64 with wrapping overflow —
 the hot encode path runs at memory bandwidth.
@@ -35,6 +40,16 @@ _GOLDEN = np.uint64(MIX_GOLDEN)
 PAD_KEY = np.int64(2**63 - 1)
 # World-id sentinel that never matches a real (>= 0) interned world.
 NO_WORLD = np.int32(-1)
+# Seed-space offset separating the two hash families.
+KEY2_OFFSET = 0x5851F42D4C957F2D
+# Index padding rows pad key2 with 0; padded QUERIES pad with 1, so a
+# padding query probing a segment's padding run (both share PAD_KEY)
+# fails the second-key exactness check and counts as an empty run —
+# without this, padding queries would register as hot-run overflows in
+# the two-tier CSR kernel. (A real query whose key2 happens to be 1 is
+# fine: matches still require key1 equality, and padding rows carry
+# peer -1 anyway.)
+QUERY_PAD_KEY2 = np.int64(1)
 
 
 def _mix(x: np.ndarray) -> np.ndarray:
@@ -54,6 +69,15 @@ def spatial_keys(
         h = _mix(h ^ cubes[..., 1].view(np.uint64))
         h = _mix(h ^ cubes[..., 2].view(np.uint64))
     return h.view(np.int64)
+
+
+def spatial_keys2(
+    world_ids: np.ndarray, cubes: np.ndarray, seed: int = 0
+) -> np.ndarray:
+    """Second, independent key family (same mixer, disjoint seed
+    space): the device-side exactness check compares this instead of
+    the raw (world, cube) tuple."""
+    return spatial_keys(world_ids, cubes, (seed + KEY2_OFFSET) & (2**64 - 1))
 
 
 def next_pow2(n: int, floor: int = 8) -> int:

@@ -11,12 +11,15 @@ Index layout — two segments, LSM-style, so a mutation costs O(log S)
 instead of an O(S) rebuild (the reference's AreaMap does O(1) dict
 updates, area_map.rs:72-85; this is the static-shape analog):
 
-* **base**: large sorted-by-key SoA (``key i64 | world i32 | cube
-  3×i64 | peer i32``). Immutable except for *tombstones*: a removal
-  sets ``peer = -1`` (host + one device scatter per flush). Keys,
-  worlds and cubes never change, so the binary-search run structure
-  and the first-row exactness probe stay valid; dead rows gather as
-  ``-1`` targets, which every consumer already filters.
+* **base**: large sorted-by-key SoA. On device each row is 20 bytes —
+  ``key i64 | key2 i64 | peer i32`` — where ``key2`` is a second,
+  independent hash standing in for the raw (world, cube) identity
+  (hashing.py: combined collision odds ~2⁻¹²⁸); the host keeps the
+  exact ``world``/``cube`` columns as authority. Immutable except for
+  *tombstones*: a removal sets ``peer = -1`` (host + one device
+  scatter per flush). Keys never change, so the binary-search run
+  structure and the first-row exactness probe stay valid; dead rows
+  gather as ``-1`` targets, which every consumer already filters.
 * **delta**: small insertion-ordered append log holding rows added
   since the last compaction. Each flush sorts the *live* delta rows
   (O(D log D), D = churn since compaction) and uploads them as a
@@ -61,7 +64,10 @@ import jax.numpy as jnp
 
 from ..protocol.types import Replication, Vector3
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
-from .hashing import NO_WORLD, PAD_KEY, next_pow2, pad_to, spatial_keys
+from .hashing import (
+    NO_WORLD, PAD_KEY, QUERY_PAD_KEY2, next_pow2, pad_to, spatial_keys,
+    spatial_keys2,
+)
 from .quantize import cube_coords_batch
 
 _log = logging.getLogger(__name__)
@@ -78,8 +84,8 @@ _XYZ_PAD = np.int64(-(2 ** 62))
 
 
 def match_core(
-    sub_key, sub_world, sub_xyz, sub_peer,
-    q_key, q_world, q_xyz, q_sender, q_repl,
+    sub_key, sub_key2, sub_peer,
+    q_key, q_key2, q_sender, q_repl,
     *, k: int,
 ):
     """[M] queries × [S] sorted subscriptions → [M, K] peer ids (-1 pad).
@@ -90,26 +96,33 @@ def match_core(
     and fall out through the same mask that drops replication-filtered
     rows.
     """
+    lo, cnt = _run_bounds(sub_key, sub_key2, q_key, q_key2)
+    return _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, k=k)
+
+
+def _run_bounds(sub_key, sub_key2, q_key, q_key2):
+    """Per-query (run start, run length) in a sorted segment.
+
+    Exactness: the hash locates a candidate run; it counts only if the
+    run's first row also matches under the second, independent key
+    family (spatial/hashing.py: ~2^-128 combined collision odds —
+    16 key bytes replace the 28-byte raw (world, cube) identity on
+    the wire and in the index rows)."""
     s = sub_key.shape[0]
     lo = jnp.searchsorted(sub_key, q_key, side="left")
     hi = jnp.searchsorted(sub_key, q_key, side="right")
     li = jnp.minimum(lo, s - 1)
+    found = (sub_key[li] == q_key) & (sub_key2[li] == q_key2)
+    return lo, jnp.where(found, hi - lo, 0)
 
-    # Exactness: the hash located a candidate run; admit it only if the
-    # run's first row carries the query's exact (world, cube).
-    found = (
-        (sub_key[li] == q_key)
-        & (sub_world[li] == q_world)
-        & jnp.all(sub_xyz[li] == q_xyz, axis=-1)
-    )
-    cnt = jnp.where(found, hi - lo, 0)
 
+def _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, *, k):
+    """Gather up to ``k`` targets per run and apply the tombstone +
+    replication filters (local_message.rs:60-86)."""
     offs = jnp.arange(k, dtype=lo.dtype)
-    gidx = jnp.minimum(lo[:, None] + offs[None, :], s - 1)
+    gidx = jnp.minimum(lo[:, None] + offs[None, :], sub_peer.shape[0] - 1)
     tgt = sub_peer[gidx]
     valid = (offs[None, :] < cnt[:, None]) & (tgt >= 0)
-
-    # Replication filter (local_message.rs:60-86).
     is_sender = tgt == q_sender[:, None]
     repl = q_repl[:, None]
     valid &= jnp.where(
@@ -122,12 +135,12 @@ def match_core(
 
 def _multi_match(flat_args, ks):
     """Match against ``len(ks)`` segments, concatenating the per-query
-    target lists along the K axis. ``flat_args`` is 4 arrays per
-    segment followed by the 5 query arrays."""
+    target lists along the K axis. ``flat_args`` is 3 arrays per
+    segment (key, key2, peer) followed by the 4 query arrays."""
     nseg = len(ks)
-    queries = flat_args[4 * nseg:]
+    queries = flat_args[3 * nseg:]
     parts = [
-        match_core(*flat_args[4 * i:4 * i + 4], *queries, k=ks[i])
+        match_core(*flat_args[3 * i:3 * i + 3], *queries, k=ks[i])
         for i in range(nseg)
     ]
     return parts[0] if nseg == 1 else jnp.concatenate(parts, axis=1)
@@ -156,16 +169,92 @@ def compact_csr(tgt, *, t_cap: int):
     device→host result from M×K to ~total ints (the dominant cost on
     the wire back). On ``total > t_cap`` overflow the tail is dropped;
     callers detect via ``total`` and re-fetch dense."""
-    valid = tgt >= 0
-    cnt = valid.sum(axis=1, dtype=jnp.int32)
+    cnt = (tgt >= 0).sum(axis=1, dtype=jnp.int32)
     starts = jnp.cumsum(cnt) - cnt  # exclusive prefix
-    slot = jnp.cumsum(valid, axis=1) - 1
-    flat_idx = jnp.where(valid, starts[:, None] + slot, t_cap)
-    flat_idx = jnp.minimum(flat_idx, t_cap)  # overflow tail → spill slot
-    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32).at[flat_idx].max(
-        jnp.where(valid, tgt, -1)
-    )
+    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32)
+    flat = _csr_scatter(flat, tgt, starts,
+                        jnp.ones(tgt.shape[0], bool), t_cap)
     return cnt, flat[:t_cap], cnt.sum(dtype=jnp.int32)
+
+
+def _csr_scatter(flat, tgt, starts, row_live, t_cap):
+    """Scatter one tier's [R, K] targets into the CSR flat buffer at
+    ``starts[r] + position-among-valid``. ``row_live`` masks whole rows
+    (rows owned by the other tier scatter nothing)."""
+    present = tgt >= 0
+    valid = present & row_live[:, None]
+    slot = jnp.cumsum(present, axis=1) - 1
+    idx = jnp.where(valid, starts[:, None] + slot, t_cap)
+    idx = jnp.minimum(idx, t_cap)
+    return flat.at[idx].max(jnp.where(valid, tgt, -1))
+
+
+def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
+    """CSR fan-out with a two-tier gather: the gather degree K is set
+    by the HOTTEST cube in a segment, but almost every query's run is
+    tiny — a full-K gather pays the hot cube's cost for all M queries
+    (the dominant kernel cost under Zipf hotspots). Tier 1 gathers
+    min(K, k_lo) per segment for every query; the few queries whose raw
+    run overran k_lo are re-gathered at full K on an ``h_cap``-slot
+    tier. If more than h_cap queries overflow, ``total`` returns the
+    impossible value t_cap + 1 so the host retries with doubled
+    capacities (same contract as a flat-buffer overflow).
+
+    Returns ``(counts[M], flat[t_cap], total)`` like compact_csr."""
+    nseg = len(ks)
+    q_key, q_key2, q_sender, q_repl = flat_args[3 * nseg:]
+    k_los = [min(k, k_lo) for k in ks]
+
+    los, cnts, tier1 = [], [], []
+    for i in range(nseg):
+        sub_key, sub_key2, sub_peer = flat_args[3 * i:3 * i + 3]
+        lo, cnt = _run_bounds(sub_key, sub_key2, q_key, q_key2)
+        los.append(lo)
+        cnts.append(cnt)
+        tier1.append(_gather_filtered(
+            sub_peer, lo, cnt, q_sender, q_repl, k=k_los[i]
+        ))
+    tgt1 = tier1[0] if nseg == 1 else jnp.concatenate(tier1, axis=1)
+
+    # Padding queries never overflow: their key2 pad (QUERY_PAD_KEY2)
+    # deliberately differs from the index rows' key2 pad, so a padding
+    # query's probe of a segment's padding run fails _run_bounds'
+    # second-key check and counts as 0.
+    over = cnts[0] > k_los[0]
+    for i in range(1, nseg):
+        over |= cnts[i] > k_los[i]
+    n_over = over.sum(dtype=jnp.int32)
+
+    # Overflow rows first (stable, so query order is kept within tiers)
+    oidx = jnp.argsort(~over, stable=True)[:h_cap].astype(jnp.int32)
+    ovalid = over[oidx]
+    tier2 = []
+    for i in range(nseg):
+        sub_peer = flat_args[3 * i + 2]
+        tier2.append(_gather_filtered(
+            sub_peer, los[i][oidx], cnts[i][oidx],
+            q_sender[oidx], q_repl[oidx], k=ks[i],
+        ))
+    tgt2 = tier2[0] if nseg == 1 else jnp.concatenate(tier2, axis=1)
+
+    cnt1 = (tgt1 >= 0).sum(axis=1, dtype=jnp.int32)
+    cnt2 = (tgt2 >= 0).sum(axis=1, dtype=jnp.int32)
+    counts = jnp.where(over, 0, cnt1)
+    counts = counts.at[oidx].max(jnp.where(ovalid, cnt2, 0))
+    starts = jnp.cumsum(counts) - counts
+
+    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32)
+    flat = _csr_scatter(flat, tgt1, starts, ~over, t_cap)
+    flat = _csr_scatter(flat, tgt2, starts[oidx], ovalid, t_cap)
+
+    total = counts.sum(dtype=jnp.int32)
+    total = jnp.where(n_over > h_cap, t_cap + 1, total)
+    return counts, flat[:t_cap], total
+
+
+@partial(jax.jit, static_argnames=("ks", "k_lo", "h_cap", "t_cap"))
+def _match_csr2_kernel(*flat_args, ks, k_lo, h_cap, t_cap):
+    return match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap)
 
 
 @partial(jax.jit, static_argnames=("ks",))
@@ -208,7 +297,7 @@ def _write_chunk(bufs, chunks, start):
 @partial(jax.jit, static_argnames=("cap",))
 def _grow_buffers(bufs, cap):
     """Grow the delta buffer to ``cap`` rows on device — no re-upload."""
-    pads = (PAD_KEY, NO_WORLD, _XYZ_PAD, np.int32(-1))
+    pads = (PAD_KEY, np.int64(0), np.int32(-1))
     out = []
     for b, fill in zip(bufs, pads):
         widths = [(0, cap - b.shape[0])] + [(0, 0)] * (b.ndim - 1)
@@ -221,23 +310,22 @@ def _alloc_buffers(cap):
     """Fresh all-padding delta buffer, allocated on device (no H2D)."""
     return (
         jnp.full((cap,), PAD_KEY, jnp.int64),
-        jnp.full((cap,), NO_WORLD, jnp.int32),
-        jnp.full((cap, 3), _XYZ_PAD, jnp.int64),
+        jnp.zeros((cap,), jnp.int64),
         jnp.full((cap,), -1, jnp.int32),
     )
 
 
 @jax.jit
-def _sort_segment_dev(keys, wids, xyz, peers):
+def _sort_segment_dev(keys, keys2, peers):
     """Key-sort a segment on device (the delta buffer is insertion-
     ordered; queries need sorted runs). Stable, so ties keep insertion
     order — matching the host's numpy mirror."""
     order = jnp.argsort(keys, stable=True)
-    return keys[order], wids[order], xyz[order], peers[order]
+    return keys[order], keys2[order], peers[order]
 
 
 @partial(jax.jit, static_argnames=("cap2",))
-def _device_compact(bk, bw, bxyz, bp, dk, dw, dxyz, dp, cap2):
+def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2):
     """Fold base + delta into a fresh sorted base ENTIRELY on device —
     zero host→device transfer (decisive on tunneled/remote devices
     where a full index upload costs seconds).
@@ -248,12 +336,11 @@ def _device_compact(bk, bw, bxyz, bp, dk, dw, dxyz, dp, cap2):
     host applies the identical transform to its numpy mirror, keeping
     row indices aligned with the device (both sorts are stable)."""
     keys = jnp.concatenate([bk, dk])
-    wids = jnp.concatenate([bw, dw])
-    xyz = jnp.concatenate([bxyz, dxyz])
+    keys2 = jnp.concatenate([bk2, dk2])
     peers = jnp.concatenate([bp, dp])
     keys = jnp.where(peers < 0, PAD_KEY, keys)
     order = jnp.argsort(keys, stable=True)[:cap2]
-    return keys[order], wids[order], xyz[order], peers[order]
+    return keys[order], keys2[order], peers[order]
 
 
 class _CollisionError(Exception):
@@ -289,6 +376,9 @@ class TpuSpatialBackend(SpatialBackend):
     #: treats it as wedged and abandons it — a hung device call must not
     #: let the delta log grow without bound
     COMPACT_STALL_SECS = 120.0
+    #: tier-1 gather degree for the CSR path: covers ~p99 of cube runs;
+    #: hotter runs re-gather at full K on the overflow tier
+    CSR_K_LO = 16
 
     def __init__(self, cube_size: int, compact_threshold: int | None = None):
         super().__init__(cube_size)
@@ -303,8 +393,11 @@ class TpuSpatialBackend(SpatialBackend):
         self._dirty = True
         self._compact_threshold_override = compact_threshold
 
-        # base segment (host authority, sorted by key)
+        # base segment (host authority, sorted by key). _bw/_bxyz are
+        # the exact-identity authority (point queries, collision
+        # checks); _bk2 mirrors the device's second-key column.
         self._bk = np.empty(0, np.int64)
+        self._bk2 = np.empty(0, np.int64)
         self._bw = np.empty(0, np.int32)
         self._bxyz = np.empty((0, 3), np.int64)
         self._bp = np.empty(0, np.int32)
@@ -317,6 +410,7 @@ class TpuSpatialBackend(SpatialBackend):
         # delta log (host authority, insertion order, capacity doubling)
         self._dcap = 0
         self._dk = np.empty(0, np.int64)
+        self._dk2 = np.empty(0, np.int64)
         self._dw = np.empty(0, np.int32)
         self._dxyz = np.empty((0, 3), np.int64)
         self._dp = np.empty(0, np.int32)
@@ -554,6 +648,7 @@ class TpuSpatialBackend(SpatialBackend):
             return out
 
         self._dk = grow(self._dk, cap, np.int64)
+        self._dk2 = grow(self._dk2, cap, np.int64)
         self._dw = grow(self._dw, (cap,), np.int32)
         self._dxyz = grow(self._dxyz, (cap, 3), np.int64)
         self._dp = grow(self._dp, (cap,), np.int32)
@@ -1033,10 +1128,14 @@ class TpuSpatialBackend(SpatialBackend):
             self._delta_buf_cap = cap_needed
 
         if dn > built:
+            # second keys are computed lazily here (vectorized over the
+            # new chunk) rather than per-row on the append hot path
+            self._dk2[built:dn] = spatial_keys2(
+                self._dw[built:dn], self._dxyz[built:dn], self._seed
+            )
             chunk = (
                 pad_to(self._dk[built:dn], chunk_n, PAD_KEY),
-                pad_to(self._dw[built:dn], chunk_n, NO_WORLD),
-                pad_to(self._dxyz[built:dn], chunk_n, _XYZ_PAD),
+                pad_to(self._dk2[built:dn], chunk_n, np.int64(0)),
                 pad_to(self._dp[built:dn], chunk_n, np.int32(-1)),
             )
             self._delta_buf = self._write_delta_chunk(
@@ -1049,8 +1148,8 @@ class TpuSpatialBackend(SpatialBackend):
             rows = pad_to(rows, next_pow2(rows.size),
                           np.int32(self._delta_buf_cap))
             self._delta_buf = (
-                *self._delta_buf[:3],
-                self._scatter_delta_dead(self._delta_buf[3], rows),
+                *self._delta_buf[:2],
+                self._scatter_delta_dead(self._delta_buf[2], rows),
             )
             self._pending_delta_dead.clear()
 
@@ -1101,9 +1200,10 @@ class TpuSpatialBackend(SpatialBackend):
         device state == host state up to ``_delta_built_n``."""
         consumed = self._delta_built_n
         snap = {
-            "bk": self._bk, "bw": self._bw, "bxyz": self._bxyz,
-            "bp": self._bp.copy(),
+            "bk": self._bk, "bk2": self._bk2, "bw": self._bw,
+            "bxyz": self._bxyz, "bp": self._bp.copy(),
             "dk": self._dk[:consumed].copy(),
+            "dk2": self._dk2[:consumed].copy(),
             "dw": self._dw[:consumed].copy(),
             "dxyz": self._dxyz[:consumed].copy(),
             "dp": self._dp[:consumed].copy(),
@@ -1144,25 +1244,28 @@ class TpuSpatialBackend(SpatialBackend):
         # host mirror: full-capacity views matching the device layout
         dcap = snap["delta_cap"]
         dk = pad_to(snap["dk"], dcap, PAD_KEY)
+        dk2 = pad_to(snap["dk2"], dcap, np.int64(0))
         dw = pad_to(snap["dw"], dcap, NO_WORLD)
         dxyz = pad_to(snap["dxyz"], dcap, _XYZ_PAD)
         dp = pad_to(snap["dp"], dcap, np.int32(-1))
         keys = np.concatenate([snap["bk"], dk])
+        keys2 = np.concatenate([snap["bk2"], dk2])
         wids = np.concatenate([snap["bw"], dw])
         xyz = np.concatenate([snap["bxyz"], dxyz])
         peers = np.concatenate([snap["bp"], dp])
         keys = np.where(peers < 0, PAD_KEY, keys)
         live_total = int((peers >= 0).sum())
         if live_total == 0:
-            return (np.empty(0, np.int64), np.empty(0, np.int32),
-                    np.empty((0, 3), np.int64), np.empty(0, np.int32),
-                    1, None, 0)
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int32), np.empty((0, 3), np.int64),
+                    np.empty(0, np.int32), 1, None, 0)
         cap2 = next_pow2(live_total)
         order = np.argsort(keys, kind="stable")[:cap2]
-        hk, hw, hx, hp = keys[order], wids[order], xyz[order], peers[order]
+        hk, hk2, hw, hx, hp = (keys[order], keys2[order], wids[order],
+                               xyz[order], peers[order])
         k = next_pow2(_max_run(hk[:live_total]), 8)
-        bundle = self._compact_device(snap, cap2, (hk, hw, hx, hp), k)
-        return (hk, hw, hx, hp, k, bundle, live_total)
+        bundle = self._compact_device(snap, cap2, (hk, hk2, hp), k)
+        return (hk, hk2, hw, hx, hp, k, bundle, live_total)
 
     def _compact_device(self, snap: dict, cap2: int, host_arrays, k) -> dict:
         """Device side of compaction. Single-chip: fold the resident
@@ -1220,9 +1323,10 @@ class TpuSpatialBackend(SpatialBackend):
             # un-dirty flush would early-return and never retry.
             self._dirty = True
             return state["error"]
-        keys, wids, xyz, pids, k, bundle, live_total = state["result"]
+        keys, keys2, wids, xyz, pids, k, bundle, live_total = state["result"]
         self._failed_streak = 0
-        self._bk, self._bw, self._bxyz, self._bp = keys, wids, xyz, pids
+        self._bk, self._bk2 = keys, keys2
+        self._bw, self._bxyz, self._bp = wids, xyz, pids
         self._base_pid_order = None
         self._base_k = k
         self._base_bundle = bundle
@@ -1306,15 +1410,22 @@ class TpuSpatialBackend(SpatialBackend):
         if n:
             cap = next_pow2(n)
             self._bk = pad_to(keys, cap, PAD_KEY)
+            self._bk2 = pad_to(
+                spatial_keys2(
+                    wids.astype(np.int32, copy=False), xyz, self._seed
+                ),
+                cap, np.int64(0),
+            )
             self._bw = pad_to(wids.astype(np.int32, copy=False), cap, NO_WORLD)
             self._bxyz = pad_to(xyz, cap, _XYZ_PAD)
             self._bp = pad_to(pids.astype(np.int32, copy=False), cap,
                               np.int32(-1))
             self._base_bundle = self._upload_base(
-                self._bk, self._bw, self._bxyz, self._bp, self._base_k
+                self._bk, self._bk2, self._bp, self._base_k
             )
         else:
             self._bk = np.empty(0, np.int64)
+            self._bk2 = np.empty(0, np.int64)
             self._bw = np.empty(0, np.int32)
             self._bxyz = np.empty((0, 3), np.int64)
             self._bp = np.empty(0, np.int32)
@@ -1341,13 +1452,12 @@ class TpuSpatialBackend(SpatialBackend):
 
     # region: device upload seams (overridden by the sharded backend)
 
-    def _upload_base(self, keys, wids, xyz, pids, k) -> dict:
+    def _upload_base(self, keys, keys2, pids, k) -> dict:
         cap = next_pow2(keys.size)
         return {
             "dev": (
                 jnp.asarray(pad_to(keys, cap, PAD_KEY)),
-                jnp.asarray(pad_to(wids, cap, NO_WORLD)),
-                jnp.asarray(pad_to(xyz, cap, _XYZ_PAD)),
+                jnp.asarray(pad_to(keys2, cap, np.int64(0))),
                 jnp.asarray(pad_to(pids.astype(np.int32), cap, np.int32(-1))),
             ),
             "cap": cap,
@@ -1357,7 +1467,7 @@ class TpuSpatialBackend(SpatialBackend):
         dev = bundle["dev"]
         cap = bundle["cap"]
         padded = pad_to(rows, next_pow2(rows.size), np.int32(cap))
-        return {**bundle, "dev": (*dev[:3], _scatter_dead(dev[3], padded))}
+        return {**bundle, "dev": (*dev[:2], _scatter_dead(dev[2], padded))}
 
     # endregion
 
@@ -1428,12 +1538,14 @@ class TpuSpatialBackend(SpatialBackend):
 
         cubes = cube_coords_batch(positions, self.cube_size)
         keys = spatial_keys(world_ids, cubes, self._seed)
+        keys2 = spatial_keys2(world_ids, cubes, self._seed)
 
         cap = self._query_cap(m)
+        # 21 B/query on the wire (two keys + sender + replication) —
+        # the raw (world, cube) identity stays on the host.
         queries = (
             pad_to(keys, cap, PAD_KEY),
-            pad_to(world_ids, cap, NO_WORLD),
-            pad_to(cubes, cap, np.int64(0)),
+            pad_to(keys2, cap, QUERY_PAD_KEY2),
             pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
             pad_to(repls.astype(np.int8), cap, np.int8(0)),
         )
@@ -1474,7 +1586,16 @@ class TpuSpatialBackend(SpatialBackend):
 
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
-        return _match_csr_kernel(*flat, *queries, ks=ks, t_cap=t_cap)
+        if max(ks) <= self.CSR_K_LO:
+            return _match_csr_kernel(*flat, *queries, ks=ks, t_cap=t_cap)
+        # Hot-cube index: two-tier gather. Overflow tier sized off the
+        # result capacity so the caller's capacity-doubling retry grows
+        # both together.
+        h_cap = max(64, t_cap // 64)
+        return _match_csr2_kernel(
+            *flat, *queries, ks=ks, k_lo=self.CSR_K_LO,
+            h_cap=h_cap, t_cap=t_cap,
+        )
 
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
