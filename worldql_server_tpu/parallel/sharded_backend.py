@@ -47,6 +47,7 @@ from ..spatial.tpu_backend import (
     compact_csr,
     compact_sparse,
     match_core,
+    run_remainders_np,
 )
 
 
@@ -89,11 +90,12 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         return NamedSharding(self.mesh, P(*spec))
 
     def _base_specs(self):
-        # (key, key2, peer) — all 1-D per-shard stacks
-        return (P("space", None), P("space", None), P("space", None))
+        # (key, key2, peer, run-remainder) — all 1-D per-shard stacks
+        return (P("space", None), P("space", None),
+                P("space", None), P("space", None))
 
     def _delta_specs(self):
-        return (P(None), P(None), P(None))
+        return (P(None), P(None), P(None), P(None))
 
     def _query_specs(self):
         # (key, key2, sender, repl)
@@ -113,13 +115,19 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 for a, b in zip(splits, splits[1:])
             ])
 
+        # runs never straddle a shard boundary (splits snap to run
+        # starts), so each shard's run-remainder column derives from
+        # its own padded key rows
+        padded_keys = stack(keys, PAD_KEY)
+        rems = np.stack([run_remainders_np(row) for row in padded_keys])
         sub = self._sharding("space", None)
         return {
             "dev": (
-                jax.device_put(stack(keys, PAD_KEY), sub),
+                jax.device_put(padded_keys, sub),
                 jax.device_put(stack(keys2, np.int64(0)), sub),
                 jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
                                sub),
+                jax.device_put(rems, sub),
             ),
             "cap": self.n_space * cap,
             "splits": np.asarray(splits, np.int64),
@@ -191,7 +199,10 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             lambda peer, s, l: peer.at[s, l].set(-1, mode="drop"),
             spec=("space", None),
         )
-        return {**bundle, "dev": (*dev[:2], kernel(dev[2], shard, local))}
+        return {
+            **bundle,
+            "dev": (*dev[:2], kernel(dev[2], shard, local), dev[3]),
+        }
 
     # endregion
 
@@ -213,10 +224,10 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         n_seg = len(kinds)
 
         def local(*args):
-            queries = args[3 * n_seg:]
+            queries = args[4 * n_seg:]
             parts = []
             for i, (kind, k) in enumerate(zip(kinds, ks)):
-                seg = args[3 * i:3 * i + 3]
+                seg = args[4 * i:4 * i + 4]
                 if kind == "base":
                     seg = tuple(a[0] for a in seg)  # drop the shard dim
                 parts.append(match_core(*seg, *queries, k=k))

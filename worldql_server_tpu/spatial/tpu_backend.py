@@ -84,7 +84,7 @@ _XYZ_PAD = np.int64(-(2 ** 62))
 
 
 def match_core(
-    sub_key, sub_key2, sub_peer,
+    sub_key, sub_key2, sub_peer, sub_rem,
     q_key, q_key2, q_sender, q_repl,
     *, k: int,
 ):
@@ -96,12 +96,20 @@ def match_core(
     and fall out through the same mask that drops replication-filtered
     rows.
     """
-    lo, cnt = _run_bounds(sub_key, sub_key2, q_key, q_key2)
+    lo, cnt = _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2)
     return _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, k=k)
 
 
-def _run_bounds(sub_key, sub_key2, q_key, q_key2):
+def _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2):
     """Per-query (run start, run length) in a sorted segment.
+
+    One binary search (``side='left'``) instead of two: the segment
+    carries a precomputed per-row run-remainder column (``sub_rem[r]``
+    = rows from r to the end of r's equal-key run), so the run length
+    at ``lo`` is a single [M] gather — half the search cost, which is
+    the kernel's dominant term. Runs never change between compactions
+    (tombstones rewrite peers, not keys), so the column stays valid
+    for a segment's lifetime.
 
     Exactness: the hash locates a candidate run; it counts only if the
     run's first row also matches under the second, independent key
@@ -110,10 +118,26 @@ def _run_bounds(sub_key, sub_key2, q_key, q_key2):
     the wire and in the index rows)."""
     s = sub_key.shape[0]
     lo = jnp.searchsorted(sub_key, q_key, side="left")
-    hi = jnp.searchsorted(sub_key, q_key, side="right")
     li = jnp.minimum(lo, s - 1)
     found = (sub_key[li] == q_key) & (sub_key2[li] == q_key2)
-    return lo, jnp.where(found, hi - lo, 0)
+    return lo, jnp.where(found, sub_rem[li], 0)
+
+
+def run_remainders(sorted_keys):
+    """[S] i32 column: rows from each row to the end of its equal-key
+    run (inclusive). Pure vectorized segment scan — no gathers."""
+    s = sorted_keys.shape[0]
+    idx = jnp.arange(s, dtype=jnp.int32)
+    last = jnp.concatenate([
+        sorted_keys[1:] != sorted_keys[:-1],
+        jnp.ones((1,), bool),
+    ])
+    # exclusive end of each row's run = index of its run's last row + 1,
+    # found by a reverse running-minimum over last-row positions
+    ends = jax.lax.cummin(
+        jnp.where(last, idx, jnp.int32(s - 1)), reverse=True
+    )
+    return ends + 1 - idx
 
 
 def _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, *, k):
@@ -135,12 +159,13 @@ def _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, *, k):
 
 def _multi_match(flat_args, ks):
     """Match against ``len(ks)`` segments, concatenating the per-query
-    target lists along the K axis. ``flat_args`` is 3 arrays per
-    segment (key, key2, peer) followed by the 4 query arrays."""
+    target lists along the K axis. ``flat_args`` is 4 arrays per
+    segment (key, key2, peer, run-remainder) followed by the 4 query
+    arrays."""
     nseg = len(ks)
-    queries = flat_args[3 * nseg:]
+    queries = flat_args[4 * nseg:]
     parts = [
-        match_core(*flat_args[3 * i:3 * i + 3], *queries, k=ks[i])
+        match_core(*flat_args[4 * i:4 * i + 4], *queries, k=ks[i])
         for i in range(nseg)
     ]
     return parts[0] if nseg == 1 else jnp.concatenate(parts, axis=1)
@@ -202,13 +227,13 @@ def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
 
     Returns ``(counts[M], flat[t_cap], total)`` like compact_csr."""
     nseg = len(ks)
-    q_key, q_key2, q_sender, q_repl = flat_args[3 * nseg:]
+    q_key, q_key2, q_sender, q_repl = flat_args[4 * nseg:]
     k_los = [min(k, k_lo) for k in ks]
 
     los, cnts, tier1 = [], [], []
     for i in range(nseg):
-        sub_key, sub_key2, sub_peer = flat_args[3 * i:3 * i + 3]
-        lo, cnt = _run_bounds(sub_key, sub_key2, q_key, q_key2)
+        sub_key, sub_key2, sub_peer, sub_rem = flat_args[4 * i:4 * i + 4]
+        lo, cnt = _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2)
         los.append(lo)
         cnts.append(cnt)
         tier1.append(_gather_filtered(
@@ -230,7 +255,7 @@ def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
     ovalid = over[oidx]
     tier2 = []
     for i in range(nseg):
-        sub_peer = flat_args[3 * i + 2]
+        sub_peer = flat_args[4 * i + 2]
         tier2.append(_gather_filtered(
             sub_peer, los[i][oidx], cnts[i][oidx],
             q_sender[oidx], q_repl[oidx], k=ks[i],
@@ -318,14 +343,16 @@ def _alloc_buffers(cap):
 @jax.jit
 def _sort_segment_dev(keys, keys2, peers):
     """Key-sort a segment on device (the delta buffer is insertion-
-    ordered; queries need sorted runs). Stable, so ties keep insertion
-    order — matching the host's numpy mirror."""
+    ordered; queries need sorted runs) and derive its run-remainder
+    column. Stable, so ties keep insertion order — matching the host's
+    numpy mirror."""
     order = jnp.argsort(keys, stable=True)
-    return keys[order], keys2[order], peers[order]
+    sk = keys[order]
+    return sk, keys2[order], peers[order], run_remainders(sk)
 
 
 @partial(jax.jit, static_argnames=("cap2",))
-def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2):
+def _device_compact(bk, bk2, bp, brem, dk, dk2, dp, cap2):
     """Fold base + delta into a fresh sorted base ENTIRELY on device —
     zero host→device transfer (decisive on tunneled/remote devices
     where a full index upload costs seconds).
@@ -334,13 +361,16 @@ def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2):
     sentinel, so the stable sort sinks them past every live run and the
     leading ``cap2`` rows are exactly the live index plus padding. The
     host applies the identical transform to its numpy mirror, keeping
-    row indices aligned with the device (both sorts are stable)."""
+    row indices aligned with the device (both sorts are stable). The
+    old run-remainder column is discarded; the new base's is derived
+    from the folded keys."""
     keys = jnp.concatenate([bk, dk])
     keys2 = jnp.concatenate([bk2, dk2])
     peers = jnp.concatenate([bp, dp])
     keys = jnp.where(peers < 0, PAD_KEY, keys)
     order = jnp.argsort(keys, stable=True)[:cap2]
-    return keys[order], keys2[order], peers[order]
+    sk = keys[order]
+    return sk, keys2[order], peers[order], run_remainders(sk)
 
 
 class _CollisionError(Exception):
@@ -1454,11 +1484,13 @@ class TpuSpatialBackend(SpatialBackend):
 
     def _upload_base(self, keys, keys2, pids, k) -> dict:
         cap = next_pow2(keys.size)
+        padded_keys = pad_to(keys, cap, PAD_KEY)
         return {
             "dev": (
-                jnp.asarray(pad_to(keys, cap, PAD_KEY)),
+                jnp.asarray(padded_keys),
                 jnp.asarray(pad_to(keys2, cap, np.int64(0))),
                 jnp.asarray(pad_to(pids.astype(np.int32), cap, np.int32(-1))),
+                jnp.asarray(run_remainders_np(padded_keys)),
             ),
             "cap": cap,
         }
@@ -1467,7 +1499,10 @@ class TpuSpatialBackend(SpatialBackend):
         dev = bundle["dev"]
         cap = bundle["cap"]
         padded = pad_to(rows, next_pow2(rows.size), np.int32(cap))
-        return {**bundle, "dev": (*dev[:2], _scatter_dead(dev[2], padded))}
+        return {
+            **bundle,
+            "dev": (*dev[:2], _scatter_dead(dev[2], padded), dev[3]),
+        }
 
     # endregion
 
@@ -1752,6 +1787,21 @@ def _sort_segment(keys, wids, xyz, pids):
         np.ascontiguousarray(xyz[order]),
         np.ascontiguousarray(pids[order].astype(np.int32, copy=False)),
     )
+
+
+def run_remainders_np(sorted_keys: np.ndarray) -> np.ndarray:
+    """Host twin of :func:`run_remainders` (same [S] i32 contract)."""
+    s = sorted_keys.size
+    if s == 0:
+        return np.empty(0, np.int32)
+    idx = np.arange(s, dtype=np.int32)
+    last = np.empty(s, bool)
+    last[:-1] = sorted_keys[1:] != sorted_keys[:-1]
+    last[-1] = True
+    ends = np.minimum.accumulate(
+        np.where(last, idx, np.int32(s - 1))[::-1]
+    )[::-1]
+    return (ends + 1 - idx).astype(np.int32)
 
 
 def _max_run(sorted_keys: np.ndarray) -> int:
