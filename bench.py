@@ -33,6 +33,7 @@ Diagnostics go to stderr. --quick shrinks every shape for smoke runs.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -217,9 +218,16 @@ def bench_config5(args) -> dict:
     for b in batches[:2]:
         _force(tpu.match_arrays_async(*b, csr_cap=csr_cap)[1])
 
-    _, sustained, total_fanout, csr_cap = run_pipelined_adaptive(
-        tpu, batches, csr_cap, depth=8
+    profile_ctx = (
+        jax.profiler.trace(args.profile) if args.profile
+        else contextlib.nullcontext()
     )
+    with profile_ctx:
+        _, sustained, total_fanout, csr_cap = run_pipelined_adaptive(
+            tpu, batches, csr_cap, depth=8
+        )
+    if args.profile:
+        log(f"jax profiler trace written to {args.profile}")
     log(f"tpu: sustained {sustained:.2f} ms/tick  "
         f"avg fan-out {total_fanout / (len(batches) * args.queries):.2f}  "
         f"csr_cap {csr_cap}  "
@@ -681,6 +689,10 @@ def main() -> None:
     ap.add_argument("--cpu-ticks", type=int, default=5)
     ap.add_argument("--quick", action="store_true",
                     help="small shapes for smoke-testing the harness")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="capture a jax.profiler trace of the sustained "
+                         "run (config 5) into DIR (view with xprof/"
+                         "tensorboard)")
     args = ap.parse_args()
     # --quick shrinks the DEFAULT shapes; explicit flags still win
     quick_defaults = (20_000, 1_024, 10) if args.quick \
