@@ -163,6 +163,89 @@ def test_overflow_tier_exhaustion_signals_retry():
     assert csr_lists(counts, flat, m) == dense_lists(dense)
 
 
+def _require_devices(n: int):
+    import jax
+    import pytest
+
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def build_hot_cold_sharded(mesh, hot_cubes=6, hot_occupancy=40, cold=200):
+    from worldql_server_tpu.parallel import ShardedTpuSpatialBackend
+
+    b = ShardedTpuSpatialBackend(16, mesh, compact_threshold=32)
+    cubes, peers = [], []
+    pid = 0
+    for h in range(hot_cubes):
+        for _ in range(hot_occupancy):
+            cubes.append([16 * (h + 1), 16, 16])
+            peers.append(uuid.UUID(int=pid + 1))
+            pid += 1
+    for c in range(cold):
+        cubes.append([16 * (c + 1), 16 * 50, 16])
+        peers.append(uuid.UUID(int=pid + 1))
+        pid += 1
+    b.bulk_add_subscriptions(W, peers, np.asarray(cubes, np.int64))
+    b.flush()
+    b.wait_compaction()
+    assert b._base_k > b.CSR_K_LO
+    return b, np.asarray(cubes, np.float64) - 0.5, peers
+
+
+def test_sharded_csr_two_tier_matches_dense():
+    """The mesh kernel's two-tier gather (overflow mask pmax-merged
+    over 'space' before each batch shard selects) must equal the dense
+    mesh result — including queries whose hot run lives on a single
+    space shard."""
+    _require_devices(8)
+    from worldql_server_tpu.parallel import make_fanout_mesh
+
+    mesh = make_fanout_mesh(2, 4)
+    b, sub_pos, peers = build_hot_cold_sharded(mesh)
+    # post-compaction delta rows too, one hot
+    for p in _peers(20, base=50_000):
+        b.add_subscription(W, p, (16 * 2, 16, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+
+    rng = np.random.default_rng(23)
+    for repl in Replication:
+        qidx = rng.integers(0, len(sub_pos), 160)
+        batch = query_batch(
+            b, sub_pos[qidx], [peers[i] for i in qidx], repl
+        )
+        dense = b.match_arrays(*batch)
+        m, res = b.match_arrays_async(*batch, csr_cap=16384)
+        counts, flat, total = res
+        assert int(total) <= 16384
+        assert csr_lists(counts, flat, m) == dense_lists(dense)
+
+
+def test_sharded_overflow_tier_exhaustion_signals_retry():
+    _require_devices(8)
+    from worldql_server_tpu.parallel import make_fanout_mesh
+
+    mesh = make_fanout_mesh(2, 4)
+    hot_cubes = 160  # > per-batch-shard h_cap = 64 even split over 2
+    b, sub_pos, peers = build_hot_cold_sharded(
+        mesh, hot_cubes=hot_cubes, hot_occupancy=20, cold=10
+    )
+    qpos = np.asarray(
+        [[16 * (h + 1) - 0.5, 15.5, 15.5] for h in range(hot_cubes)]
+    )
+    batch = query_batch(b, qpos, [uuid.uuid4()] * hot_cubes)
+    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    counts, flat, total = res
+    assert int(total) == 4096 + 1  # sentinel
+
+    m, res = b.match_arrays_async(*batch, csr_cap=16384)
+    counts, flat, total = res
+    assert int(total) == hot_cubes * 20
+    dense = b.match_arrays(*batch)
+    assert csr_lists(counts, flat, m) == dense_lists(dense)
+
+
 def test_sparse_path_matches_dense():
     b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
     rng = np.random.default_rng(17)

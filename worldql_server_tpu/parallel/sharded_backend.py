@@ -40,7 +40,10 @@ from ..spatial.hashing import PAD_KEY, next_pow2, pad_to
 from ..spatial.tpu_backend import (
     TpuSpatialBackend,
     _alloc_buffers,
+    _gather_filtered,
     _grow_buffers,
+    _merge_two_tier_csr,
+    _run_bounds,
     _scatter_dead,
     _sort_segment_dev,
     _write_chunk,
@@ -223,14 +226,19 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         mesh = self.mesh
         n_seg = len(kinds)
 
-        def local(*args):
-            queries = args[4 * n_seg:]
-            parts = []
-            for i, (kind, k) in enumerate(zip(kinds, ks)):
+        def local_segs(args):
+            for i, kind in enumerate(kinds):
                 seg = args[4 * i:4 * i + 4]
                 if kind == "base":
                     seg = tuple(a[0] for a in seg)  # drop the shard dim
-                parts.append(match_core(*seg, *queries, k=k))
+                yield seg
+
+        def local(*args):
+            queries = args[4 * n_seg:]
+            parts = [
+                match_core(*seg, *queries, k=k)
+                for seg, k in zip(local_segs(args), ks)
+            ]
             tgt = parts[0] if n_seg == 1 else jnp.concatenate(parts, axis=1)
             # Exactly one 'space' shard holds any cube's base run, and
             # the delta part is identical on every shard — max is a
@@ -244,18 +252,88 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 self._base_specs() if kind == "base" else self._delta_specs()
             )
         ) + self._query_specs()
-        matched = jax.shard_map(
-            local, mesh=mesh, in_specs=in_specs, out_specs=P("batch", None)
-        )
 
-        if variant == "dense":
-            fn = matched
-        elif variant == "sparse":
+        if variant == "csr2":
+            t_cap, h_cap, k_lo = extra
+            k_los = [min(k, k_lo) for k in ks]
+
+            def local2(*args):
+                q_key, q_key2, q_sender, q_repl = args[4 * n_seg:]
+                los, cnts, tier1 = [], [], []
+                for seg, k_l in zip(local_segs(args), k_los):
+                    sub_key, sub_key2, sub_peer, sub_rem = seg
+                    lo, cnt = _run_bounds(
+                        sub_key, sub_key2, sub_rem, q_key, q_key2
+                    )
+                    los.append(lo)
+                    cnts.append(cnt)
+                    tier1.append(_gather_filtered(
+                        sub_peer, lo, cnt, q_sender, q_repl, k=k_l
+                    ))
+                tgt1 = (tier1[0] if n_seg == 1
+                        else jnp.concatenate(tier1, axis=1))
+                tgt1 = jax.lax.pmax(tgt1, "space")
+
+                # a run lives on exactly one space shard, so the global
+                # overflow mask is the pmax union — every space shard
+                # must see it before selecting, or their tier-2 rows
+                # would disagree
+                over_l = cnts[0] > k_los[0]
+                for i in range(1, n_seg):
+                    over_l |= cnts[i] > k_los[i]
+                over = jax.lax.pmax(over_l.astype(jnp.int32), "space") > 0
+                n_over = over.sum(dtype=jnp.int32)
+
+                oidx = jnp.argsort(~over, stable=True)[:h_cap]
+                oidx = oidx.astype(jnp.int32)
+                ovalid = over[oidx]
+                tier2 = []
+                for seg, k, lo, cnt in zip(local_segs(args), ks, los, cnts):
+                    tier2.append(_gather_filtered(
+                        seg[2], lo[oidx], cnt[oidx],
+                        q_sender[oidx], q_repl[oidx], k=k,
+                    ))
+                tgt2 = (tier2[0] if n_seg == 1
+                        else jnp.concatenate(tier2, axis=1))
+                tgt2 = jax.lax.pmax(tgt2, "space")
+
+                # globalize the per-batch-shard selection indices
+                m_local = q_key.shape[0]
+                goidx = oidx + jnp.int32(
+                    jax.lax.axis_index("batch") * m_local
+                )
+                return (tgt1, tgt2, over, goidx, ovalid,
+                        n_over.reshape(1))
+
+            matched2 = jax.shard_map(
+                local2, mesh=mesh, in_specs=in_specs,
+                out_specs=(
+                    P("batch", None), P("batch", None), P("batch"),
+                    P("batch"), P("batch"), P("batch"),
+                ),
+            )
+
             def fn(*args):
-                return compact_sparse(matched(*args), c=extra)
+                tgt1, tgt2, over, goidx, ovalid, n_over = matched2(*args)
+                # each batch shard has its own h_cap slot budget — the
+                # retry sentinel triggers on the worst shard
+                return _merge_two_tier_csr(
+                    tgt1, tgt2, over, goidx, ovalid, n_over.max(),
+                    h_cap, t_cap,
+                )
         else:
-            def fn(*args):
-                return compact_csr(matched(*args), t_cap=extra)
+            matched = jax.shard_map(
+                local, mesh=mesh, in_specs=in_specs,
+                out_specs=P("batch", None),
+            )
+            if variant == "dense":
+                fn = matched
+            elif variant == "sparse":
+                def fn(*args):
+                    return compact_sparse(matched(*args), c=extra)
+            else:
+                def fn(*args):
+                    return compact_csr(matched(*args), t_cap=extra)
 
         in_shardings = tuple(
             NamedSharding(mesh, spec) for spec in in_specs
@@ -281,7 +359,12 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
-        return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
+        if max(ks) <= self.CSR_K_LO:
+            return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
+        # hot-cube index: two-tier gather on the mesh (overflow slots
+        # budgeted per batch shard)
+        extra = (t_cap, self._csr_h_cap(t_cap), self.CSR_K_LO)
+        return self._kernel("csr2", kinds, ks, extra)(*flat, *queries)
 
     # endregion
 

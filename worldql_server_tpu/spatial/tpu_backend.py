@@ -262,6 +262,16 @@ def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
         ))
     tgt2 = tier2[0] if nseg == 1 else jnp.concatenate(tier2, axis=1)
 
+    return _merge_two_tier_csr(
+        tgt1, tgt2, over, oidx, ovalid, n_over, h_cap, t_cap
+    )
+
+
+def _merge_two_tier_csr(tgt1, tgt2, over, oidx, ovalid, n_over, h_cap, t_cap):
+    """Fold the two gather tiers into one CSR result. ``n_over`` is the
+    worst-case overflow-row count against the ``h_cap`` slot budget
+    (per selection domain — the sharded backend passes the max across
+    batch shards, since each shard has its own slot budget)."""
     cnt1 = (tgt1 >= 0).sum(axis=1, dtype=jnp.int32)
     cnt2 = (tgt2 >= 0).sum(axis=1, dtype=jnp.int32)
     counts = jnp.where(over, 0, cnt1)
@@ -1619,17 +1629,22 @@ class TpuSpatialBackend(SpatialBackend):
         flat = [a for seg in segs for a in seg]
         return _match_sparse_kernel(*flat, *queries, ks=ks, c=c)
 
+    @staticmethod
+    def _csr_h_cap(t_cap: int) -> int:
+        """Overflow-tier slot budget, sized off the result capacity so
+        the caller's capacity-doubling retry grows both together.
+        Shared by the single-chip and sharded dispatchers — the retry
+        contract must not drift between them."""
+        return max(64, t_cap // 64)
+
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
         if max(ks) <= self.CSR_K_LO:
             return _match_csr_kernel(*flat, *queries, ks=ks, t_cap=t_cap)
-        # Hot-cube index: two-tier gather. Overflow tier sized off the
-        # result capacity so the caller's capacity-doubling retry grows
-        # both together.
-        h_cap = max(64, t_cap // 64)
+        # hot-cube index: two-tier gather
         return _match_csr2_kernel(
             *flat, *queries, ks=ks, k_lo=self.CSR_K_LO,
-            h_cap=h_cap, t_cap=t_cap,
+            h_cap=self._csr_h_cap(t_cap), t_cap=t_cap,
         )
 
     def match_local_batch(
