@@ -163,6 +163,59 @@ def test_overflow_tier_exhaustion_signals_retry():
     assert csr_lists(counts, flat, m) == dense_lists(dense)
 
 
+def test_delivery_path_uses_csr_and_falls_back_dense_on_overflow():
+    """dispatch/collect_local_batch (the server's tick path) ships CSR;
+    a tick whose fan-out outgrows the capacity hint must deliver
+    exactly the same lists via the dense fallback and raise the hint."""
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=4, hot_occupancy=40)
+    cpu = CpuSpatialBackend(16)
+    for p, pos in zip(peers, sub_pos):
+        cpu.add_subscription(W, p, Vector3(*pos))
+
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
+                   Replication.EXCEPT_SELF)
+        for i in range(0, len(sub_pos), 2)
+    ]
+    want = [sorted(w, key=str) for w in cpu.match_local_batch(queries)]
+
+    def got_lists(res):
+        return [sorted(g, key=str) for g in res]
+
+    # normal path (hint is ample)
+    assert got_lists(b.match_local_batch(queries)) == want
+
+    # force overflow: a tiny hint makes total > t_cap, taking the
+    # dense fallback at collect time
+    b._delivery_cap = 1
+    handle = b.dispatch_local_batch(queries)
+    _, (kind, t_cap, (_, _, total), _) = handle
+    assert kind == "csr"
+    assert int(total) > t_cap  # really overflowed
+    got = got_lists(b.collect_local_batch(handle))
+    assert got == want
+    assert b._delivery_cap > 1  # hint grew for future ticks
+    # and the grown hint serves the CSR path again
+    assert got_lists(b.match_local_batch(queries)) == want
+
+    # a batch whose capacity hint reaches the true fan-out ceiling
+    # (m * sum K) dispatches dense instead — CSR saves nothing there,
+    # and a persistent overflow always escapes this way
+    b._delivery_cap = 1 << 20
+    handle1 = b.dispatch_local_batch(queries[:1])
+    assert handle1[1][0] == "dense"
+    assert got_lists(b.collect_local_batch(handle1)) == want[:1]
+
+    # ...and the inflated hint decays back toward observed need
+    before = b._delivery_cap
+    for _ in range(3):
+        b.match_local_batch(queries)
+    assert b._delivery_cap < before
+
+
 def _require_devices(n: int):
     import jax
     import pytest

@@ -40,10 +40,9 @@ from ..spatial.hashing import PAD_KEY, next_pow2, pad_to
 from ..spatial.tpu_backend import (
     TpuSpatialBackend,
     _alloc_buffers,
-    _gather_filtered,
+    _concat_parts,
     _grow_buffers,
     _merge_two_tier_csr,
-    _run_bounds,
     _scatter_dead,
     _sort_segment_dev,
     _write_chunk,
@@ -51,6 +50,8 @@ from ..spatial.tpu_backend import (
     compact_sparse,
     match_core,
     run_remainders_np,
+    two_tier_first_pass,
+    two_tier_second_pass,
 )
 
 
@@ -255,50 +256,31 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
         if variant == "csr2":
             t_cap, h_cap, k_lo = extra
-            k_los = [min(k, k_lo) for k in ks]
 
             def local2(*args):
-                q_key, q_key2, q_sender, q_repl = args[4 * n_seg:]
-                los, cnts, tier1 = [], [], []
-                for seg, k_l in zip(local_segs(args), k_los):
-                    sub_key, sub_key2, sub_peer, sub_rem = seg
-                    lo, cnt = _run_bounds(
-                        sub_key, sub_key2, sub_rem, q_key, q_key2
-                    )
-                    los.append(lo)
-                    cnts.append(cnt)
-                    tier1.append(_gather_filtered(
-                        sub_peer, lo, cnt, q_sender, q_repl, k=k_l
-                    ))
-                tgt1 = (tier1[0] if n_seg == 1
-                        else jnp.concatenate(tier1, axis=1))
-                tgt1 = jax.lax.pmax(tgt1, "space")
+                segs = list(local_segs(args))
+                queries = args[4 * n_seg:]
+                parts, over_l, los, cnts = two_tier_first_pass(
+                    segs, ks, k_lo, queries
+                )
+                tgt1 = jax.lax.pmax(_concat_parts(parts), "space")
 
                 # a run lives on exactly one space shard, so the global
                 # overflow mask is the pmax union — every space shard
                 # must see it before selecting, or their tier-2 rows
                 # would disagree
-                over_l = cnts[0] > k_los[0]
-                for i in range(1, n_seg):
-                    over_l |= cnts[i] > k_los[i]
                 over = jax.lax.pmax(over_l.astype(jnp.int32), "space") > 0
                 n_over = over.sum(dtype=jnp.int32)
 
                 oidx = jnp.argsort(~over, stable=True)[:h_cap]
                 oidx = oidx.astype(jnp.int32)
                 ovalid = over[oidx]
-                tier2 = []
-                for seg, k, lo, cnt in zip(local_segs(args), ks, los, cnts):
-                    tier2.append(_gather_filtered(
-                        seg[2], lo[oidx], cnt[oidx],
-                        q_sender[oidx], q_repl[oidx], k=k,
-                    ))
-                tgt2 = (tier2[0] if n_seg == 1
-                        else jnp.concatenate(tier2, axis=1))
-                tgt2 = jax.lax.pmax(tgt2, "space")
+                tgt2 = jax.lax.pmax(_concat_parts(two_tier_second_pass(
+                    segs, ks, los, cnts, oidx, queries
+                )), "space")
 
                 # globalize the per-batch-shard selection indices
-                m_local = q_key.shape[0]
+                m_local = queries[0].shape[0]
                 goidx = oidx + jnp.int32(
                     jax.lax.axis_index("batch") * m_local
                 )

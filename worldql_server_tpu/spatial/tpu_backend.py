@@ -214,6 +214,50 @@ def _csr_scatter(flat, tgt, starts, row_live, t_cap):
     return flat.at[idx].max(jnp.where(valid, tgt, -1))
 
 
+def two_tier_first_pass(segs, ks, k_lo, queries):
+    """Tier 1 of the two-tier gather: per-segment run bounds + a
+    min(K, k_lo) gather for every query, and the raw overflow mask.
+    ``segs`` is a list of (key, key2, peer, run_rem) tuples. Returns
+    ``(tgt1_parts, over, los, cnts)`` — the caller merges parts and
+    (on a mesh) unions the mask across shards before selection.
+
+    Padding queries never overflow: their key2 pad (QUERY_PAD_KEY2)
+    deliberately differs from the index rows' key2 pad, so a padding
+    query's probe of a segment's padding run fails _run_bounds' second-
+    key check and counts as 0."""
+    q_key, q_key2, q_sender, q_repl = queries
+    los, cnts, parts = [], [], []
+    over = None
+    for (sub_key, sub_key2, sub_peer, sub_rem), k in zip(segs, ks):
+        k_l = min(k, k_lo)
+        lo, cnt = _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2)
+        los.append(lo)
+        cnts.append(cnt)
+        parts.append(_gather_filtered(
+            sub_peer, lo, cnt, q_sender, q_repl, k=k_l
+        ))
+        seg_over = cnt > k_l
+        over = seg_over if over is None else over | seg_over
+    return parts, over, los, cnts
+
+
+def two_tier_second_pass(segs, ks, los, cnts, oidx, queries):
+    """Tier 2: re-gather the selected (overflowing) queries at full K
+    per segment. Returns the per-segment target parts."""
+    _, _, q_sender, q_repl = queries
+    return [
+        _gather_filtered(
+            seg[2], lo[oidx], cnt[oidx],
+            q_sender[oidx], q_repl[oidx], k=k,
+        )
+        for seg, k, lo, cnt in zip(segs, ks, los, cnts)
+    ]
+
+
+def _concat_parts(parts):
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
     """CSR fan-out with a two-tier gather: the gather degree K is set
     by the HOTTEST cube in a segment, but almost every query's run is
@@ -227,41 +271,19 @@ def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
 
     Returns ``(counts[M], flat[t_cap], total)`` like compact_csr."""
     nseg = len(ks)
-    q_key, q_key2, q_sender, q_repl = flat_args[4 * nseg:]
-    k_los = [min(k, k_lo) for k in ks]
+    segs = [tuple(flat_args[4 * i:4 * i + 4]) for i in range(nseg)]
+    queries = flat_args[4 * nseg:]
 
-    los, cnts, tier1 = [], [], []
-    for i in range(nseg):
-        sub_key, sub_key2, sub_peer, sub_rem = flat_args[4 * i:4 * i + 4]
-        lo, cnt = _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2)
-        los.append(lo)
-        cnts.append(cnt)
-        tier1.append(_gather_filtered(
-            sub_peer, lo, cnt, q_sender, q_repl, k=k_los[i]
-        ))
-    tgt1 = tier1[0] if nseg == 1 else jnp.concatenate(tier1, axis=1)
-
-    # Padding queries never overflow: their key2 pad (QUERY_PAD_KEY2)
-    # deliberately differs from the index rows' key2 pad, so a padding
-    # query's probe of a segment's padding run fails _run_bounds'
-    # second-key check and counts as 0.
-    over = cnts[0] > k_los[0]
-    for i in range(1, nseg):
-        over |= cnts[i] > k_los[i]
+    parts, over, los, cnts = two_tier_first_pass(segs, ks, k_lo, queries)
+    tgt1 = _concat_parts(parts)
     n_over = over.sum(dtype=jnp.int32)
 
     # Overflow rows first (stable, so query order is kept within tiers)
     oidx = jnp.argsort(~over, stable=True)[:h_cap].astype(jnp.int32)
     ovalid = over[oidx]
-    tier2 = []
-    for i in range(nseg):
-        sub_peer = flat_args[4 * i + 2]
-        tier2.append(_gather_filtered(
-            sub_peer, los[i][oidx], cnts[i][oidx],
-            q_sender[oidx], q_repl[oidx], k=ks[i],
-        ))
-    tgt2 = tier2[0] if nseg == 1 else jnp.concatenate(tier2, axis=1)
-
+    tgt2 = _concat_parts(
+        two_tier_second_pass(segs, ks, los, cnts, oidx, queries)
+    )
     return _merge_two_tier_csr(
         tgt1, tgt2, over, oidx, ovalid, n_over, h_cap, t_cap
     )
@@ -478,6 +500,9 @@ class TpuSpatialBackend(SpatialBackend):
         self.compactions = 0
         self.compaction_failures = 0
         self._failed_streak = 0
+        # CSR result-capacity hint for the delivery path; grows on
+        # overflow (collect_local_batch)
+        self._delivery_cap = 4096
 
         # pid → base rows: lazily built per base epoch (argsort of the
         # peer column, O(S log S) once), then each eviction is two
@@ -1581,19 +1606,21 @@ class TpuSpatialBackend(SpatialBackend):
         if not segs or m == 0:
             return m, None
 
-        cubes = cube_coords_batch(positions, self.cube_size)
-        keys = spatial_keys(world_ids, cubes, self._seed)
-        keys2 = spatial_keys2(world_ids, cubes, self._seed)
-
-        cap = self._query_cap(m)
-        # 21 B/query on the wire (two keys + sender + replication) —
-        # the raw (world, cube) identity stays on the host.
-        queries = (
-            pad_to(keys, cap, PAD_KEY),
-            pad_to(keys2, cap, QUERY_PAD_KEY2),
-            pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
-            pad_to(repls.astype(np.int8), cap, np.int8(0)),
+        queries = self._prepare_queries(
+            world_ids, positions, sender_ids, repls
         )
+        result = self._launch(
+            queries, segs, ks, kinds, csr_cap=csr_cap, max_hits=max_hits
+        )
+        return m, result[0] if max_hits is None and csr_cap is None else result
+
+    def _launch(self, queries, segs, ks, kinds, *, csr_cap=None,
+                max_hits=None):
+        """Pick the result layout, dispatch, and enqueue the D2H
+        prefetch (by the time a pipelined caller reads, the copy has
+        landed — the read costs no round-trip). Returns a tuple of
+        device arrays. Shared by the array API and the server delivery
+        path so the dispatch pipeline cannot drift between them."""
         if csr_cap is not None:
             result = self._dispatch_csr(
                 queries, segs, ks, kinds, next_pow2(csr_cap)
@@ -1604,18 +1631,31 @@ class TpuSpatialBackend(SpatialBackend):
             )
         else:
             result = (self._dispatch(queries, segs, ks, kinds),)
-        # Enqueue D2H now: by the time a pipelined caller reads the
-        # result, the copy has landed — the read costs no round-trip.
         for r in result:
             copy = getattr(r, "copy_to_host_async", None)
             if copy is not None:
                 copy()
-        return m, result[0] if max_hits is None and csr_cap is None else result
+        return result
 
     def _query_cap(self, m: int) -> int:
         """Padded query-batch capacity tier; sharded backends round to
         their batch-axis divisibility."""
         return next_pow2(m)
+
+    def _prepare_queries(self, world_ids, positions, sender_ids, repls):
+        """Quantize + hash + pad one query batch into the device query
+        tuple. 21 B/query on the wire (two keys + sender + replication)
+        — the raw (world, cube) identity stays on the host."""
+        cubes = cube_coords_batch(positions, self.cube_size)
+        keys = spatial_keys(world_ids, cubes, self._seed)
+        keys2 = spatial_keys2(world_ids, cubes, self._seed)
+        cap = self._query_cap(len(world_ids))
+        return (
+            pad_to(keys, cap, PAD_KEY),
+            pad_to(keys2, cap, QUERY_PAD_KEY2),
+            pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
+            pad_to(repls.astype(np.int8), cap, np.int8(0)),
+        )
 
     def _dispatch(self, queries: tuple, segs, ks, kinds):
         """Run the padded query arrays against the device segments.
@@ -1677,20 +1717,86 @@ class TpuSpatialBackend(SpatialBackend):
         repls = np.fromiter(
             (int(q.replication) for q in queries), dtype=np.int8, count=m
         )
-        return self.match_arrays_async(world_ids, positions, sender_ids, repls)
+        self.flush()
+        segs, ks, kinds = self._segments()
+        if not segs:
+            return (m, None)
+        qtuple = self._prepare_queries(
+            world_ids, positions, sender_ids, repls
+        )
+        # CSR delivery: the result ships ~total ints instead of a dense
+        # [M, K] table (K is set by the hottest cube). The capacity
+        # hint adapts to the observed fan-out. m * sum(K) is the true
+        # fan-out ceiling: once the hint reaches it, CSR saves nothing
+        # over dense — and dispatching dense there also guarantees a
+        # persistent overflow (e.g. overflow-tier exhaustion at a
+        # clamped t_cap) always escapes instead of re-dispatching
+        # forever.
+        ceiling = next_pow2(m * sum(ks))
+        t_cap = next_pow2(max(self._delivery_cap, 2 * m))
+        if t_cap >= ceiling:
+            (tgt,) = self._launch(qtuple, segs, ks, kinds)
+            return (m, ("dense", tgt))
+        result = self._launch(qtuple, segs, ks, kinds, csr_cap=t_cap)
+        return (m, ("csr", t_cap, result, (qtuple, segs, ks, kinds)))
 
     def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
         """Wait for a dispatched batch and decode fan-out UUID lists.
-        Thread-safe against concurrent interning: peer ids are
-        append-only, so index reads stay valid."""
-        m, result = handle
-        if result is None:
+        Safe on a worker thread: peer ids are append-only (index reads
+        stay valid), and the overflow fallback re-dispatches the device
+        arrays CAPTURED at dispatch time — it never touches host state
+        the owning thread could be mutating."""
+        m, payload = handle
+        if payload is None:
             return [[] for _ in range(m)]
-        tgt = np.asarray(result)[:m]
+        peer_list = self._peer_list
+        if payload[0] == "dense":
+            tgt = np.asarray(payload[1])[:m]
+            counts, flat = _dense_to_csr(tgt)
+            # the hint must keep adapting here too, or a flash-crowd
+            # inflation would park every batch on the dense ceiling
+            # path forever
+            self._adapt_delivery_cap(counts, grow=False)
+            return self._decode_csr(counts, flat)
+        _, t_cap, (counts, flat, total), ctx = payload
+        total = int(total)
+        if total > t_cap:
+            # Rare: the tick's fan-out outgrew the hint (or the
+            # overflow tier) — re-resolve dense against the same index
+            # snapshot and raise the hint for future ticks. ``total``
+            # is exact unless it is the t_cap+1 overflow-tier sentinel,
+            # so convergence is one tick, not log2 doubling steps.
+            self._delivery_cap = max(
+                t_cap * 2 if total == t_cap + 1
+                else next_pow2(2 * total),
+                self._delivery_cap,
+            )
+            qtuple, segs, ks, kinds = ctx
+            tgt = np.asarray(self._dispatch(qtuple, segs, ks, kinds))[:m]
+            return self._decode_csr(*_dense_to_csr(tgt))
+        counts = np.asarray(counts)[:m]
+        self._adapt_delivery_cap(counts, grow=True)
+        return self._decode_csr(counts, np.asarray(flat))
 
-        mask = tgt >= 0
-        counts = mask.sum(axis=1)
-        flat = tgt[mask]
+    def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool) -> None:
+        """Track the capacity the observed tick actually needed: flat
+        slots for the total fan-out AND an overflow tier (t_cap // 64)
+        big enough for the hot-run rows — decaying below that would
+        oscillate between sentinel overflow and decay forever. Grows
+        immediately, decays by halves (one flash-crowd tick must not
+        inflate every future tick's D2H)."""
+        total = int(counts.sum())
+        # filtered counts under-estimate raw run length; 128x (2x the
+        # h_cap divisor) leaves slack for that
+        n_hot = int((counts > self.CSR_K_LO).sum())
+        needed = next_pow2(max(2 * total, 128 * n_hot, 64))
+        if needed >= self._delivery_cap:
+            if grow:
+                self._delivery_cap = needed
+        else:
+            self._delivery_cap = max(needed, self._delivery_cap // 2)
+
+    def _decode_csr(self, counts, flat) -> list[list[uuid_mod.UUID]]:
         peer_list = self._peer_list
         out: list[list[uuid_mod.UUID]] = []
         pos = 0
@@ -1802,6 +1908,13 @@ def _sort_segment(keys, wids, xyz, pids):
         np.ascontiguousarray(xyz[order]),
         np.ascontiguousarray(pids[order].astype(np.int32, copy=False)),
     )
+
+
+def _dense_to_csr(tgt: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized compaction of a dense [M, K] host table to CSR
+    (counts, flat) — touches only the real hits, not M*K cells."""
+    mask = tgt >= 0
+    return mask.sum(axis=1), tgt[mask]
 
 
 def run_remainders_np(sorted_keys: np.ndarray) -> np.ndarray:
