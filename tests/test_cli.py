@@ -59,6 +59,8 @@ def test_load_dotenv_missing_file_is_fine(tmp_path):
 def test_dotenv_feeds_config(tmp_path, monkeypatch):
     """A .env in the working directory supplies WQL_* fallbacks, the
     same as the reference's dotenv() before Args::parse."""
+    import os
+
     monkeypatch.chdir(tmp_path)
     (tmp_path / ".env").write_text("WQL_SUBSCRIPTION_REGION_CUBE_SIZE=48\n")
     monkeypatch.delenv("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", raising=False)
@@ -66,7 +68,10 @@ def test_dotenv_feeds_config(tmp_path, monkeypatch):
     try:
         assert Config().sub_region_size == 48
     finally:
-        monkeypatch.delenv("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", raising=False)
+        # plain pop, NOT monkeypatch.delenv: delenv would record the
+        # leaked value and monkeypatch teardown would RESTORE it,
+        # poisoning every later Config() in the session
+        os.environ.pop("WQL_SUBSCRIPTION_REGION_CUBE_SIZE", None)
 
 
 # endregion

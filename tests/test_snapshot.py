@@ -1,0 +1,221 @@
+"""Subscription-index snapshot/restore (spatial/snapshot.py).
+
+The reference loses all subscriptions on restart; the snapshot lets a
+server checkpoint its index at shutdown and serve identical fan-out
+after reboot without a re-subscribe storm.
+"""
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.protocol.types import Replication, Vector3
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.spatial.snapshot import (
+    SnapshotError, load_snapshot, save_snapshot,
+)
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+
+def populate(b, n=150, worlds=("alpha", "beta")):
+    rng = np.random.default_rng(5)
+    peers = [uuid.UUID(int=i + 1) for i in range(n)]
+    pos = rng.uniform(-200, 200, (n, 3))
+    for i, p in enumerate(peers):
+        b.add_subscription(worlds[i % len(worlds)], p, Vector3(*pos[i]))
+    # churn: some removals and a disconnect, so tombstones are live
+    for i in range(0, n, 7):
+        b.remove_subscription(
+            worlds[i % len(worlds)], peers[i], Vector3(*pos[i])
+        )
+    b.remove_peer(peers[3])
+    b.flush()
+    return peers, pos, worlds
+
+
+def assert_equivalent(a, b, peers, pos, worlds):
+    assert b.subscription_count() == a.subscription_count()
+    for w in worlds:
+        assert b.query_world(w) == a.query_world(w)
+        assert b.cube_count(w) == a.cube_count(w)
+    queries = [
+        LocalQuery(worlds[i % len(worlds)], Vector3(*pos[i]),
+                   peers[i], Replication.EXCEPT_SELF)
+        for i in range(0, len(peers), 5)
+    ]
+    for got, want in zip(b.match_local_batch(queries),
+                         a.match_local_batch(queries)):
+        assert set(got) == set(want)
+
+
+@pytest.mark.parametrize("make", [
+    lambda: CpuSpatialBackend(16),
+    lambda: TpuSpatialBackend(16),
+    lambda: TpuSpatialBackend(16, compact_threshold=16),
+], ids=["cpu", "tpu", "tpu-compacted"])
+def test_snapshot_roundtrip(tmp_path, make):
+    src = make()
+    peers, pos, worlds = populate(src)
+    if hasattr(src, "wait_compaction"):
+        src.wait_compaction()
+    path = str(tmp_path / "index.npz")
+    saved = save_snapshot(src, path)
+    assert saved == src.subscription_count()
+
+    dst = make()
+    restored, restored_peers = load_snapshot(dst, path)
+    assert restored == saved
+    assert set(restored_peers) <= set(peers)
+    assert_equivalent(src, dst, peers, pos, worlds)
+
+
+def test_snapshot_cross_backend(tmp_path):
+    """A CPU-built snapshot restores into the TPU backend and vice
+    versa — the format carries semantics, not layout."""
+    cpu = CpuSpatialBackend(16)
+    peers, pos, worlds = populate(cpu)
+    path = str(tmp_path / "x.npz")
+    save_snapshot(cpu, path)
+    tpu = TpuSpatialBackend(16)
+    load_snapshot(tpu, path)
+    assert_equivalent(cpu, tpu, peers, pos, worlds)
+
+    path2 = str(tmp_path / "y.npz")
+    save_snapshot(tpu, path2)
+    cpu2 = CpuSpatialBackend(16)
+    load_snapshot(cpu2, path2)
+    assert_equivalent(tpu, cpu2, peers, pos, worlds)
+
+
+def test_snapshot_rejects_wrong_grid(tmp_path):
+    b = CpuSpatialBackend(16)
+    populate(b, n=10)
+    path = str(tmp_path / "g.npz")
+    save_snapshot(b, path)
+    other = CpuSpatialBackend(32)
+    with pytest.raises(SnapshotError, match="cube_size"):
+        load_snapshot(other, path)
+    assert other.subscription_count() == 0  # never half-loaded
+
+
+def test_server_restart_keeps_subscriptions(tmp_path):
+    """e2e: subscribe over a real WebSocket, stop the server, boot a
+    NEW server on the same snapshot path — fan-out works without
+    re-subscribing."""
+    from tests.client_util import WsClient, free_port
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+    from worldql_server_tpu.protocol.types import Instruction, Message
+
+    snap = str(tmp_path / "server-index.npz")
+
+    def make_config():
+        config = Config(store_url="memory://")
+        config.ws_port = free_port()
+        config.http_enabled = False
+        config.zmq_enabled = False
+        config.spatial_backend = "tpu"
+        config.index_snapshot = snap
+        return config
+
+    async def scenario():
+        pos = Vector3(5.0, 5.0, 5.0)
+        server = WorldQLServer(make_config())
+        await server.start()
+        listener = await WsClient.connect(server.config.ws_port)
+        await listener.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name="w", position=pos,
+        ))
+        await asyncio.sleep(0.2)
+        listener_uuid = listener.uuid
+        # stop with the client still connected: the checkpoint must
+        # capture the SERVING state, before transport close evicts the
+        # connected peers
+        await server.stop()
+        await listener.connection.close()
+
+        server2 = WorldQLServer(make_config())
+        await server2.start()
+        try:
+            # restored WITHOUT any re-subscribe
+            assert server2.backend.is_subscribed_any("w", listener_uuid)
+            got = server2.backend.match_local_batch([LocalQuery(
+                "w", pos, uuid.uuid4(), Replication.EXCEPT_SELF,
+            )])
+            assert got == [[listener_uuid]]
+        finally:
+            await server2.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_restored_peers_swept_if_they_never_reconnect(tmp_path):
+    """Restored subscriptions must not leak across restart cycles:
+    peers absent one staleness window after boot lose their rows
+    (WS UUIDs are per-connection, so WS rows are always swept)."""
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    snap = str(tmp_path / "sweep.npz")
+    src = CpuSpatialBackend(16)
+    ghost = uuid.uuid4()
+    src.add_subscription("w", ghost, Vector3(1.0, 2.0, 3.0))
+    save_snapshot(src, snap)
+
+    config = Config(store_url="memory://")
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_enabled = False
+    config.spatial_backend = "cpu"
+    config.index_snapshot = snap
+    config.zmq_timeout_secs = 0  # immediate sweep window for the test
+
+    async def scenario():
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            assert server.backend.is_subscribed_any("w", ghost)
+            for _ in range(50):
+                await asyncio.sleep(0.02)
+                if not server.backend.is_subscribed_any("w", ghost):
+                    break
+            assert not server.backend.is_subscribed_any("w", ghost)
+            assert server.backend.subscription_count() == 0
+        finally:
+            await server.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_failed_load_never_clobbers_the_snapshot(tmp_path):
+    """If the boot-time load fails, the shutdown save is disabled —
+    the failing-but-intact file must survive for a fixed binary to
+    restore, never be overwritten with an empty index."""
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    snap = tmp_path / "keep.npz"
+    snap.write_bytes(b"not a real npz")
+    original = snap.read_bytes()
+
+    config = Config(store_url="memory://")
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_enabled = False
+    config.index_snapshot = str(snap)
+
+    async def scenario():
+        server = WorldQLServer(config)
+        await server.start()  # load fails, logged, serves empty
+        assert server._snapshot_save_disabled
+        await server.stop()
+        return True
+
+    assert asyncio.run(scenario())
+    assert snap.read_bytes() == original  # untouched

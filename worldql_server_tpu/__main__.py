@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sharded backend: data-parallel query axis size")
     p.add_argument("--mesh-space", type=int,
                    help="sharded backend: space-shard axis size (0 = rest)")
+    p.add_argument("--index-snapshot",
+                   help="subscription-index snapshot file: loaded at "
+                        "boot if present, saved at shutdown")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -76,7 +79,7 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
-    "mesh_batch", "mesh_space",
+    "mesh_batch", "mesh_space", "index_snapshot",
 ]
 
 

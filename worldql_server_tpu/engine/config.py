@@ -103,6 +103,12 @@ class Config:
     mesh_space: int = field(
         default_factory=lambda: int(_env("WQL_MESH_SPACE", "0"))
     )
+    # Subscription-index snapshot file: loaded at boot if present,
+    # saved at shutdown. Empty/None disables (reference semantics:
+    # subscriptions are lost on restart).
+    index_snapshot: str | None = field(
+        default_factory=lambda: os.environ.get("WQL_INDEX_SNAPSHOT")
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation

@@ -76,6 +76,8 @@ class WorldQLServer:
         self._tasks: list[asyncio.Task] = []
         self._transports: list = []
         self._started = asyncio.Event()
+        self._restored_peers: list = []
+        self._snapshot_save_disabled = False
 
     def _register_gauges(self) -> None:
         self.metrics.gauge("peers", self.peer_map.size)
@@ -107,6 +109,7 @@ class WorldQLServer:
     async def start(self) -> None:
         """Bring up the store and all enabled transports (main.rs:106-207)."""
         await self.store.init()
+        self._restore_index_snapshot()
 
         if self.config.ws_enabled:
             from ..transports.websocket import WebSocketTransport
@@ -137,6 +140,11 @@ class WorldQLServer:
         if self.ticker is not None:
             self.ticker.start()
 
+        if self._restored_peers:
+            self._tasks.append(asyncio.create_task(
+                self._sweep_restored_peers(), name="restored-peer-sweep"
+            ))
+
         self._started.set()
         logger.info("worldql-server-tpu started")
 
@@ -150,7 +158,78 @@ class WorldQLServer:
                 logger.info("removing stale peer: %s", uuid)
                 await self.peer_map.remove(uuid)
 
+    def _restore_index_snapshot(self) -> None:
+        """Reload the subscription index saved by the last shutdown —
+        clients that reconnect under the SAME UUID (ZeroMQ peers pick
+        their own) keep their area subscriptions across a restart
+        instead of the reference's re-subscribe storm (SURVEY §5:
+        subscriptions are ephemeral there). Restored rows whose owner
+        has not reconnected within the staleness window are swept, so
+        departed peers (and WebSocket peers, whose UUIDs are assigned
+        per connection) can never inflate the index across restarts.
+        A missing file is a fresh start; a bad one is loudly skipped —
+        and the shutdown save is then disabled so the failing-but-
+        intact file is never clobbered with an empty index."""
+        path = self.config.index_snapshot
+        if not path:
+            return
+        import os
+
+        from ..spatial.snapshot import load_snapshot
+
+        if not os.path.exists(path):
+            logger.info("index snapshot %s not found — starting empty", path)
+            return
+        try:
+            _, self._restored_peers = load_snapshot(self.backend, path)
+        except Exception:
+            logger.exception(
+                "index snapshot %s failed to load — starting empty; the "
+                "file is preserved (shutdown will not overwrite it)", path
+            )
+            self._snapshot_save_disabled = True
+
+    def _save_index_snapshot(self) -> None:
+        path = self.config.index_snapshot
+        if not path:
+            return
+        if self._snapshot_save_disabled:
+            logger.warning(
+                "index snapshot %s NOT saved: the boot-time load failed "
+                "and overwriting would destroy the previous state", path
+            )
+            return
+        from ..spatial.snapshot import save_snapshot
+
+        try:
+            save_snapshot(self.backend, path)
+        except Exception:
+            logger.exception("index snapshot %s failed to save", path)
+
+    async def _sweep_restored_peers(self) -> None:
+        """Evict restored subscriptions whose owners never came back:
+        one staleness window after boot, any restored peer absent from
+        the peer map loses its rows."""
+        await asyncio.sleep(self.config.zmq_timeout_secs)
+        swept = 0
+        for peer in self._restored_peers:
+            if self.peer_map.get(peer) is None:
+                if self.backend.remove_peer(peer):
+                    swept += 1
+        self._restored_peers = []
+        if swept:
+            logger.info(
+                "swept restored subscriptions of %d peers that did not "
+                "reconnect", swept,
+            )
+
     async def stop(self) -> None:
+        # Snapshot FIRST, synchronously: closing transports evicts the
+        # still-connected peers (disconnect cleanup would empty the
+        # index before a later save), and a cancellation-driven
+        # shutdown can interrupt any await below — the checkpoint must
+        # capture the SERVING state and must not be skippable.
+        self._save_index_snapshot()
         if self.ticker is not None:
             await self.ticker.stop()
         for task in self._tasks:
@@ -167,8 +246,27 @@ class WorldQLServer:
         await self.store.close()
 
     async def run_forever(self) -> None:
+        """Serve until SIGINT/SIGTERM, then shut down gracefully — the
+        index snapshot and transport teardown must run on a container
+        stop (SIGTERM), not only on Ctrl-C. Registering loop handlers
+        also overrides the SIG_IGN that non-interactive shells hand to
+        background processes."""
+        import signal
+
         await self.start()
+        stop_requested = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        hooked = []
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_requested.set)
+                hooked.append(sig)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-unix / nested loop: fall back to default
         try:
-            await asyncio.Event().wait()
+            await stop_requested.wait()
+            logger.info("shutdown signal received")
         finally:
+            for sig in hooked:
+                loop.remove_signal_handler(sig)
             await self.stop()

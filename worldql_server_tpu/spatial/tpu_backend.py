@@ -1749,7 +1749,6 @@ class TpuSpatialBackend(SpatialBackend):
         m, payload = handle
         if payload is None:
             return [[] for _ in range(m)]
-        peer_list = self._peer_list
         if payload[0] == "dense":
             tgt = np.asarray(payload[1])[:m]
             counts, flat = _dense_to_csr(tgt)
