@@ -79,7 +79,10 @@ class ZmqClient:
         self.uuid = uuid
 
     @classmethod
-    async def connect(cls, server_port: int, host: str = "127.0.0.1") -> "ZmqClient":
+    async def connect(
+        cls, server_port: int, host: str = "127.0.0.1",
+        peer_uuid: uuid_mod.UUID | None = None,
+    ) -> "ZmqClient":
         ctx = zmq.asyncio.Context()
         pull = ctx.socket(zmq.PULL)
         client_port = pull.bind_to_random_port(f"tcp://{host}")
@@ -87,7 +90,7 @@ class ZmqClient:
         push.setsockopt(zmq.LINGER, 0)
         push.connect(f"tcp://{host}:{server_port}")
 
-        client = cls(ctx, push, pull, uuid_mod.uuid4())
+        client = cls(ctx, push, pull, peer_uuid or uuid_mod.uuid4())
         await client.send(
             Message(
                 instruction=Instruction.HANDSHAKE,

@@ -154,6 +154,66 @@ def test_server_restart_keeps_subscriptions(tmp_path):
     assert asyncio.run(scenario())
 
 
+def test_zmq_peer_keeps_subscription_across_restart(tmp_path):
+    """The headline path: a ZeroMQ peer (client-chosen UUID) reconnects
+    after a server restart and receives area fan-out WITHOUT
+    re-subscribing."""
+    from tests.client_util import ZmqClient, free_port
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+    from worldql_server_tpu.protocol.types import Instruction, Message
+
+    snap = str(tmp_path / "zmq-index.npz")
+    fixed = uuid.uuid4()
+    pos = Vector3(5.0, 5.0, 5.0)
+
+    def make_config():
+        config = Config(store_url="memory://")
+        config.http_enabled = False
+        config.ws_enabled = False
+        config.zmq_server_host = "127.0.0.1"
+        config.zmq_server_port = free_port()
+        config.spatial_backend = "tpu"
+        config.index_snapshot = snap
+        return config
+
+    async def scenario():
+        server = WorldQLServer(make_config())
+        await server.start()
+        z = await ZmqClient.connect(
+            server.config.zmq_server_port, peer_uuid=fixed
+        )
+        await z.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name="w", position=pos,
+        ))
+        await asyncio.sleep(0.3)
+        await server.stop()  # client connected: checkpoint captures it
+        await z.close()
+
+        server2 = WorldQLServer(make_config())
+        await server2.start()
+        try:
+            # reconnect under the SAME uuid; no AREA_SUBSCRIBE sent
+            z2 = await ZmqClient.connect(
+                server2.config.zmq_server_port, peer_uuid=fixed
+            )
+            sender = await ZmqClient.connect(server2.config.zmq_server_port)
+            await sender.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=pos, parameter="wb",
+            ))
+            got = await z2.recv_until(Instruction.LOCAL_MESSAGE, timeout=10)
+            assert got.parameter == "wb"
+            await z2.close()
+            await sender.close()
+        finally:
+            await server2.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
 def test_restored_peers_swept_if_they_never_reconnect(tmp_path):
     """Restored subscriptions must not leak across restart cycles:
     peers absent one staleness window after boot lose their rows
@@ -191,6 +251,40 @@ def test_restored_peers_swept_if_they_never_reconnect(tmp_path):
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_quick_restart_does_not_repersist_ghosts(tmp_path):
+    """A restart SHORTER than the staleness window must still drop
+    unclaimed restored rows at save time — otherwise a crash-looping
+    deploy re-persists departed peers' subscriptions forever."""
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    snap = str(tmp_path / "ghost.npz")
+    src = CpuSpatialBackend(16)
+    ghost = uuid.uuid4()
+    src.add_subscription("w", ghost, Vector3(1.0, 2.0, 3.0))
+    save_snapshot(src, snap)
+
+    config = Config(store_url="memory://")
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_enabled = False
+    config.spatial_backend = "cpu"
+    config.index_snapshot = snap
+    config.zmq_timeout_secs = 3600  # sweep task never fires in-test
+
+    async def scenario():
+        server = WorldQLServer(config)
+        await server.start()
+        assert server.backend.is_subscribed_any("w", ghost)
+        await server.stop()  # well inside the window
+        return True
+
+    assert asyncio.run(scenario())
+    fresh = CpuSpatialBackend(16)
+    restored, _ = load_snapshot(fresh, snap)
+    assert restored == 0  # the ghost was not written back
 
 
 def test_failed_load_never_clobbers_the_snapshot(tmp_path):

@@ -193,6 +193,13 @@ class WorldQLServer:
         path = self.config.index_snapshot
         if not path:
             return
+        # Complete any pending restored-peer sweep synchronously first:
+        # a restart shorter than the staleness window must not
+        # re-persist ghost rows forever.
+        for peer in self._restored_peers:
+            if self.peer_map.get(peer) is None:
+                self.backend.remove_peer(peer)
+        self._restored_peers = []
         if self._snapshot_save_disabled:
             logger.warning(
                 "index snapshot %s NOT saved: the boot-time load failed "

@@ -117,6 +117,17 @@ class SpatialBackend(abc.ABC):
             out.append(_apply_replication(peers, q.sender, q.replication))
         return out
 
+    def export_rows(self):
+        """→ (worlds, peers, row_wid, row_cube, row_pid): every live
+        subscription as index rows for snapshotting (spatial/
+        snapshot.py). Each backend implements this against its own
+        internals — a backend without it loses its shutdown checkpoint,
+        so fail loudly rather than silently."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement export_rows — "
+            "its index cannot be snapshotted"
+        )
+
     def flush(self) -> None:
         """Make all prior mutations visible to queries. No-op for
         immediate-mode backends; device-mirror backends sync here."""

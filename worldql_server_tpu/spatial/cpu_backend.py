@@ -125,6 +125,27 @@ class CpuSpatialBackend(SpatialBackend):
     def world_names(self) -> list[str]:
         return list(self._worlds.keys())
 
+    def export_rows(self):
+        """Snapshot export (spatial/snapshot.py): live rows from the
+        dict index."""
+        import numpy as np
+
+        worlds, rows = [], []
+        peers, peer_ids = [], {}
+        for world, w in self._worlds.items():
+            wid_i = len(worlds)
+            worlds.append(world)
+            for cube_t, cube_peers in w.cubes.items():
+                for peer in cube_peers:
+                    pid_i = peer_ids.get(peer)
+                    if pid_i is None:
+                        pid_i = peer_ids[peer] = len(peers)
+                        peers.append(peer)
+                    rows.append((wid_i, *cube_t, pid_i))
+        arr = np.asarray(rows, np.int64).reshape(-1, 5)
+        return (worlds, peers, arr[:, 0].astype(np.int32),
+                arr[:, 1:4], arr[:, 4])
+
     def cube_count(self, world: str) -> int:
         w = self._worlds.get(world)
         return 0 if w is None else len(w.cubes)

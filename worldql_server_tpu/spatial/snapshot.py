@@ -33,43 +33,10 @@ _VERSION = 1
 def export_rows(backend):
     """→ (worlds, peer_hi, peer_lo, row_wid, row_cube, row_pid): the
     backend's live subscription rows in the portable snapshot layout.
-
-    Works on any SpatialBackend via its query surface; the TPU backends
-    are exported vectorized from their SoA columns."""
-    # vectorized fast path: the TPU backends' host-authority columns
-    if hasattr(backend, "_bp"):
-        live_b = backend._bp >= 0
-        dn = backend._dn
-        live_d = backend._dp[:dn] >= 0
-        wid = np.concatenate([
-            backend._bw[live_b], backend._dw[:dn][live_d],
-        ]).astype(np.int32)
-        cube = np.concatenate([
-            backend._bxyz[live_b], backend._dxyz[:dn][live_d],
-        ]).astype(np.int64)
-        pid = np.concatenate([
-            backend._bp[live_b], backend._dp[:dn][live_d],
-        ]).astype(np.int64)
-        worlds = list(backend._world_ids)
-        peers = backend._peer_list
-    else:
-        worlds, rows = [], []
-        peers, peer_ids = [], {}
-        for world in backend.world_names():
-            wid_i = len(worlds)
-            worlds.append(world)
-            w = backend._worlds[world]
-            for cube_t, cube_peers in w.cubes.items():
-                for peer in cube_peers:
-                    pid_i = peer_ids.get(peer)
-                    if pid_i is None:
-                        pid_i = peer_ids[peer] = len(peers)
-                        peers.append(peer)
-                    rows.append((wid_i, *cube_t, pid_i))
-        arr = np.asarray(rows, np.int64).reshape(-1, 5)
-        wid = arr[:, 0].astype(np.int32)
-        cube = arr[:, 1:4]
-        pid = arr[:, 4]
+    Each backend implements :meth:`SpatialBackend.export_rows` against
+    its own internals; this packs the peer UUIDs into two u64
+    columns."""
+    worlds, peers, wid, cube, pid = backend.export_rows()
 
     ints = np.fromiter(
         (p.int for p in peers), dtype=object, count=len(peers)
@@ -91,20 +58,29 @@ def save_snapshot(backend, path: str) -> int:
     # a path (not a handle) so numpy fully finalizes the zip before
     # returning; the .npz suffix keeps savez from appending its own
     tmp = f"{path}.{os.getpid()}.tmp.npz"
-    np.savez_compressed(
-        tmp,
-        version=np.int64(_VERSION),
-        cube_size=np.int64(backend.cube_size),
-        worlds=np.frombuffer(
-            json.dumps(worlds).encode(), dtype=np.uint8
-        ),
-        peer_hi=peer_hi,
-        peer_lo=peer_lo,
-        row_wid=wid,
-        row_cube=cube,
-        row_pid=pid,
-    )
-    os.replace(tmp, path)
+    try:
+        np.savez_compressed(
+            tmp,
+            version=np.int64(_VERSION),
+            cube_size=np.int64(backend.cube_size),
+            worlds=np.frombuffer(
+                json.dumps(worlds).encode(), dtype=np.uint8
+            ),
+            peer_hi=peer_hi,
+            peer_lo=peer_lo,
+            row_wid=wid,
+            row_cube=cube,
+            row_pid=pid,
+        )
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed save (disk full, kill) must not litter orphan temps
+        # next to the snapshot on every crashing shutdown
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     logger.info(
         "index snapshot: %d rows, %d worlds, %d peers -> %s",
         len(pid), len(worlds), len(peer_hi), path,
@@ -139,6 +115,14 @@ def load_snapshot(backend, path: str) -> tuple[int, list[uuid_mod.UUID]]:
         worlds = json.loads(bytes(z["worlds"]).decode())
         peer_hi, peer_lo = z["peer_hi"], z["peer_lo"]
         wid, cube, pid = z["row_wid"], z["row_cube"], z["row_pid"]
+        # validate every index BEFORE mutating the backend: a malformed
+        # row must never restore under the wrong peer (negative pids
+        # would silently wrap) or leave a half-loaded index
+        if len(pid) and (
+            int(pid.min()) < 0 or int(pid.max()) >= len(peer_hi)
+            or int(wid.min()) < 0 or int(wid.max()) >= len(worlds)
+        ):
+            raise SnapshotError("row peer/world ids out of range")
 
     peers = [
         uuid_mod.UUID(int=(int(hi) << 64) | int(lo))

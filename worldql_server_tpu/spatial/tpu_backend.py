@@ -1866,6 +1866,23 @@ class TpuSpatialBackend(SpatialBackend):
     def subscription_count(self) -> int:
         return self._base_live + self._delta_live
 
+    def export_rows(self):
+        """Snapshot export (spatial/snapshot.py): live rows, vectorized
+        from the host-authority SoA columns."""
+        live_b = self._bp >= 0
+        dn = self._dn
+        live_d = self._dp[:dn] >= 0
+        wid = np.concatenate([
+            self._bw[live_b], self._dw[:dn][live_d],
+        ]).astype(np.int32)
+        cube = np.concatenate([
+            self._bxyz[live_b], self._dxyz[:dn][live_d],
+        ]).astype(np.int64)
+        pid = np.concatenate([
+            self._bp[live_b], self._dp[:dn][live_d],
+        ]).astype(np.int64)
+        return list(self._world_ids), self._peer_list, wid, cube, pid
+
     def device_stats(self) -> dict:
         return {
             "subscriptions": self.subscription_count(),
