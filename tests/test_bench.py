@@ -67,7 +67,8 @@ def test_bench_config2_random_walk():
     assert rec["metric"] == "random_walk_tick_ms"
     assert rec["clients"] == 1000
     assert rec["resubs_per_tick"] > 0
-    assert rec["p50_ms"] <= rec["p99_ms"]
+    assert rec["iter_p50_ms"] <= rec["iter_p99_ms"]
+    assert rec["measurement"] == "pipelined-depth2-v2"
     assert "warmup" in stderr
 
 

@@ -115,9 +115,16 @@ def load_snapshot(backend, path: str) -> tuple[int, list[uuid_mod.UUID]]:
         worlds = json.loads(bytes(z["worlds"]).decode())
         peer_hi, peer_lo = z["peer_hi"], z["peer_lo"]
         wid, cube, pid = z["row_wid"], z["row_cube"], z["row_pid"]
-        # validate every index BEFORE mutating the backend: a malformed
-        # row must never restore under the wrong peer (negative pids
-        # would silently wrap) or leave a half-loaded index
+        # validate shape consistency and every index BEFORE mutating
+        # the backend: a malformed row must never restore under the
+        # wrong peer (negative pids would silently wrap) or leave a
+        # half-loaded index
+        if (
+            len(peer_hi) != len(peer_lo)
+            or not (len(wid) == len(pid) == len(cube))
+            or (len(cube) and cube.shape[1:] != (3,))
+        ):
+            raise SnapshotError("column lengths disagree")
         if len(pid) and (
             int(pid.min()) < 0 or int(pid.max()) >= len(peer_hi)
             or int(wid.min()) < 0 or int(wid.max()) >= len(worlds)
