@@ -322,3 +322,94 @@ def test_cross_transport_ws_to_zmq():
         return True
 
     assert run(scenario())
+
+
+def test_oversized_zmq_frame_cannot_exhaust_memory():
+    """A hostile ZMQ peer streaming a frame above max_message_size is
+    cut off by libzmq (MAXMSGSIZE); the PULL socket and every other
+    peer keep working."""
+    async def scenario():
+        server = make_server(
+            http_enabled=False, ws_enabled=False,
+            max_message_size=64 * 1024,
+        )
+        await server.start()
+        try:
+            z1 = await ZmqClient.connect(server.config.zmq_server_port)
+            pos = Vector3(5, 5, 5)
+            await z1.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="world", position=pos,
+            ))
+            await asyncio.sleep(0.1)
+
+            # raw oversized frame straight at the PULL socket
+            import zmq as zmq_mod
+            import zmq.asyncio as zmq_aio
+            ctx = zmq_aio.Context()
+            hostile = ctx.socket(zmq_mod.PUSH)
+            hostile.setsockopt(zmq_mod.LINGER, 0)
+            hostile.connect(
+                f"tcp://127.0.0.1:{server.config.zmq_server_port}"
+            )
+            await hostile.send(b"\xff" * (1024 * 1024))
+            await asyncio.sleep(0.2)
+            hostile.close(linger=0)
+            ctx.term()
+
+            # the server still serves the well-behaved peer
+            await z1.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="world", position=pos,
+                parameter="still-alive",
+                replication=Replication.INCLUDING_SELF,
+            ))
+            got = await z1.recv_until(Instruction.LOCAL_MESSAGE, timeout=5)
+            assert got.parameter == "still-alive"
+            await z1.close()
+        finally:
+            await server.stop()
+        return True
+
+    assert run(scenario())
+
+
+def test_oversized_ws_frame_closes_only_that_connection():
+    """A WS client sending a frame above max_message_size loses its
+    connection (library-enforced cap); the server and other clients
+    keep working."""
+    async def scenario():
+        server = make_server(
+            http_enabled=False, zmq_enabled=False,
+            max_message_size=64 * 1024,
+        )
+        await server.start()
+        try:
+            good = await WsClient.connect(server.config.ws_port)
+            bad = await WsClient.connect(server.config.ws_port)
+            pos = Vector3(5, 5, 5)
+            await good.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="world", position=pos,
+            ))
+            await asyncio.sleep(0.1)
+
+            await bad.send_raw(b"\xff" * (1024 * 1024))
+            # the offender's connection actually CLOSES (a timeout here
+            # would mean the cap silently regressed)
+            await asyncio.wait_for(bad.connection.wait_closed(), timeout=5)
+            # everyone else is unaffected
+            await good.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="world", position=pos,
+                parameter="ok",
+                replication=Replication.INCLUDING_SELF,
+            ))
+            got = await good.recv_until(Instruction.LOCAL_MESSAGE, timeout=5)
+            assert got.parameter == "ok"
+            await good.close()
+        finally:
+            await server.stop()
+        return True
+
+    assert run(scenario())

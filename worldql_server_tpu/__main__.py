@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--index-snapshot",
                    help="subscription-index snapshot file: loaded at "
                         "boot if present, saved at shutdown")
+    p.add_argument("--max-message-size", type=int,
+                   help="inbound wire-message byte cap, both transports "
+                        "(default 8 MiB)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -79,7 +82,7 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
-    "mesh_batch", "mesh_space", "index_snapshot",
+    "mesh_batch", "mesh_space", "index_snapshot", "max_message_size",
 ]
 
 

@@ -81,6 +81,15 @@ class Config:
         default_factory=lambda: int(_env("WQL_ZMQ_TIMEOUT_SECS", "25"))
     )
 
+    # Upper bound on one inbound wire message, enforced by both
+    # transports (WS frame max_size; ZMQ MAXMSGSIZE) — an unbounded
+    # frame is an easy memory-exhaustion vector.
+    max_message_size: int = field(
+        default_factory=lambda: int(
+            _env("WQL_MAX_MESSAGE_SIZE", str(8 * 1024 * 1024))
+        )
+    )
+
     verbose: int = 0
 
     # --- rebuild-specific knobs ------------------------------------
@@ -134,6 +143,8 @@ class Config:
 
         if self.zmq_enabled and self.zmq_timeout_secs < 10:
             errors.append("zmq_timeout_secs must be at least 10 seconds")
+        if self.max_message_size <= 0:
+            errors.append("max_message_size must be greater than 0")
 
         for axis in ("x", "y", "z"):
             region = getattr(self, f"db_region_{axis}_size")

@@ -32,7 +32,6 @@ from ..engine.peers import Peer
 
 logger = logging.getLogger(__name__)
 
-MAX_FRAME_BYTES = 8 * 1024 * 1024
 
 
 class WebSocketTransport:
@@ -46,7 +45,7 @@ class WebSocketTransport:
             self._handle_connection,
             config.ws_host,
             config.ws_port,
-            max_size=MAX_FRAME_BYTES,
+            max_size=config.max_message_size,
         )
         logger.info(
             "WebSocket server listening on %s:%s", config.ws_host, config.ws_port

@@ -67,6 +67,12 @@ class ZmqTransport:
     async def start(self) -> None:
         config = self.server.config
         self._pull = self.ctx.socket(zmq.PULL)
+        # Bound inbound frames BEFORE bind: without MAXMSGSIZE a single
+        # hostile peer can stream an arbitrarily large message into
+        # server memory (libzmq buffers the whole frame). Oversized
+        # senders are disconnected by libzmq; the PULL socket and every
+        # other peer keep working.
+        self._pull.setsockopt(zmq.MAXMSGSIZE, config.max_message_size)
         self._pull.bind(f"tcp://{config.zmq_server_host}:{config.zmq_server_port}")
         logger.info(
             "ZeroMQ PULL server listening on %s:%s",
@@ -95,8 +101,19 @@ class ZmqTransport:
         """PULL loop (incoming.rs:26-75): multipart frames are
         concatenated, deserialized-or-dropped, then routed."""
         assert self._pull is not None
+        limit = self.server.config.max_message_size
         while True:
             parts = await self._pull.recv_multipart()
+            # MAXMSGSIZE bounds each PART; a hostile peer could still
+            # split one logical message into many under-cap frames, so
+            # bound the flattened total BEFORE the join materializes it
+            # a second time.
+            if sum(len(p) for p in parts) > limit:
+                logger.warning(
+                    "dropping oversized multipart zmq message (%d parts)",
+                    len(parts),
+                )
+                continue
             data = b"".join(parts)
             try:
                 message = deserialize_message(data)
