@@ -311,22 +311,11 @@ def _device_probes(tpu, batch, csr_cap: int, reps: int = 12):
     )
     jax.block_until_ready(result)
     segs, ks, kinds = tpu._segments()
-    from worldql_server_tpu.spatial.hashing import next_pow2
     t_cap = next_pow2(csr_cap)
-    # rebuild the padded query arrays once, resident on device
+    # build the padded query arrays once, resident on device
     dispatch = tpu._dispatch_csr
-    from worldql_server_tpu.spatial.quantize import cube_coords_batch
-    from worldql_server_tpu.spatial.hashing import (
-        PAD_KEY, QUERY_PAD_KEY2, pad_to, spatial_keys, spatial_keys2,
-    )
-    cubes = cube_coords_batch(positions, tpu.cube_size)
-    keys = spatial_keys(world_ids, cubes, tpu._seed)
-    keys2 = spatial_keys2(world_ids, cubes, tpu._seed)
-    cap = tpu._query_cap(len(world_ids))
-    queries = tuple(jax.device_put(q) for q in (
-        pad_to(keys, cap, PAD_KEY), pad_to(keys2, cap, QUERY_PAD_KEY2),
-        pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
-        pad_to(repls.astype(np.int8), cap, np.int8(0)),
+    queries = tuple(jax.device_put(q) for q in tpu._prepare_queries(
+        world_ids, positions, sender_ids, repls
     ))
     jax.block_until_ready(queries)
     r = dispatch(queries, segs, ks, kinds, t_cap)

@@ -81,9 +81,15 @@ class Config:
         default_factory=lambda: int(_env("WQL_ZMQ_TIMEOUT_SECS", "25"))
     )
 
-    # Upper bound on one inbound wire message, enforced by both
-    # transports (WS frame max_size; ZMQ MAXMSGSIZE) — an unbounded
-    # frame is an easy memory-exhaustion vector.
+    # Upper bound on one inbound wire message — an unbounded frame is
+    # an easy memory-exhaustion vector. WS enforces it on the whole
+    # (reassembled) message; ZMQ enforces it per frame at the socket
+    # (MAXMSGSIZE) plus on the flattened multipart total. Caveat:
+    # libzmq assembles a multipart message atomically before delivery
+    # and no socket option bounds that sum, so a peer splitting one
+    # logical message into many under-cap frames can still make libzmq
+    # buffer up to parts x cap before the drop — the protocol's own
+    # clients are single-part, so cap accordingly.
     max_message_size: int = field(
         default_factory=lambda: int(
             _env("WQL_MAX_MESSAGE_SIZE", str(8 * 1024 * 1024))

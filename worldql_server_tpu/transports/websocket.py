@@ -33,7 +33,6 @@ from ..engine.peers import Peer
 logger = logging.getLogger(__name__)
 
 
-
 class WebSocketTransport:
     def __init__(self, server):
         self.server = server

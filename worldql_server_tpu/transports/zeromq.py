@@ -104,10 +104,11 @@ class ZmqTransport:
         limit = self.server.config.max_message_size
         while True:
             parts = await self._pull.recv_multipart()
-            # MAXMSGSIZE bounds each PART; a hostile peer could still
-            # split one logical message into many under-cap frames, so
-            # bound the flattened total BEFORE the join materializes it
-            # a second time.
+            # MAXMSGSIZE bounds each PART; bound the flattened total
+            # before the join materializes it a second time. (libzmq
+            # assembles multipart atomically before delivery, so its
+            # own buffering of many under-cap parts cannot be bounded
+            # by any socket option — see Config.max_message_size.)
             if sum(len(p) for p in parts) > limit:
                 logger.warning(
                     "dropping oversized multipart zmq message (%d parts)",
