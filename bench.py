@@ -222,13 +222,22 @@ def bench_config5(args) -> dict:
         jax.profiler.trace(args.profile) if args.profile
         else contextlib.nullcontext()
     )
+    # Best-of-2 sustained passes: the tunneled link's congestion swings
+    # a single pass several-fold while device compute stays flat — the
+    # min is the code's number, the attribution probes below say how
+    # much link remains even in it.
+    sust_runs = []
     with profile_ctx:
-        _, sustained, total_fanout, csr_cap = run_pipelined_adaptive(
-            tpu, batches, csr_cap, depth=8
-        )
+        for _ in range(2):
+            _, sustained, total_fanout, csr_cap = run_pipelined_adaptive(
+                tpu, batches, csr_cap, depth=8
+            )
+            sust_runs.append(sustained)
+    sustained = min(sust_runs)
     if args.profile:
         log(f"jax profiler trace written to {args.profile}")
-    log(f"tpu: sustained {sustained:.2f} ms/tick  "
+    log(f"tpu: sustained {sustained:.2f} ms/tick "
+        f"(runs: {', '.join(f'{s:.1f}' for s in sust_runs)})  "
         f"avg fan-out {total_fanout / (len(batches) * args.queries):.2f}  "
         f"csr_cap {csr_cap}  "
         f"({args.queries / (sustained / 1e3):,.0f} queries/s)")
@@ -285,6 +294,7 @@ def bench_config5(args) -> dict:
         "p99_ms_depth2": round(pctl(lat2, 99), 3),
         "link_rtt_ms": round(rtt_ms, 3),
         "device_compute_ms": round(compute_ms, 4),
+        "sustained_runs_ms": [round(s, 3) for s in sust_runs],
         "target_p99_ms": TARGET_P99_MS,
         "config": 5,
     }
