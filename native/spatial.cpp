@@ -1,0 +1,107 @@
+// Native query preparation: fused cube quantization + double spatial
+// hash in one pass over a position batch — the per-tick host-side cost
+// of the fan-out engine (Python twins: worldql_server_tpu/spatial/
+// quantize.coord_clamp_batch and hashing.spatial_keys/spatial_keys2).
+//
+// Semantics are bit-exact with the golden quantizer (reference:
+// worldql_server/src/subscriptions/cube_area.rs:23-44): max-corner
+// labeling, sign symmetry, 0 -> +size, exact multiples label their own
+// cube, NaN -> +size, +-inf -> +-i64::MAX, Rust-style saturating f64 ->
+// i64 casts. The hash is the splitmix64 chain from spatial/hashing.py.
+//
+// C ABI (ctypes consumer: worldql_server_tpu/spatial/native_keys.py):
+//   wql_spatial_abi() -> 1
+//   wql_query_keys(pos[n*3] f64, world_ids[n] i32, n, cube_size,
+//                  seed1, seed2, keys1[n] i64 out, keys2[n] i64 out)
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t I64_MAX = INT64_MAX;
+constexpr int64_t I64_MIN = INT64_MIN;
+// float(2^63 - 1) == float(2^63): both bounds have this magnitude
+constexpr double I64_MAX_F = 9223372036854775808.0;
+
+// Rust `f64 as i64`: NaN -> 0, out-of-range saturates.
+inline int64_t sat_i64(double f) {
+  if (std::isnan(f)) return 0;
+  if (f >= I64_MAX_F) return I64_MAX;
+  if (f <= -I64_MAX_F) return I64_MIN;
+  return static_cast<int64_t>(f);
+}
+
+// Python twin: quantize.coord_clamp (scalar reference semantics).
+inline int64_t coord_clamp(double coord, int64_t size) {
+  if (std::isinf(coord)) return coord > 0 ? I64_MAX : -I64_MAX;
+
+  const double size_f = static_cast<double>(size);
+  const double abs_c = std::fabs(coord);
+  const int64_t mult = (coord < 0.0) ? -1 : 1;  // NaN compares false -> +1
+
+  if (!std::isnan(coord)) {
+    if (std::fmod(abs_c, size_f) == 0.0 && coord != 0.0) {
+      return sat_i64(coord);
+    }
+  }
+
+  double rounded = std::ceil(abs_c / size_f) * size_f;
+  if (abs_c == 0.0) rounded = size_f;  // round_by_multiple: 0 -> size
+
+  int64_t result;
+  if (rounded > coord) {  // NaN > NaN false -> falls to +size, like Rust
+    result = sat_i64(rounded);
+  } else {
+    const int64_t ri = sat_i64(rounded);
+    result = (ri > I64_MAX - size) ? I64_MAX : ri + size;  // saturating
+  }
+  return result * mult;
+}
+
+// splitmix64 mixer — constants shared with spatial/hashing.py.
+inline uint64_t mix(uint64_t x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+constexpr uint64_t GOLDEN = 0x9E3779B97F4A7C15ULL;
+
+inline int64_t chain(uint64_t h, uint64_t w, uint64_t cx, uint64_t cy,
+                     uint64_t cz) {
+  h = mix(h ^ w);
+  h = mix(h ^ cx);
+  h = mix(h ^ cy);
+  h = mix(h ^ cz);
+  return static_cast<int64_t>(h);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t wql_spatial_abi() { return 1; }
+
+void wql_query_keys(const double* pos, const int32_t* world_ids, int64_t n,
+                    int64_t cube_size, uint64_t seed1, uint64_t seed2,
+                    int64_t* keys1, int64_t* keys2) {
+  const uint64_t h1 = mix(seed1 + GOLDEN);
+  const uint64_t h2 = mix(seed2 + GOLDEN);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t cx =
+        static_cast<uint64_t>(coord_clamp(pos[3 * i + 0], cube_size));
+    const uint64_t cy =
+        static_cast<uint64_t>(coord_clamp(pos[3 * i + 1], cube_size));
+    const uint64_t cz =
+        static_cast<uint64_t>(coord_clamp(pos[3 * i + 2], cube_size));
+    // world id sign-extends i32 -> i64 before the bit view, like
+    // world_ids.astype(int64).view(uint64) in the numpy twin
+    const uint64_t w =
+        static_cast<uint64_t>(static_cast<int64_t>(world_ids[i]));
+    keys1[i] = chain(h1, w, cx, cy, cz);
+    keys2[i] = chain(h2, w, cx, cy, cz);
+  }
+}
+
+}  // extern "C"

@@ -244,15 +244,26 @@ class _TooManyObjects(Exception):
     """Internal: exceeds the native object cap; use the Python codec."""
 
 
+def resolve_lib_path() -> Path | None:
+    """Where the native shared library lives, honoring WQL_NATIVE_CODEC
+    ('0' disables, '1'/unset = in-tree build, else a path). Shared by
+    every native binding (codec, spatial keys) so the policy cannot
+    diverge."""
+    env = os.environ.get("WQL_NATIVE_CODEC", "1")
+    if env == "0":
+        return None
+    return _LIB_PATH if env == "1" else Path(env)
+
+
 def load() -> NativeCodec | None:
     """Load the native codec, or None (pure-Python fallback).
     WQL_NATIVE_CODEC: '0' forces the fallback, '1'/unset uses the
     in-tree build, any other value is a path to the shared library
     (containers install it outside the source tree)."""
     env = os.environ.get("WQL_NATIVE_CODEC", "1")
-    if env == "0":
+    lib_path = resolve_lib_path()
+    if lib_path is None:
         return None
-    lib_path = _LIB_PATH if env == "1" else Path(env)
     if not lib_path.exists():
         if env != "1":
             # An explicitly configured path that is missing is a

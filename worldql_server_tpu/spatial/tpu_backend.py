@@ -68,7 +68,7 @@ from .hashing import (
     NO_WORLD, PAD_KEY, QUERY_PAD_KEY2, next_pow2, pad_to, spatial_keys,
     spatial_keys2,
 )
-from .quantize import cube_coords_batch
+from .native_keys import query_keys
 
 _log = logging.getLogger(__name__)
 
@@ -1645,10 +1645,12 @@ class TpuSpatialBackend(SpatialBackend):
     def _prepare_queries(self, world_ids, positions, sender_ids, repls):
         """Quantize + hash + pad one query batch into the device query
         tuple. 21 B/query on the wire (two keys + sender + replication)
-        — the raw (world, cube) identity stays on the host."""
-        cubes = cube_coords_batch(positions, self.cube_size)
-        keys = spatial_keys(world_ids, cubes, self._seed)
-        keys2 = spatial_keys2(world_ids, cubes, self._seed)
+        — the raw (world, cube) identity stays on the host. Quantize +
+        both hashes run as one fused native pass when the C++ kernel is
+        built (spatial/native_keys.py; numpy twins otherwise)."""
+        keys, keys2 = query_keys(
+            world_ids, positions, self.cube_size, self._seed
+        )
         cap = self._query_cap(len(world_ids))
         return (
             pad_to(keys, cap, PAD_KEY),
