@@ -93,3 +93,59 @@ def test_tick_is_deterministic():
     out2 = tick(state)
     for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(out2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tick_targets_are_nearest_first():
+    """Within an entity's candidate window the targets come back
+    ordered by distance — a true kNN selection, not sort-order
+    happenstance."""
+    # four entities in one cube at staggered x, one far away
+    position = jnp.array([
+        [1.0, 1.0, 1.0],
+        [2.0, 1.0, 1.0],
+        [5.0, 1.0, 1.0],
+        [9.0, 1.0, 1.0],
+        [500.0, 1.0, 1.0],
+    ], jnp.float32)
+    state = EntityState(
+        position=position,
+        velocity=jnp.zeros((5, 3), jnp.float32),
+        world=jnp.zeros(5, jnp.int32),
+        peer=jnp.arange(5, dtype=jnp.int32),
+    )
+    tick = make_tick_fn(cube_size=16, k=8, dt=0.0)
+    _, targets, counts = tick(state)
+    tgt = np.asarray(targets)
+    # entity 0 at x=1: nearest is peer 1 (dx=1), then 2 (dx=4), then 3
+    assert [t for t in tgt[0] if t >= 0] == [1, 2, 3]
+    # entity 3 at x=9: nearest is peer 2 (dx=4), then 1 (dx=7), then 0
+    assert [t for t in tgt[3] if t >= 0] == [2, 1, 0]
+    assert int(counts[4]) == 1  # the far entity is alone
+
+
+def test_tick_nan_position_still_broadcasts_before_sentinels():
+    """A NaN-position entity quantizes to cube +size and participates;
+    its co-cube neighbors' rows must keep real targets CONTIGUOUS
+    before the -1 padding even though the distance to it is NaN."""
+    nan = float("nan")
+    position = jnp.array([
+        [nan, 1.0, 1.0],     # quantizes to cube (+16, 16, 16)
+        [15.0, 1.0, 1.0],    # same cube
+        [14.0, 1.0, 1.0],    # same cube
+    ], jnp.float32)
+    state = EntityState(
+        position=position,
+        velocity=jnp.zeros((3, 3), jnp.float32),
+        world=jnp.zeros(3, jnp.int32),
+        peer=jnp.arange(3, dtype=jnp.int32),
+    )
+    tick = make_tick_fn(cube_size=16, k=8, dt=0.0)
+    _, targets, counts = tick(state)
+    tgt = np.asarray(targets)
+    assert int(counts[1]) == 3
+    row = list(tgt[1])
+    real = [t for t in row if t >= 0]
+    assert set(real) == {0, 2}
+    # no real target after the first -1 (contiguity invariant)
+    first_pad = row.index(-1) if -1 in row else len(row)
+    assert all(t == -1 for t in row[first_pad:])
