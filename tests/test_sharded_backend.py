@@ -191,3 +191,30 @@ def test_compaction_worker_failure_surfaces_and_recovers():
     b.wait_compaction()
     assert b.compactions >= 1
     assert set(b.match_local_batch([LocalQuery(W, pos, sender)])[0]) == set(peers)
+
+
+def test_maybe_initialize_distributed_env_contract(monkeypatch):
+    """Unset → single-host no-op; a partial multi-host config fails
+    loudly instead of silently running single-host."""
+    from worldql_server_tpu.parallel import maybe_initialize_distributed
+
+    monkeypatch.delenv("WQL_DIST_COORDINATOR", raising=False)
+    assert maybe_initialize_distributed() is False
+
+    monkeypatch.setenv("WQL_DIST_COORDINATOR", "10.0.0.1:1234")
+    monkeypatch.delenv("WQL_DIST_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("WQL_DIST_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="WQL_DIST_NUM_PROCESSES"):
+        maybe_initialize_distributed()
+
+
+def test_dist_env_with_wrong_backend_is_a_config_error(monkeypatch):
+    from worldql_server_tpu.engine.config import Config
+
+    monkeypatch.setenv("WQL_DIST_COORDINATOR", "10.0.0.1:1234")
+    config = Config(store_url="memory://")
+    config.spatial_backend = "cpu"
+    with pytest.raises(ValueError, match="multi-host requires"):
+        config.validate()
+    config.spatial_backend = "sharded"
+    config.validate()  # sharded accepts it

@@ -175,6 +175,18 @@ class Config:
 
         if self.spatial_backend not in ("cpu", "tpu", "sharded"):
             errors.append("spatial_backend must be 'cpu', 'tpu' or 'sharded'")
+        if (
+            os.environ.get("WQL_DIST_COORDINATOR")
+            and self.spatial_backend != "sharded"
+        ):
+            # only the sharded backend joins the distributed runtime —
+            # ignoring the multi-host config would silently run every
+            # process single-host
+            errors.append(
+                "WQL_DIST_COORDINATOR is set but spatial_backend is "
+                f"'{self.spatial_backend}' — multi-host requires "
+                "'sharded'"
+            )
         if self.tick_interval < 0:
             errors.append("tick_interval must be >= 0")
         if self.mesh_batch <= 0:

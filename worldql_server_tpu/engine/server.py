@@ -30,8 +30,13 @@ def build_backend(config: Config) -> SpatialBackend:
 
         return TpuSpatialBackend(config.sub_region_size)
     if config.spatial_backend == "sharded":
-        from ..parallel import ShardedTpuSpatialBackend, make_fanout_mesh
+        from ..parallel import (
+            ShardedTpuSpatialBackend,
+            make_fanout_mesh,
+            maybe_initialize_distributed,
+        )
 
+        maybe_initialize_distributed()
         mesh = make_fanout_mesh(
             config.mesh_batch, config.mesh_space or None
         )

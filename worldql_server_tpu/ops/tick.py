@@ -154,7 +154,8 @@ def simulation_tick(
     # target pack into ONE int64 per candidate so the whole reorder is
     # a single row-sort — lax.top_k on [N, K] costs ~5x more on TPU
     # (measured) for the same result. IEEE bits of a non-negative f32
-    # are order-preserving, invalid slots carry +inf so they sink, and
+    # are order-preserving, invalid slots carry the all-ones bit
+    # pattern (above +inf AND every NaN, so they sink below both), and
     # equal distances tie-break by peer id (deterministic). With cube
     # occupancy beyond K the window truncates at K candidates (callers
     # detect via counts > K); within it the result is the k nearest,

@@ -10,7 +10,11 @@ parallelism), and per-query partial results combine with one ``pmax``
 collective over ICI.
 """
 
-from .mesh import make_fanout_mesh
+from .mesh import make_fanout_mesh, maybe_initialize_distributed
 from .sharded_backend import ShardedTpuSpatialBackend
 
-__all__ = ["make_fanout_mesh", "ShardedTpuSpatialBackend"]
+__all__ = [
+    "make_fanout_mesh",
+    "maybe_initialize_distributed",
+    "ShardedTpuSpatialBackend",
+]

@@ -11,13 +11,66 @@ Axes:
 On a real slice the mesh should be built so ``space`` rides ICI
 (neighbor collectives dominate); ``batch`` only ever combines at the
 end of a tick.
+
+**Multi-host (DCN):** where the reference would scale out with a
+second process and NCCL/MPI-style plumbing, a JAX multi-host run is
+one ``jax.distributed.initialize`` per process and the SAME mesh code:
+``jax.devices()`` then spans every host's chips and the sharded
+backend's collectives ride ICI within a host and DCN across hosts with
+no further changes. :func:`maybe_initialize_distributed` wires that
+from ``WQL_DIST_*`` environment variables so every process of a
+multi-host deployment runs the identical server command.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+
 from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
 import jax
 from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+
+def maybe_initialize_distributed() -> bool:
+    """Join a multi-host JAX runtime if ``WQL_DIST_COORDINATOR`` is
+    set (``host:port`` of process 0), using ``WQL_DIST_NUM_PROCESSES``
+    and ``WQL_DIST_PROCESS_ID``. No-op (returns False) when unset —
+    single-host runs need nothing. Must run before the first device
+    query, which is why build_backend calls it ahead of mesh
+    construction."""
+    coordinator = os.environ.get("WQL_DIST_COORDINATOR")
+    if not coordinator:
+        return False
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return True  # second build_backend in one process: no-op
+    try:
+        num = int(os.environ["WQL_DIST_NUM_PROCESSES"])
+        pid = int(os.environ["WQL_DIST_PROCESS_ID"])
+    except KeyError as exc:
+        raise ValueError(
+            "WQL_DIST_COORDINATOR is set but "
+            f"{exc.args[0]} is not — a partial multi-host config "
+            "would silently run single-host"
+        ) from None
+    except ValueError as exc:
+        raise ValueError(
+            "WQL_DIST_NUM_PROCESSES / WQL_DIST_PROCESS_ID must be "
+            f"integers: {exc}"
+        ) from None
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+    )
+    logger.info(
+        "joined distributed runtime: process %d/%d via %s "
+        "(%d global devices)",
+        pid, num, coordinator, jax.device_count(),
+    )
+    return True
 
 
 def make_fanout_mesh(
