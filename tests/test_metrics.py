@@ -70,9 +70,11 @@ def test_server_metrics_endpoint():
             await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=30)
 
             def fetch():
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{http_port}/metrics"
-                ) as resp:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/metrics",
+                    headers={"Accept": "application/json"},
+                )
+                with urllib.request.urlopen(req) as resp:
                     return json.loads(resp.read())
 
             snap = await asyncio.to_thread(fetch)
@@ -83,6 +85,22 @@ def test_server_metrics_endpoint():
             assert snap["gauges"]["subscriptions"] == 2
             assert snap["latency"]["tick.flush_ms"]["count"] >= 1
             assert snap["gauges"]["tick"]["last_batch"] == 1
+
+            def fetch_prometheus():
+                # a scraper's plain GET (no JSON Accept) must get the
+                # text exposition format
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/metrics"
+                ) as resp:
+                    assert resp.headers.get_content_type() == "text/plain"
+                    return resp.read().decode()
+
+            text = await asyncio.to_thread(fetch_prometheus)
+            assert "# TYPE wql_messages_local_message_total counter" in text
+            assert "wql_messages_local_message_total 1" in text
+            assert "wql_peers 2" in text
+            assert 'wql_tick_flush_seconds_bucket{le="+Inf"}' in text
+            assert "# TYPE wql_uptime_seconds gauge" in text
 
             def health():
                 with urllib.request.urlopen(

@@ -92,13 +92,17 @@ class Metrics:
         """Register a pull-style gauge; evaluated at snapshot time."""
         self._gauges[name] = fn
 
-    def snapshot(self) -> dict:
+    def _eval_gauges(self) -> dict:
         gauges = {}
         for name, fn in self._gauges.items():
             try:
                 gauges[name] = fn()
             except Exception as exc:  # a broken gauge must not kill /metrics
                 gauges[name] = f"error: {exc}"
+        return gauges
+
+    def snapshot(self) -> dict:
+        gauges = self._eval_gauges()
         return {
             "uptime_s": round(time.time() - self.started_at, 3),
             "counters": dict(self.counters),
@@ -107,3 +111,48 @@ class Metrics:
             },
             "gauges": gauges,
         }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of the registry —
+        what a scraper expects at GET /metrics. Counter/gauge names map
+        dots to underscores under a ``wql_`` prefix; histograms emit
+        the standard ``_bucket``/``_sum``/``_count`` series (bucket
+        bounds in seconds, per convention); dict-valued gauges flatten
+        one level, non-numeric leaves are skipped."""
+        out: list[str] = []
+
+        def name_of(raw: str) -> str:
+            return "wql_" + raw.replace(".", "_").replace("-", "_")
+
+        out.append("# TYPE wql_uptime_seconds gauge")
+        out.append(
+            f"wql_uptime_seconds {time.time() - self.started_at:.3f}"
+        )
+        for raw, value in sorted(self.counters.items()):
+            n = name_of(raw) + "_total"  # Prometheus counter convention
+            out.append(f"# TYPE {n} counter")
+            out.append(f"{n} {value}")
+        for raw, hist in sorted(self.histograms.items()):
+            # registry names carry '_ms'; the export is in seconds, so
+            # swap the unit suffix instead of stacking both
+            n = name_of(raw.removesuffix("_ms")) + "_seconds"
+            out.append(f"# TYPE {n} histogram")
+            acc = 0
+            for bound, count in zip(hist.buckets, hist.counts):
+                acc += count
+                out.append(f'{n}_bucket{{le="{bound / 1e3:g}"}} {acc}')
+            out.append(f'{n}_bucket{{le="+Inf"}} {hist.total}')
+            out.append(f"{n}_sum {hist.sum_ms / 1e3:.6f}")
+            out.append(f"{n}_count {hist.total}")
+        for raw, value in sorted(self._eval_gauges().items()):
+            leaves = (
+                {f"{raw}.{k}": v for k, v in value.items()}
+                if isinstance(value, dict) else {raw: value}
+            )
+            for leaf, v in sorted(leaves.items()):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                n = name_of(leaf)
+                out.append(f"# TYPE {n} gauge")
+                out.append(f"{n} {v}")
+        return "\n".join(out) + "\n"

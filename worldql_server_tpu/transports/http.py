@@ -61,7 +61,17 @@ class HttpTransport:
     async def _get_metrics(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
             return web.Response(status=401)
-        return web.json_response(self.server.metrics.snapshot())
+        # Content negotiation: callers that ask for JSON (dashboards,
+        # the test suite) get the structured snapshot; everything else
+        # — Prometheus scrapers send Accept: text/plain /
+        # openmetrics-text — gets the standard exposition format.
+        if "application/json" in request.headers.get("Accept", ""):
+            return web.json_response(self.server.metrics.snapshot())
+        return web.Response(
+            text=self.server.metrics.render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def _post_global_message(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
