@@ -314,3 +314,38 @@ def test_sparse_path_matches_dense():
            for r, row in zip(rows, targets)}
     for i, w in enumerate(want):
         assert got.get(i, []) == w
+
+
+def test_key1_collision_rejected_by_second_key():
+    """The exactness contract: a query whose FIRST key matches a
+    stored run but whose second key differs (the absent-cube collision
+    case, ~2^-64) must resolve empty — on the dense, CSR, and sparse
+    paths alike."""
+    from worldql_server_tpu.spatial.hashing import (
+        PAD_KEY, QUERY_PAD_KEY2, next_pow2, pad_to,
+    )
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
+    segs, ks, kinds = b._segments()
+    # craft queries aimed at REAL stored key1s with corrupted key2s
+    stored_k1 = np.asarray(segs[0][0])[:8].copy()
+    stored_k2 = np.asarray(segs[0][1])[:8].copy()
+    m = len(stored_k1)
+    cap = next_pow2(m)
+    queries = (
+        pad_to(stored_k1, cap, PAD_KEY),
+        pad_to(stored_k2 ^ np.int64(0x5A5A), cap, QUERY_PAD_KEY2),
+        pad_to(np.full(m, -1, np.int32), cap, np.int32(-1)),
+        pad_to(np.zeros(m, np.int8), cap, np.int8(0)),
+    )
+    dense = np.asarray(b._dispatch(queries, segs, ks, kinds))[:m]
+    assert (dense == -1).all()
+    counts, flat, total = b._dispatch_csr(queries, segs, ks, kinds, 1024)
+    assert int(total) == 0 and int(np.asarray(counts)[:m].sum()) == 0
+    rows, targets, n_hits = b._dispatch_sparse(queries, segs, ks, kinds, 64)
+    assert int(n_hits) == 0
+    # and the same queries with the TRUE key2 resolve non-empty
+    queries_ok = (queries[0], pad_to(stored_k2, cap, QUERY_PAD_KEY2),
+                  queries[2], queries[3])
+    dense_ok = np.asarray(b._dispatch(queries_ok, segs, ks, kinds))[:m]
+    assert (dense_ok >= 0).any()
