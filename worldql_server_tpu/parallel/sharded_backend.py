@@ -36,8 +36,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..spatial.hashing import PAD_KEY, next_pow2, pad_to
+from ..spatial.hashing import PAD_KEY, n_distinct, next_pow2, pad_to
 from ..spatial.tpu_backend import (
+    SEG_ARRAYS,
     TpuSpatialBackend,
     _alloc_buffers,
     _concat_parts,
@@ -49,6 +50,8 @@ from ..spatial.tpu_backend import (
     compact_csr,
     compact_sparse,
     match_core,
+    probe_buckets_for,
+    probe_tables,
     run_remainders_np,
     two_tier_first_pass,
     two_tier_second_pass,
@@ -94,12 +97,16 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         return NamedSharding(self.mesh, P(*spec))
 
     def _base_specs(self):
-        # (key, key2, peer, run-remainder) — all 1-D per-shard stacks
-        return (P("space", None), P("space", None),
-                P("space", None), P("space", None))
+        # (key, key2, peer, run-remainder, tbl_key, tbl_pay, oflow) —
+        # 1-D columns and [B, E] probe tables as per-shard stacks
+        v = P("space", None)
+        t = P("space", None, None)
+        return (v, v, v, v, t, t, v)
 
     def _delta_specs(self):
-        return (P(None), P(None), P(None), P(None))
+        v = P(None)
+        t = P(None, None)
+        return (v, v, v, v, t, t, v)
 
     def _query_specs(self):
         # (key, key2, sender, repl)
@@ -120,25 +127,61 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             ])
 
         # runs never straddle a shard boundary (splits snap to run
-        # starts), so each shard's run-remainder column derives from
-        # its own padded key rows
+        # starts), so each shard's run-remainder column (and its probe
+        # table — shard-local run starts) derives from its own padded
+        # key rows
         padded_keys = stack(keys, PAD_KEY)
         rems = np.stack([run_remainders_np(row) for row in padded_keys])
+        n_cubes = max(
+            n_distinct(keys[a:b]) for a, b in zip(splits, splits[1:])
+        )
         sub = self._sharding("space", None)
+        sk = jax.device_put(padded_keys, sub)
+        rem = jax.device_put(rems, sub)
+        tk, tp, oflow = self._probe_stack(
+            sk, rem, probe_buckets_for(n_cubes)
+        )
         return {
             "dev": (
-                jax.device_put(padded_keys, sub),
+                sk,
                 jax.device_put(stack(keys2, np.int64(0)), sub),
                 jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
                                sub),
-                jax.device_put(rems, sub),
+                rem, tk, tp, oflow,
             ),
             "cap": self.n_space * cap,
             "splits": np.asarray(splits, np.int64),
             "shard_cap": cap,
         }
 
-    def _compact_device(self, snap: dict, cap2: int, host_arrays, k) -> dict:
+    def _probe_stack(self, sk_stack, rem_stack, n_buckets: int):
+        """Per-shard probe tables for a [n_space, cap] base stack —
+        vmapped over the shard dim with matching shardings, so each
+        device builds the table for its own rows locally."""
+        key = ("probe_stack", n_buckets)
+        kernel = self._kernels.get(key)
+        if kernel is None:
+            kernel = self._kernels[key] = jax.jit(
+                jax.vmap(
+                    lambda sk, rem: probe_tables(
+                        sk, rem, n_buckets=n_buckets
+                    )
+                ),
+                in_shardings=(
+                    self._sharding("space", None),
+                    self._sharding("space", None),
+                ),
+                out_shardings=(
+                    self._sharding("space", None, None),
+                    self._sharding("space", None, None),
+                    self._sharding("space", None),
+                ),
+            )
+        return kernel(sk_stack, rem_stack)
+
+    def _compact_device(
+        self, snap: dict, cap2: int, host_arrays, k, n_buckets: int
+    ) -> dict:
         """Mesh-aware compaction: the resident base is a [n_space, cap]
         per-shard stack while the delta is flat, and the folded index
         needs fresh run-boundary split points — which only the host
@@ -184,8 +227,16 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             peer_buf, rows
         )
 
-    def _sort_delta(self, bufs: tuple) -> tuple:
-        return self._rep_kernel("sort_delta", _sort_segment_dev)(*bufs)
+    def _sort_delta(self, bufs: tuple, n_buckets: int) -> tuple:
+        key = ("sort_delta", n_buckets)
+        kernel = self._kernels.get(key)
+        if kernel is None:
+            v, t = self._sharding(None), self._sharding(None, None)
+            kernel = self._kernels[key] = jax.jit(
+                _sort_segment_dev, static_argnames=("n_buckets",),
+                out_shardings=(v, v, v, v, t, t, v),
+            )
+        return kernel(*bufs, n_buckets=n_buckets)
 
     def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
         """Map global sorted-row indices → (shard, local) and tombstone
@@ -205,7 +256,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         )
         return {
             **bundle,
-            "dev": (*dev[:2], kernel(dev[2], shard, local), dev[3]),
+            "dev": (*dev[:2], kernel(dev[2], shard, local), *dev[3:]),
         }
 
     # endregion
@@ -227,17 +278,19 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         mesh = self.mesh
         n_seg = len(kinds)
 
+        na = SEG_ARRAYS
+
         def local_segs(args):
             for i, kind in enumerate(kinds):
-                seg = args[4 * i:4 * i + 4]
+                seg = args[na * i:na * i + na]
                 if kind == "base":
                     seg = tuple(a[0] for a in seg)  # drop the shard dim
                 yield seg
 
         def local(*args):
-            queries = args[4 * n_seg:]
+            queries = args[na * n_seg:]
             parts = [
-                match_core(*seg, *queries, k=k)
+                match_core(seg, *queries, k=k)
                 for seg, k in zip(local_segs(args), ks)
             ]
             tgt = parts[0] if n_seg == 1 else jnp.concatenate(parts, axis=1)
@@ -259,7 +312,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
             def local2(*args):
                 segs = list(local_segs(args))
-                queries = args[4 * n_seg:]
+                queries = args[na * n_seg:]
                 parts, over_l, los, cnts = two_tier_first_pass(
                     segs, ks, k_lo, queries
                 )

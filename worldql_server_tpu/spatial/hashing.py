@@ -80,6 +80,16 @@ def spatial_keys2(
     return spatial_keys(world_ids, cubes, (seed + KEY2_OFFSET) & (2**64 - 1))
 
 
+def n_distinct(sorted_keys: np.ndarray) -> int:
+    """Distinct values in a SORTED key array (>= 1 by convention, so
+    probe-table sizing never degenerates to zero buckets). Sizing
+    contract partner of tpu_backend.probe_buckets_for — every segment
+    build site must count cubes the same way."""
+    if sorted_keys.size == 0:
+        return 1
+    return 1 + int(np.count_nonzero(sorted_keys[1:] != sorted_keys[:-1]))
+
+
 def next_pow2(n: int, floor: int = 8) -> int:
     """Capacity tier: smallest power of two >= max(n, floor). Bounds
     the number of distinct compiled shapes to log2(capacity)."""
