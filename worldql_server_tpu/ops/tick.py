@@ -7,15 +7,18 @@ per-message hot loop does (SURVEY §3.2), but for EVERY entity at once:
 2. re-quantize every entity to its subscription cube,
 3. rebuild the spatial hash for the tick (one device sort — the
    "per-tick spatial-hash rebuild" of BASELINE config 5),
-4. resolve every entity's broadcast: the contiguous run of co-cube
-   subscribers via a segment scan over the sort, gathered at fixed
-   degree K with except-self masking,
+4. resolve every entity's broadcast as a stencil over the sort:
+   co-cube members are sort-order neighbors, so the ±(K-1) candidate
+   window is a pad + stack-of-slices with same-run masks — no random
+   gather (a [N, K] element gather costs ~8 ns/element on TPU and
+   dominated this tick; the slice stack fuses into one kernel),
 5. order each entity's neighbors nearest-first (batched kNN: top-k by
-   squared distance over the candidate window).
+   squared distance over the stencil window).
 
 Static shapes throughout: N entities and degree K are compile-time;
-XLA fuses steps 1-2 and 4's mask/gather chains. The sort (step 3) is
-the asymptotic cost, O(N log N) on-device, no host round-trips.
+XLA fuses steps 1-2 and the stencil's roll/mask chains. The sort
+(step 3) is the asymptotic cost, O(N log N) on-device, no host
+round-trips.
 
 Quantization note: this sim path quantizes in f32 on device
 (``device_coord_clamp``), semantically mirroring the golden host
@@ -127,11 +130,14 @@ def simulation_tick(
     sorted_keys = keys[order]
     sorted_peer = state.peer[order]
 
-    # 4. resolve every entity's broadcast set. Every entity is a row of
-    # the sort it just participated in, so its run bounds come from a
-    # vectorized segment scan + one scatter back through ``order`` —
-    # no binary search (which would be 2 x log2(N) rounds of random
-    # gathers, the dominant cost at 100K+ entities).
+    # 4. resolve every entity's broadcast set as a STENCIL over the
+    # sort: an entity's co-cube members are its neighbors in sorted
+    # order, so the ±(K-1) candidate window is a contiguous slice per
+    # shift — no [N, K] random gather. The fixed-degree gather this
+    # replaces dominated the tick (27 of 36 ms at 100K entities on
+    # v5e: TPU element gathers cost ~8 ns/element). Exact counts
+    # still come from the run scan (cheap, and callers use them to
+    # detect K-overflow).
     p_idx = jnp.arange(n, dtype=jnp.int32)
     boundary = sorted_keys[1:] != sorted_keys[:-1]
     first = jnp.concatenate([jnp.ones((1,), bool), boundary])
@@ -140,41 +146,58 @@ def simulation_tick(
     run_end = jax.lax.cummin(
         jnp.where(last, p_idx + 1, jnp.int32(n)), reverse=True
     )
-    lo = jnp.zeros(n, jnp.int32).at[order].set(run_start)
-    hi = jnp.zeros(n, jnp.int32).at[order].set(run_end)
-    counts = hi - lo
+    counts_sorted = run_end - run_start
+    counts = jnp.zeros(n, jnp.int32).at[order].set(counts_sorted)
+    # inverse permutation: one cheap [N] scatter, so the final [N, K]
+    # un-permute is a row GATHER (take axis 0 — the TPU fast path)
+    inv = jnp.zeros(n, jnp.int32).at[order].set(p_idx)
 
-    offs = jnp.arange(k, dtype=jnp.int32)
-    gidx = jnp.minimum(lo[:, None] + offs[None, :], n - 1)
-    tgt = sorted_peer[gidx]
-    valid = (offs[None, :] < counts[:, None]) & (tgt != state.peer[:, None])
-
-    # 5. true k-nearest selection: order each entity's co-cube
-    # candidates nearest-first by squared distance. Distance bits and
-    # target pack into ONE int64 per candidate so the whole reorder is
-    # a single row-sort — lax.top_k on [N, K] costs ~5x more on TPU
+    # 5. true k-nearest selection among the stencil candidates: the
+    # ±(K-1) window covers EVERY co-cube member whenever the cube's
+    # occupancy L <= K (runs are contiguous in sorted order, so the
+    # max sort-order distance between members is L-1). Distance
+    # bits and target pack into ONE int64 per candidate so the whole
+    # reorder is a single row-sort — lax.top_k costs ~5x more on TPU
     # (measured) for the same result. IEEE bits of a non-negative f32
-    # are order-preserving, invalid slots carry the all-ones bit
-    # pattern (above +inf AND every NaN, so they sink below both), and
-    # equal distances tie-break by peer id (deterministic). With cube
-    # occupancy beyond K the window truncates at K candidates (callers
-    # detect via counts > K); within it the result is the k nearest,
-    # not sort-order happenstance.
-    targets = jnp.where(valid, tgt, -1)
+    # are order-preserving; invalid slots carry the all-ones bit
+    # pattern (above +inf AND every NaN — NaN positions are supported
+    # inputs, they quantize to cube +size), and equal distances
+    # tie-break by peer id (deterministic). With occupancy beyond K
+    # the candidate set truncates to the 2(K-1) nearest in sort order
+    # (callers detect via counts > K); within it the result is the
+    # exact k nearest.
+    # The window materializes as a pad + stack-of-slices (one fused
+    # concat — a python loop of jnp.roll per shift emits ~2K separate
+    # kernel launches, ~20x slower, measured). Run identity compares as
+    # a cumsum run id (i32 — exact, and cheaper than the i64 keys);
+    # padding rows carry run id -1, so window slots past either array
+    # end never match and there is no wraparound to dedup. The self
+    # column (shift 0) and duplicate-peer candidates fall to the
+    # ``peer != own`` mask, matching the reference's ExceptSelf.
     sorted_pos = pos[order]
-    cand = sorted_pos[gidx]  # [N, K, 3]
-    d2 = jnp.sum((cand - pos[:, None, :]) ** 2, axis=-1).astype(jnp.float32)
-    d2_bits = jax.lax.bitcast_convert_type(d2, jnp.uint32)
-    # mask invalid slots at the BIT level: uint32 max exceeds even NaN
-    # bit patterns, so a valid candidate with a NaN distance (NaN
-    # positions are supported inputs — they quantize to cube +size)
-    # still sorts before the -1 sentinels instead of after them
-    d2_bits = jnp.where(valid, d2_bits, jnp.uint32(0xFFFFFFFF))
-    packed = (d2_bits.astype(jnp.uint64) << jnp.uint64(32)) | (
-        (targets + 1).astype(jnp.uint64) & jnp.uint64(0xFFFFFFFF)
+    w = 2 * k - 1
+    rid = jnp.cumsum(first.astype(jnp.int32))
+    rid_p = jnp.pad(rid, (k - 1, k - 1), constant_values=-1)
+    peer_p = jnp.pad(sorted_peer, (k - 1, k - 1), constant_values=-1)
+    pos_p = jnp.pad(sorted_pos, ((k - 1, k - 1), (0, 0)))
+    rid_w = jnp.stack([rid_p[s:s + n] for s in range(w)], axis=1)
+    peer_w = jnp.stack([peer_p[s:s + n] for s in range(w)], axis=1)
+    pos_w = jnp.stack([pos_p[s:s + n] for s in range(w)], axis=1)
+    same = (rid_w == rid[:, None]) & (peer_w != sorted_peer[:, None])
+    d2 = jnp.sum((pos_w - sorted_pos[:, None, :]) ** 2, axis=-1).astype(
+        jnp.float32
     )
-    packed = jnp.sort(packed, axis=1)
-    targets = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32) - 1
+    d2_bits = jnp.where(
+        same, jax.lax.bitcast_convert_type(d2, jnp.uint32),
+        jnp.uint32(0xFFFFFFFF),
+    )
+    packed = (d2_bits.astype(jnp.uint64) << jnp.uint64(32)) | (
+        (jnp.where(same, peer_w, -1) + 1).astype(jnp.uint64)
+        & jnp.uint64(0xFFFFFFFF)
+    )
+    packed = jnp.sort(packed, axis=1)[:, :k]   # k nearest per entity
+    tgt_sorted = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32) - 1
+    targets = jnp.take(tgt_sorted, inv, axis=0)
 
     return EntityState(pos, vel, state.world, state.peer), targets, counts
 

@@ -245,12 +245,36 @@ def run_remainders(sorted_keys):
     return ends + 1 - idx
 
 
+def _window_gather(arr, lo, k):
+    """[M] window starts → [M, k] contiguous windows of a 1-D array
+    (length a multiple of 8), via aligned row gathers from an [S/8, 8]
+    view + an 8-way static-rotation select. A TPU element gather costs
+    ~8 ns/element; an aligned row gather ~25x less (measured on v5e) —
+    the windows here are contiguous, so only the alignment varies.
+    Lanes past the array end read the clamped last row; every caller
+    masks them (they can only be lanes beyond the run length)."""
+    s = arr.shape[0]
+    if s < 8 or s % 8:
+        idx = jnp.minimum(lo[:, None] + jnp.arange(k, dtype=lo.dtype), s - 1)
+        return arr[idx]
+    nrows = s // 8
+    v = arr.reshape(nrows, 8)
+    r = jnp.minimum(lo >> 3, nrows - 1).astype(jnp.int32)
+    c = (lo & 7).astype(jnp.int32)
+    rows = jnp.concatenate(
+        [jnp.take(v, jnp.minimum(r + t, nrows - 1), axis=0)
+         for t in range((k + 7) // 8 + 1)], axis=1)
+    out = rows[:, 0:k]
+    for cc in range(1, 8):
+        out = jnp.where((c == cc)[:, None], rows[:, cc:cc + k], out)
+    return out
+
+
 def _gather_filtered(sub_peer, lo, cnt, q_sender, q_repl, *, k):
     """Gather up to ``k`` targets per run and apply the tombstone +
     replication filters (local_message.rs:60-86)."""
     offs = jnp.arange(k, dtype=lo.dtype)
-    gidx = jnp.minimum(lo[:, None] + offs[None, :], sub_peer.shape[0] - 1)
-    tgt = sub_peer[gidx]
+    tgt = _window_gather(sub_peer, lo, k)
     valid = (offs[None, :] < cnt[:, None]) & (tgt >= 0)
     is_sender = tgt == q_sender[:, None]
     repl = q_repl[:, None]
