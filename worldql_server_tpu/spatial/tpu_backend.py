@@ -117,8 +117,9 @@ def probe_tables(sorted_keys, run_rem, *, n_buckets: int):
     """Build the bucket probe table for a sorted segment on device.
 
     The table replaces the per-query binary search (20 dependent gather
-    rounds into a 1M-row segment, ~7 ms for a 16K batch on v5e) with a
-    single 64-byte row gather (~0.2 ms): each distinct cube's run start
+    rounds into a 1M-row segment, ~8 ms for a 16K batch on v5e) with a
+    single 64-byte row gather (~1.4 ms end-to-end run-bounds, verify
+    gather and cond dispatch included): each distinct cube's run start
     lands in bucket ``hash(key) & (B-1)``, at most PROBE_E entries per
     bucket. Returns ``(tbl_key [B, E], tbl_pay [B, E], oflow [1])`` —
     ``tbl_pay`` packs ``(run_start << 31) | run_len``; ``oflow`` counts
