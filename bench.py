@@ -343,19 +343,28 @@ def _device_probes(tpu, batch, csr_cap: int):
         q_key, q_key2, q_sender, q_repl = queries
 
         def body(i, carry):
-            acc, qk = carry
+            acc, shift = carry
+            # every iteration runs the SAME multiset of queries rotated
+            # by a result-derived shift: the workload (hit pattern, run
+            # sizes, CSR totals) is identical each rep — feeding keys
+            # back instead made half the iterations an all-miss batch —
+            # while the rotation keeps the WHOLE kernel (lookup
+            # included) on the loop-carried dependency chain, so XLA
+            # cannot hoist the dominant probe/gather work out of the
+            # loop as it could when only the sender column changed.
+            rolled = tuple(jnp.roll(q, shift) for q in
+                           (q_key, q_key2, q_sender, q_repl))
             counts, flat, total = match_two_tier_csr(
-                flat_segs + (qk, q_key2, q_sender, q_repl),
-                tuple(ks), k_lo, h_cap, t_cap,
+                flat_segs + rolled, tuple(ks), k_lo, h_cap, t_cap,
             )
-            # thread the result back into the next queries: forces full
-            # execution of every iteration, including the CSR scatter
-            # (pad: the result tier can be smaller than the query batch)
-            padded = jnp.pad(flat, (0, max(0, mq - flat.shape[0])))
-            fold = (padded[:mq] & 1).astype(jnp.int64)
-            return acc + total.astype(jnp.int64), qk ^ fold
+            # the shift consumes a reduction of `flat` too, so the CSR
+            # scatter producing it stays live inside the timed loop
+            # (depending on `total` alone would let XLA drop it)
+            fold = total ^ flat.sum(dtype=jnp.int32)
+            nxt = (fold & jnp.int32(mq - 1)) + jnp.int32(1)
+            return acc + total.astype(jnp.int64), nxt
         acc, _ = jax.lax.fori_loop(
-            0, reps, body, (jnp.int64(0), q_key)
+            0, reps, body, (jnp.int64(0), jnp.int32(1))
         )
         return acc
 

@@ -82,7 +82,9 @@ def test_probe_matches_binary_search(n_cubes):
     assert int(oflow[0]) == 0, "healthy load factor must never overflow"
 
     lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
-    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tk, tp, d_sk2, qk, qk2)
+    lo_p, cnt_p = jax.jit(
+        _probe_run_bounds, static_argnames=("spill",)
+    )(tk, tp, d_sk2, qk, qk2, spill=int(oflow[1]) > 0)
     cnt_ref = np.asarray(cnt_ref)
     found = cnt_ref > 0
     assert (np.asarray(cnt_p) == cnt_ref).all()
@@ -113,17 +115,47 @@ def test_table_stores_every_cube_once():
         assert (sk_host[lo:lo + rem_v] == key).all()
 
 
+def test_spill_level_recovers_hot_bucket():
+    """With n_buckets=1 and a few dozen cubes, only PROBE_E fit the
+    primary bucket — the rest must land in the spill level and stay
+    probeable WITHOUT the binary-search fallback (oflow == 0)."""
+    rng = np.random.default_rng(9)
+    d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 20)
+    tk, tp, oflow = jax.jit(
+        probe_tables, static_argnames=("n_buckets",)
+    )(d_sk, rem, n_buckets=1)
+    assert int(oflow[0]) == 0, "spill level must absorb the overflow"
+    n_unique = len(set(keys.tolist()))
+    stored = np.asarray(tk).ravel()
+    assert (stored != int(PAD_KEY)).sum() == n_unique
+    # and the spill rows (past the single primary bucket) hold the rest
+    spill_rows = np.asarray(tk)[1:].ravel()
+    assert (spill_rows != int(PAD_KEY)).sum() == n_unique - PROBE_E
+
+    qk, qk2 = make_queries(rng, keys, keys2)
+    lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
+    lo_p, cnt_p = jax.jit(
+        _probe_run_bounds, static_argnames=("spill",)
+    )(tk, tp, d_sk2, qk, qk2, spill=int(oflow[1]) > 0)
+    cnt_ref = np.asarray(cnt_ref)
+    found = cnt_ref > 0
+    assert (np.asarray(cnt_p) == cnt_ref).all()
+    assert (np.asarray(lo_p)[found] == np.asarray(lo_ref)[found]).all()
+
+
 def test_overflow_falls_back_to_binary_search():
-    """With n_buckets=1, every cube lands in one bucket: at most
-    PROBE_E fit, the rest overflow — the cond must route ALL queries
+    """Overflowing BOTH levels (n_buckets=1: 8 primary slots + 16
+    spill buckets x 8 slots, vs ~200 cubes) must route ALL queries
     through binary search, so no match is ever dropped."""
     rng = np.random.default_rng(9)
-    d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 64)
+    d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 200)
     tk, tp, oflow = jax.jit(
         probe_tables, static_argnames=("n_buckets",)
     )(d_sk, rem, n_buckets=1)
     n_unique = len(set(keys.tolist()))
-    assert int(oflow[0]) == n_unique - PROBE_E
+    spill_slots = 16 * PROBE_E
+    assert int(oflow[0]) >= n_unique - PROBE_E - spill_slots
+    assert int(oflow[0]) > 0
 
     qk, qk2 = make_queries(rng, keys, keys2)
     seg = (d_sk, d_sk2, d_sp, rem, tk, tp, oflow)

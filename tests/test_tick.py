@@ -149,3 +149,31 @@ def test_tick_nan_position_still_broadcasts_before_sentinels():
     # no real target after the first -1 (contiguity invariant)
     first_pad = row.index(-1) if -1 in row else len(row)
     assert all(t == -1 for t in row[first_pad:])
+
+
+def test_tick_k1_finds_single_nearest():
+    """k=1 must return the single nearest co-cube neighbor (it rides
+    the k=2 window internally — a ±0 stencil would silently return no
+    neighbors at all), on both the XLA and Pallas(interpret) paths."""
+    position = jnp.array([
+        [1.0, 1.0, 1.0],
+        [2.0, 1.0, 1.0],
+        [9.0, 1.0, 1.0],
+        [500.0, 1.0, 1.0],
+    ], jnp.float32)
+    state = EntityState(
+        position=position,
+        velocity=jnp.zeros((4, 3), jnp.float32),
+        world=jnp.zeros(4, jnp.int32),
+        peer=jnp.arange(4, dtype=jnp.int32),
+    )
+    for pallas in (False, True):
+        tick = make_tick_fn(cube_size=16, k=1, dt=0.0, pallas=pallas)
+        _, targets, counts = tick(state)
+        tgt = np.asarray(targets)
+        assert tgt.shape == (4, 1)
+        assert tgt[0, 0] == 1   # x=1 → nearest is x=2
+        assert tgt[1, 0] == 0   # x=2 → nearest is x=1 (dx=1 < dx=7)
+        assert tgt[2, 0] in (0, 1)  # occupancy 3 > k: truncated window
+        assert tgt[3, 0] == -1  # alone in its cube
+        assert int(np.asarray(counts)[3]) == 1

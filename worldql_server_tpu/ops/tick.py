@@ -180,21 +180,27 @@ def simulation_tick(
 
     if pallas is None:
         pallas = jax.devices()[0].platform == "tpu"
-    if pallas and k >= 2:
+    # k=1 rides the k=2 window, truncated to one target: a ±(k-1)
+    # stencil at k=1 is empty and would silently return NO neighbors,
+    # while ±1 finds the single nearest whenever occupancy <= 2 — the
+    # same exactness contract (L <= K, overflow visible via counts)
+    # every other k gets.
+    kw = max(k, 2)
+    if pallas:
         # fused Pallas kernel: the whole stencil + k-nearest select in
         # one launch (ops/knn_pallas.py) — ~7x over the XLA stencil at
         # 100K entities on v5e (launch- and HBM-round-trip-bound)
         from .knn_pallas import knn_select
 
-        tgt_sorted = knn_select(rid, sorted_peer, sorted_pos, k=k)
+        tgt_sorted = knn_select(rid, sorted_peer, sorted_pos, k=kw)[:, :k]
         targets = jnp.take(tgt_sorted, inv, axis=0)
         return (EntityState(pos, vel, state.world, state.peer),
                 targets, counts)
 
-    w = 2 * k - 1
-    rid_p = jnp.pad(rid, (k - 1, k - 1), constant_values=-1)
-    peer_p = jnp.pad(sorted_peer, (k - 1, k - 1), constant_values=-1)
-    pos_p = jnp.pad(sorted_pos, ((k - 1, k - 1), (0, 0)))
+    w = 2 * kw - 1
+    rid_p = jnp.pad(rid, (kw - 1, kw - 1), constant_values=-1)
+    peer_p = jnp.pad(sorted_peer, (kw - 1, kw - 1), constant_values=-1)
+    pos_p = jnp.pad(sorted_pos, ((kw - 1, kw - 1), (0, 0)))
     rid_w = jnp.stack([rid_p[s:s + n] for s in range(w)], axis=1)
     peer_w = jnp.stack([peer_p[s:s + n] for s in range(w)], axis=1)
     pos_w = jnp.stack([pos_p[s:s + n] for s in range(w)], axis=1)

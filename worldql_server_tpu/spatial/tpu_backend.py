@@ -86,92 +86,170 @@ _XYZ_PAD = np.int64(-(2 ** 62))
 #: (the TPU's sweet spot: an [M, 8] i64 row gather costs about the same
 #: as an [M] scalar gather, measured on v5e)
 PROBE_E = 8
-#: probe-table bucket-count ceiling: beyond this the table would exceed
-#: ~64 MB and overflow anyway (load factor > 1), so the cond falls back
-#: to binary search — correctness never depends on the table fitting
+#: primary-level bucket-count ceiling: beyond this the two-level table
+#: would exceed ~72 MB; past the cap the load factor rises and cubes
+#: spill to the second level (and, last, to binary search) — correctness
+#: never depends on the table fitting
 PROBE_MAX_BUCKETS = 1 << 19
-#: seed folding the bucket hash away from the two key hash families
+#: seeds folding the two bucket hashes away from the key hash families
+#: (and from each other — a cube that overflows its level-1 bucket must
+#: land in an independent level-2 bucket)
 _PROBE_SEED = jnp.uint64(0xA0761D6478BD642F)
+_PROBE_SEED2 = jnp.uint64(0x8BB84B93962EACC9)
 
 SEG_ARRAYS = 7  # (key, key2, peer, run_rem, tbl_key, tbl_pay, oflow)
 
 
 def probe_buckets_for(n_cubes: int) -> int:
-    """Bucket-count tier for a segment with ``n_cubes`` distinct cubes:
-    load factor <= 1 against PROBE_E-slot buckets keeps the overflow
-    probability ~1e-6 per table (and overflow only costs speed)."""
-    return min(next_pow2(max(n_cubes, 8)), PROBE_MAX_BUCKETS)
+    """Primary bucket-count tier for a segment with ``n_cubes`` distinct
+    cubes: 2x headroom (load factor <= 0.5) against PROBE_E-slot buckets
+    keeps the expected spill per table below ~1e-3 cubes until the
+    bucket cap, and spilled cubes stay probeable via the second level —
+    only a cube overflowing BOTH levels (~never: the spill level is
+    nearly empty) routes its segment to binary search. At the cap the
+    primary load factor rises with n_cubes (~1.2 at 630K cubes: a few
+    spilled cubes, trivially absorbed by the 2^15-bucket spill level)."""
+    return min(next_pow2(2 * max(n_cubes, 8)), PROBE_MAX_BUCKETS)
 
 
-def _bucket_hash(keys):
+def spill_buckets_for(n_buckets: int) -> int:
+    """Spill-level bucket count paired with a primary of ``n_buckets``.
+    Sized for the expected spill population (tens of cubes at worst
+    primary load), not the cube count."""
+    return max(n_buckets // 16, 16)
+
+
+def probe_split(total_rows: int) -> tuple[int, int]:
+    """Recover ``(n_buckets, n_spill)`` from a combined table's row
+    count. ``b + spill_buckets_for(b)`` is strictly increasing in b, so
+    the split is unambiguous; shapes are static under trace, so this
+    runs at trace time."""
+    b = 1 << (max(total_rows, 1).bit_length() - 1)
+    while b >= 1:
+        if b + spill_buckets_for(b) == total_rows:
+            return b, spill_buckets_for(b)
+        b >>= 1
+    raise ValueError(f"not a probe-table row count: {total_rows}")
+
+
+def _bucket_hash(keys, seed=_PROBE_SEED):
     """[..] i64 keys → uint64 bucket hashes (splitmix64, distinct seed
     from both key families). Device-only: build and probe both run on
     device, so no host twin has to stay bit-identical."""
-    x = keys.view(jnp.uint64) ^ _PROBE_SEED
+    x = keys.view(jnp.uint64) ^ seed
     x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(MIX_M1)
     x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(MIX_M2)
     return x ^ (x >> jnp.uint64(31))
 
 
 def probe_tables(sorted_keys, run_rem, *, n_buckets: int):
-    """Build the bucket probe table for a sorted segment on device.
+    """Build the two-level bucket probe table for a sorted segment on
+    device.
 
     The table replaces the per-query binary search (20 dependent gather
-    rounds into a 1M-row segment, ~8 ms for a 16K batch on v5e) with a
-    single 64-byte row gather (~1.4 ms end-to-end run-bounds, verify
-    gather and cond dispatch included): each distinct cube's run start
-    lands in bucket ``hash(key) & (B-1)``, at most PROBE_E entries per
-    bucket. Returns ``(tbl_key [B, E], tbl_pay [B, E], oflow [1])`` —
-    ``tbl_pay`` packs ``(run_start << 31) | run_len``; ``oflow`` counts
-    cubes that did not fit (queries then take the binary-search branch
-    of :func:`_seg_run_bounds`; expected ~never at load factor <= 1).
+    rounds into a 1M-row segment, ~8 ms for a 16K batch on v5e) with
+    bucket-row gathers (~1.4 ms end-to-end run-bounds, verify gather and
+    cond dispatch included): each distinct cube's run start lands in
+    primary bucket ``hash1(key) & (B-1)``, at most PROBE_E entries per
+    bucket; cubes overflowing their primary bucket rehash with an
+    independent seed into ``B2 = spill_buckets_for(B)`` spill buckets
+    appended to the same array, so a hot bucket costs one extra row
+    gather instead of disabling the whole table. Returns
+    ``(tbl_key [B+B2, E], tbl_pay [B+B2, E], oflow [2])`` — ``tbl_pay``
+    packs ``(run_start << 31) | run_len``; ``oflow[0]`` counts cubes
+    that fit NEITHER level (queries then take the binary-search branch
+    of :func:`_seg_run_bounds`; ~never — the spill level is nearly
+    empty) and ``oflow[1]`` the spill-level population (0 for almost
+    every table: queries then skip the spill gather entirely).
 
-    Cost: one [S] argsort + two scatters — amortized into the flush /
+    Cost: two [S] argsorts + four scatters — amortized into the flush /
     compaction launch that sorted the segment anyway.
     """
     s = sorted_keys.shape[0]
     e = PROBE_E
+    n2 = spill_buckets_for(n_buckets)
+    total = (n_buckets + n2) * e
     idx = jnp.arange(s, dtype=jnp.int32)
     first = jnp.concatenate([
         jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]
     ]) & (sorted_keys != PAD_KEY)
+
+    def pack_level(bucket_rows, member, sentinel):
+        """Group ``member`` lanes by bucket row and assign slot ranks:
+        stable-sort by bucket (non-members to ``sentinel``), rank lanes
+        within their bucket run, and compute scatter slots — skipped
+        lanes get a DISTINCT out-of-bounds slot each, keeping the
+        unique_indices promise honest (mode="drop" ignores them).
+        Returns (order, slots, overflowed-lane mask in order-space)."""
+        bb = jnp.where(member, bucket_rows, jnp.int32(sentinel))
+        order = jnp.argsort(bb, stable=True)
+        sb = bb[order]
+        runstart = jnp.concatenate(
+            [jnp.ones((1,), bool), sb[1:] != sb[:-1]]
+        )
+        rank = idx - jax.lax.cummax(jnp.where(runstart, idx, 0))
+        in_level = sb < sentinel
+        fit = in_level & (rank < e)
+        slots = jnp.where(fit, sb * e + rank, total + idx)
+        return order, slots, in_level & (rank >= e)
+
     b = (_bucket_hash(sorted_keys) & jnp.uint64(n_buckets - 1)).astype(
         jnp.int32
     )
-    bb = jnp.where(first, b, jnp.int32(n_buckets))  # sentinel: not a cube
-    order = jnp.argsort(bb, stable=True)
-    sb = bb[order]
-    runstart = jnp.concatenate([jnp.ones((1,), bool), sb[1:] != sb[:-1]])
-    rank = idx - jax.lax.cummax(jnp.where(runstart, idx, 0))
-    is_cube = sb < n_buckets
-    valid = is_cube & (rank < e)
-    oflow = (is_cube & (rank >= e)).sum(dtype=jnp.int32).reshape(1)
-    slot = jnp.where(valid, sb * e + rank, n_buckets * e)
-    tk = jnp.full(n_buckets * e, PAD_KEY, jnp.int64).at[slot].set(
-        sorted_keys[order], mode="drop", unique_indices=True
+    order, slot1, over1 = pack_level(b, first, n_buckets)
+    keys_o = sorted_keys[order]
+    pay_o = (order.astype(jnp.int64) << jnp.int64(31)) | run_rem[
+        order
+    ].astype(jnp.int64)
+
+    # spill level: overflowed cubes rehash into the appended rows
+    b2 = n_buckets + (
+        _bucket_hash(keys_o, _PROBE_SEED2) & jnp.uint64(n2 - 1)
+    ).astype(jnp.int32)
+    order2, slot2, over2 = pack_level(b2, over1, n_buckets + n2)
+    oflow = jnp.stack([
+        over2.sum(dtype=jnp.int32),
+        over1.sum(dtype=jnp.int32),
+    ])
+
+    # the two levels write disjoint row ranges, so the chained scatters
+    # cannot clobber each other
+    tk = (
+        jnp.full(total, PAD_KEY, jnp.int64)
+        .at[slot1].set(keys_o, mode="drop", unique_indices=True)
+        .at[slot2].set(keys_o[order2], mode="drop", unique_indices=True)
     )
-    pay = (order.astype(jnp.int64) << jnp.int64(31)) | run_rem[order].astype(
-        jnp.int64
+    tp = (
+        jnp.zeros(total, jnp.int64)
+        .at[slot1].set(pay_o, mode="drop", unique_indices=True)
+        .at[slot2].set(pay_o[order2], mode="drop", unique_indices=True)
     )
-    tp = jnp.zeros(n_buckets * e, jnp.int64).at[slot].set(
-        pay, mode="drop", unique_indices=True
-    )
-    return tk.reshape(n_buckets, e), tp.reshape(n_buckets, e), oflow
+    rows = n_buckets + n2
+    return tk.reshape(rows, e), tp.reshape(rows, e), oflow
 
 
-def _probe_run_bounds(tbl_key, tbl_pay, sub_key2, q_key, q_key2):
-    """Per-query (run start, run length) via one bucket-row gather.
+def _probe_run_bounds(tbl_key, tbl_pay, sub_key2, q_key, q_key2, *,
+                      spill: bool):
+    """Per-query (run start, run length) via bucket-row gathers — one
+    row when the spill level is empty (``spill=False``, the common
+    case), primary + spill when it holds cubes.
 
     A table hit proves first-key equality (the bucket stores the exact
-    64-bit key); the second-key exactness gather against the segment is
-    unchanged from the binary-search path, so the ~2^-128 mis-route
-    contract holds identically."""
+    64-bit key, and a cube lives in exactly one level); the second-key
+    exactness gather against the segment is unchanged from the
+    binary-search path, so the ~2^-128 mis-route contract holds
+    identically."""
     s = sub_key2.shape[0]
-    b = (_bucket_hash(q_key) & jnp.uint64(tbl_key.shape[0] - 1)).astype(
-        jnp.int32
-    )
-    rk = jnp.take(tbl_key, b, axis=0)   # [M, E] — one 64-byte row each
-    rp = jnp.take(tbl_pay, b, axis=0)
+    nb, n2 = probe_split(tbl_key.shape[0])
+    b1 = (_bucket_hash(q_key) & jnp.uint64(nb - 1)).astype(jnp.int32)
+    rk = jnp.take(tbl_key, b1, axis=0)  # [M, E] — one 64-byte row each
+    rp = jnp.take(tbl_pay, b1, axis=0)
+    if spill:
+        b2 = nb + (
+            _bucket_hash(q_key, _PROBE_SEED2) & jnp.uint64(n2 - 1)
+        ).astype(jnp.int32)
+        rk = jnp.concatenate([rk, jnp.take(tbl_key, b2, axis=0)], axis=1)
+        rp = jnp.concatenate([rp, jnp.take(tbl_pay, b2, axis=0)], axis=1)
     hit = rk == q_key[:, None]          # <= 1 lane: keys unique per table
     pay = jnp.where(hit, rp, 0).max(axis=1)
     lo = (pay >> jnp.int64(31)).astype(jnp.int32)
@@ -182,14 +260,22 @@ def _probe_run_bounds(tbl_key, tbl_pay, sub_key2, q_key, q_key2):
 
 
 def _seg_run_bounds(seg, q_key, q_key2):
-    """Run bounds for one 7-array segment: bucket probe when the table
-    built cleanly, binary search when it overflowed (oflow > 0). The
-    branch is a device scalar — no host sync decides it."""
+    """Run bounds for one 7-array segment: primary-only bucket probe
+    when the table built cleanly (almost always), primary+spill probe
+    when some cubes spilled, binary search when cubes fit neither level
+    (oflow[0] > 0). Both branch scalars live on device — no host sync
+    decides them."""
     sub_key, sub_key2, _, sub_rem, tbl_key, tbl_pay, oflow = seg
+
+    def probe(spill: bool):
+        return lambda: _probe_run_bounds(
+            tbl_key, tbl_pay, sub_key2, q_key, q_key2, spill=spill
+        )
+
     return jax.lax.cond(
         oflow[0] > 0,
         lambda: _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2),
-        lambda: _probe_run_bounds(tbl_key, tbl_pay, sub_key2, q_key, q_key2),
+        lambda: jax.lax.cond(oflow[1] > 0, probe(True), probe(False)),
     )
 
 
