@@ -1,0 +1,272 @@
+"""Golden wire-fixture generator: buffers laid out exactly as the
+REFERENCE writer emits them.
+
+The reference serializes with flatc-generated Rust
+(worldql_server/src/flatbuffers/WorldQLFB_generated.rs): MessageT::pack
+creates child offsets in field order (:1134-1160 — parameter,
+sender_uuid, world_name, records, entities, flex; each RecordT::pack
+:620-646 creates uuid, world_name, data, flex), then Message::create
+pushes vtable slots in REVERSE field order (:887-899 — flex, position,
+entities, records, world_name, sender_uuid, parameter, replication,
+instruction), omitting scalar slots at their defaults
+(Instruction::Heartbeat, Replication::ExceptSelf — push_slot
+:1040-1058) and finishing without a file identifier (message.rs:128).
+
+This module re-creates that exact call sequence on the STOCK Google
+FlatBuffers Python runtime (``flatbuffers.Builder`` — the same
+canonical builder algorithm the Rust crate implements), so the vendored
+``tests/fixtures/wire/*.bin`` buffers stand in for "bytes the Rust
+reference put on the wire": vtable layout, slot order, string placement
+and alignment all follow the generated writer rather than this repo's
+own codec (which pushes slots in forward order — equally valid
+FlatBuffers, but a different layout; decoding THESE buffers is what
+proves cross-compatibility).
+
+Run ``python tests/wire_fixtures.py`` to (re)generate the vendored
+files; ``test_wire_fixtures.py`` asserts the generator still reproduces
+them byte-exactly (pinning the runtime) and that both codecs decode
+them correctly.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from pathlib import Path
+
+import flatbuffers
+
+FIXTURE_DIR = Path(__file__).resolve().parent / "fixtures" / "wire"
+
+U1 = "01234567-89ab-cdef-0123-456789abcdef"
+U2 = "fedcba98-7654-3210-fedc-ba9876543210"
+U3 = "00000000-0000-0000-0000-000000000000"
+
+
+def _vec3d(b: flatbuffers.Builder, v) -> int:
+    """Inline Vec3d struct, field push order per the generated writer
+    (Vec3d::new x, y, z → prepended z, y, x)."""
+    b.Prep(8, 24)
+    b.PrependFloat64(v[2])
+    b.PrependFloat64(v[1])
+    b.PrependFloat64(v[0])
+    return b.Offset()
+
+
+def _pack_obj(b: flatbuffers.Builder, o: dict) -> int:
+    """RecordT/EntityT::pack + Record::create: strings in field order,
+    slots pushed in reverse (flex, data, world_name, position, uuid)."""
+    uuid_off = b.CreateString(o["uuid"]) if "uuid" in o else None
+    world_off = b.CreateString(o["world_name"]) if "world_name" in o else None
+    data_off = b.CreateString(o["data"]) if "data" in o else None
+    flex_off = b.CreateByteVector(o["flex"]) if "flex" in o else None
+    b.StartObject(5)
+    if flex_off is not None:
+        b.PrependUOffsetTRelativeSlot(4, flex_off, 0)
+    if data_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, data_off, 0)
+    if world_off is not None:
+        b.PrependUOffsetTRelativeSlot(2, world_off, 0)
+    if "position" in o:
+        b.PrependStructSlot(1, _vec3d(b, o["position"]), 0)
+    if uuid_off is not None:
+        b.PrependUOffsetTRelativeSlot(0, uuid_off, 0)
+    return b.EndObject()
+
+
+def _obj_vector(b: flatbuffers.Builder, objs: list[dict]) -> int:
+    offs = [_pack_obj(b, o) for o in objs]
+    b.StartVector(4, len(offs), 4)
+    for off in reversed(offs):
+        b.PrependUOffsetTRelative(off)
+    return b.EndVector()
+
+
+def build_reference_bytes(case: dict) -> bytes:
+    """One Message buffer in the reference writer's layout."""
+    b = flatbuffers.Builder(1024)
+    param_off = b.CreateString(case["parameter"]) if "parameter" in case \
+        else None
+    sender_off = b.CreateString(case["sender_uuid"]) \
+        if "sender_uuid" in case else None
+    world_off = b.CreateString(case["world_name"]) if "world_name" in case \
+        else None
+    records_vec = _obj_vector(b, case["records"]) if "records" in case \
+        else None
+    entities_vec = _obj_vector(b, case["entities"]) if "entities" in case \
+        else None
+    flex_off = b.CreateByteVector(case["flex"]) if "flex" in case else None
+
+    b.StartObject(9)
+    if flex_off is not None:
+        b.PrependUOffsetTRelativeSlot(8, flex_off, 0)
+    if "position" in case:
+        b.PrependStructSlot(7, _vec3d(b, case["position"]), 0)
+    if entities_vec is not None:
+        b.PrependUOffsetTRelativeSlot(6, entities_vec, 0)
+    if records_vec is not None:
+        b.PrependUOffsetTRelativeSlot(5, records_vec, 0)
+    if world_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, world_off, 0)
+    if sender_off is not None:
+        b.PrependUOffsetTRelativeSlot(2, sender_off, 0)
+    if param_off is not None:
+        b.PrependUOffsetTRelativeSlot(1, param_off, 0)
+    # scalar slots omitted at defaults, like the Rust push_slot
+    b.PrependUint8Slot(4, case.get("replication", 0), 0)
+    b.PrependUint8Slot(0, case.get("instruction", 0), 0)
+    root = b.EndObject()
+    b.Finish(root)  # no file identifier (message.rs:128)
+    return bytes(b.Output())
+
+
+# Every instruction, optional fields present/absent, records with flex.
+# "bad_*" cases violate the decoder's required-field contract
+# (message.rs:56-111) and must raise, not crash.
+CASES: dict[str, dict] = {
+    # minimal per-instruction envelopes; instruction 0 (Heartbeat) and
+    # replication 0 both OMITTED from the buffer — decoders must apply
+    # defaults
+    **{
+        f"instruction_{i:02d}": {
+            "instruction": i, "sender_uuid": U1, "world_name": "w",
+        }
+        for i in range(14)
+    },
+    "defaults_only": {"sender_uuid": U3, "world_name": "@global"},
+    "replication_including": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "replication": 1, "position": (1.0, 2.0, 3.0),
+    },
+    "replication_only_self": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "replication": 2, "position": (1.0, 2.0, 3.0),
+    },
+    "unknown_enums_saturate": {
+        # instruction 99 → Unknown, replication 99 → ExceptSelf
+        "instruction": 99, "sender_uuid": U1, "world_name": "w",
+        "replication": 99,
+    },
+    "parameter_present": {
+        "instruction": 1, "sender_uuid": U1, "world_name": "w",
+        "parameter": "tcp://127.0.0.1:29871",
+    },
+    "unicode_strings": {
+        "instruction": 6, "sender_uuid": U1,
+        "world_name": "w", "parameter": "héllo wörld ✨ 日本語",
+    },
+    "long_parameter": {
+        "instruction": 6, "sender_uuid": U1, "world_name": "w",
+        "parameter": "x" * 4096,
+    },
+    "position_extremes": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "position": (-0.0, 1e308, -1e-308),
+    },
+    "message_flex": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "position": (4.0, 5.0, 6.0), "flex": bytes(range(256)),
+    },
+    "record_minimal": {
+        "instruction": 8, "sender_uuid": U1, "world_name": "w",
+        "records": [{"uuid": U2, "world_name": "w"}],
+    },
+    "record_full": {
+        "instruction": 8, "sender_uuid": U1, "world_name": "w",
+        "records": [{
+            "uuid": U2, "world_name": "w", "data": "payload",
+            "position": (10.5, -11.25, 12.0),
+            "flex": b"\x00\x01\xfe\xff",
+        }],
+    },
+    "record_many": {
+        "instruction": 12, "sender_uuid": U3, "world_name": "w",
+        "parameter": "1651113606000",
+        "records": [
+            {"uuid": U1, "world_name": "w", "position": (1.0, 2.0, 3.0)},
+            {"uuid": U2, "world_name": "w", "data": "d2"},
+            {"uuid": U3, "world_name": "w_other",
+             "flex": b"raw \x00 bytes"},
+        ],
+    },
+    "records_empty_vector": {
+        # Some(vec![]) — present but empty vector, distinct from absent
+        "instruction": 8, "sender_uuid": U1, "world_name": "w",
+        "records": [],
+    },
+    "entity_full": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "entities": [{
+            "uuid": U2, "world_name": "w", "data": "e",
+            "position": (7.0, 8.0, 9.0),
+        }],
+    },
+    "everything": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "big",
+        "parameter": "param", "replication": 2,
+        "position": (100.0, -200.0, 300.0), "flex": b"\xde\xad\xbe\xef",
+        "records": [{"uuid": U2, "world_name": "big",
+                     "position": (1.0, 1.0, 1.0), "data": "r",
+                     "flex": b"rf"}],
+        "entities": [{"uuid": U3, "world_name": "big",
+                      "position": (2.0, 2.0, 2.0)}],
+    },
+    # decoder-contract violations (reference: DecodeError, not a crash)
+    "bad_missing_sender": {"instruction": 7, "world_name": "w"},
+    "bad_missing_world": {"instruction": 7, "sender_uuid": U1},
+    "bad_sender_not_uuid": {
+        "instruction": 7, "sender_uuid": "not-a-uuid", "world_name": "w",
+    },
+    "bad_record_missing_uuid": {
+        "instruction": 8, "sender_uuid": U1, "world_name": "w",
+        "records": [{"world_name": "w"}],
+    },
+    "bad_entity_missing_position": {
+        "instruction": 7, "sender_uuid": U1, "world_name": "w",
+        "entities": [{"uuid": U2, "world_name": "w"}],
+    },
+}
+
+BAD_CASES = {name for name in CASES if name.startswith("bad_")}
+
+
+def expected_message(case: dict):
+    """The Message a correct decoder must produce for a (good) case."""
+    from worldql_server_tpu.protocol.types import (
+        Entity, Instruction, Message, Record, Replication, Vector3,
+    )
+
+    def obj(cls, o):
+        return cls(
+            uuid=uuid_mod.UUID(o["uuid"]),
+            position=Vector3(*o["position"]) if "position" in o else None,
+            world_name=o["world_name"],
+            data=o.get("data"),
+            flex=o.get("flex"),
+        )
+
+    return Message(
+        instruction=Instruction.from_wire(case.get("instruction", 0)),
+        parameter=case.get("parameter"),
+        sender_uuid=uuid_mod.UUID(case["sender_uuid"]),
+        world_name=case["world_name"],
+        replication=Replication.from_wire(case.get("replication", 0)),
+        records=[obj(Record, r) for r in case.get("records", [])],
+        entities=[obj(Entity, e) for e in case.get("entities", [])],
+        position=Vector3(*case["position"]) if "position" in case else None,
+        flex=case.get("flex"),
+    )
+
+
+def generate(out_dir: Path = FIXTURE_DIR) -> list[Path]:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for name, case in sorted(CASES.items()):
+        p = out_dir / f"{name}.bin"
+        p.write_bytes(build_reference_bytes(case))
+        written.append(p)
+    return written
+
+
+if __name__ == "__main__":
+    for p in generate():
+        print(f"{p.stat().st_size:6d}  {p}")
