@@ -68,7 +68,7 @@ def test_bench_config2_random_walk():
     assert rec["clients"] == 1000
     assert rec["resubs_per_tick"] > 0
     assert rec["iter_p50_ms"] <= rec["iter_p99_ms"]
-    assert rec["measurement"] == "pipelined-depth2-v2"
+    assert rec["measurement"] == "pipelined-depth2-v3"
     assert "warmup" in stderr
 
 

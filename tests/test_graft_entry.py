@@ -31,4 +31,6 @@ def test_entry_compiles_and_runs():
 
 
 def test_dryrun_multichip_8():
-    graft.dryrun_multichip(8)
+    # small per-device shape: same mesh/shard_map/GSPMD coverage as the
+    # driver's honest-shape run (128K/device) without its wall time
+    graft.dryrun_multichip(8, entities_per_device=64)

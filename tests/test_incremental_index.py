@@ -468,3 +468,35 @@ def test_world_level_views_survive_churn():
     assert b.cube_count(W) == cpu.cube_count(W)
     for p in peers:
         assert b.is_subscribed_any(W, p) == cpu.is_subscribed_any(W, p)
+
+
+def test_per_world_bulk_loads_fold_to_base():
+    """Consecutive per-world bulk calls (each under the single-call
+    fold limit) must still route to the base once the delta would
+    overrun — the 1M-sub bench pattern — and defer the device upload
+    to one flush."""
+    import numpy as np
+
+    b = TpuSpatialBackend(cube_size=16)
+    rng = np.random.default_rng(5)
+    n, n_worlds = 40_000, 8
+    cubes = rng.integers(-50, 50, (n, 3)).astype(np.int64) * 16
+    peers = [uuid.UUID(int=i + 1) for i in range(n)]
+    wids = np.arange(n) * n_worlds // n
+    for w in range(n_worlds):
+        sel = np.flatnonzero(wids == w)
+        b.bulk_add_subscriptions(
+            f"w{w}", [peers[i] for i in sel], cubes[sel]
+        )
+    stats = b.device_stats()
+    assert stats["delta_rows"] < n // 4, (
+        f"bulk loads left {stats['delta_rows']} rows in the delta log"
+    )
+    # upload was deferred: nothing on device until the flush
+    assert b._base_bundle is None and b._base_stale
+    b.flush()
+    assert b._base_bundle is not None and not b._base_stale
+    assert b.subscription_count() == n
+    # and the device answers: pick a subscriber's cube, expect company
+    got = b.query_cube("w0", tuple(cubes[0]))
+    assert peers[0] in got
