@@ -307,6 +307,19 @@ def bench_config5(args) -> dict:
 
     _parity_check(tpu, cpu, peers, batches[0])
 
+    # Queries-per-tick scaling sweep (device compute by chained slope,
+    # CPU reference at the SAME batch size). Workload model: each tick,
+    # M of the 1M subscribed entities broadcast a LocalMessage from
+    # their own position (20% from a fresh random point — miss
+    # traffic). M/subs is the per-tick speak fraction: 16K/tick at
+    # 20 t/s = every entity broadcasting every ~3s (MMO presence
+    # cadence); the 1M point is every entity broadcasting every tick —
+    # the literal 20M queries/s reading of the north star.
+    sweep = []
+    if not args.quick:
+        sweep = _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids,
+                               peers, args)
+
     return {
         "metric": "local_fanout_sustained_tick_ms",
         "value": round(sustained, 3),
@@ -320,12 +333,61 @@ def bench_config5(args) -> dict:
         "device_compute_ms": round(compute_ms, 4),
         "device_stage_ms": stages,
         "sustained_runs_ms": [round(s, 3) for s in sust_runs],
+        "queries_per_tick_sweep": sweep,
         "target_p99_ms": TARGET_P99_MS,
         "config": 5,
     }
 
 
-def _device_probes(tpu, batch, csr_cap: int):
+def _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids, peers,
+                   args) -> list[dict]:
+    """Device + CPU cost vs queries-per-tick batch size over the same
+    1M-subscription index. Device numbers are chained-slope (link
+    cancelled); CPU is the reference backend resolving the identical
+    batch."""
+    from worldql_server_tpu.spatial.backend import LocalQuery
+    from worldql_server_tpu.protocol.types import Replication, Vector3
+
+    out = []
+    for m in (16_384, 65_536, 262_144, 1_048_576):
+        batch = make_query_batch(rng, sub_positions, sub_world_ids, m)
+        # size the CSR buffer off the measured fan-out at this batch
+        warm = _force(tpu.match_arrays_async(*batch, csr_cap=m * 4)[1])
+        csr_cap = max(2048, int(warm * 1.5))
+        _, dev_ms, _ = _device_probes(
+            tpu, batch, csr_cap, stages=False,
+            reps_pair=(2, 8) if m >= 262_144 else (4, 32),
+        )
+
+        world_ids, positions, sender_ids, repls = batch
+        cpu_n = min(m, 65_536)  # CPU cost is linear; sample and scale
+        queries = [
+            LocalQuery(
+                f"world_{world_ids[i]}", Vector3(*positions[i]),
+                peers[sender_ids[i]], Replication.EXCEPT_SELF,
+            )
+            for i in range(cpu_n)
+        ]
+        t0 = time.perf_counter()
+        cpu.match_local_batch(queries)
+        cpu_ms = (time.perf_counter() - t0) * 1e3 * (m / cpu_n)
+        rec = {
+            "queries": m,
+            "speak_fraction": round(m / args.subs, 4),
+            "device_compute_ms": round(dev_ms, 3),
+            "device_queries_per_s": round(m / (dev_ms / 1e3)),
+            "cpu_ms": round(cpu_ms, 1),
+            "vs_cpu": round(cpu_ms / dev_ms, 1),
+        }
+        out.append(rec)
+        log(f"sweep m={m}: device {dev_ms:.2f} ms "
+            f"({rec['device_queries_per_s']:,}/s)  cpu {cpu_ms:.0f} ms  "
+            f"({rec['vs_cpu']}x)")
+    return out
+
+
+def _device_probes(tpu, batch, csr_cap: int, *, stages: bool = True,
+                   reps_pair: tuple = (4, 32)):
     """(link round-trip ms, device compute ms/tick, per-stage ms dict).
 
     The rtt probe is a 4-byte H2D+D2H. The compute probes chain R
@@ -419,17 +481,19 @@ def _device_probes(tpu, batch, csr_cap: int):
         return chained
 
     def slope_ms(chained) -> float:
-        return chained_slope_ms(chained, (queries, flat_segs), (4, 32))
+        return chained_slope_ms(chained, (queries, flat_segs), reps_pair)
 
-    bounds_ms = slope_ms(make_chained("bounds"))
-    tier1_ms = slope_ms(make_chained("tier1"))
     full_ms = slope_ms(make_chained("full"))
-    stages = {
-        "run_bounds_ms": round(bounds_ms, 4),
-        "tier1_gather_ms": round(max(tier1_ms - bounds_ms, 0.0), 4),
-        "tier2_csr_ms": round(max(full_ms - tier1_ms, 0.0), 4),
-    }
-    return pctl(rtts, 50), full_ms, stages
+    stage_ms = {}
+    if stages:
+        bounds_ms = slope_ms(make_chained("bounds"))
+        tier1_ms = slope_ms(make_chained("tier1"))
+        stage_ms = {
+            "run_bounds_ms": round(bounds_ms, 4),
+            "tier1_gather_ms": round(max(tier1_ms - bounds_ms, 0.0), 4),
+            "tier2_csr_ms": round(max(full_ms - tier1_ms, 0.0), 4),
+        }
+    return pctl(rtts, 50), full_ms, stage_ms
 
 
 def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
@@ -644,15 +708,23 @@ def bench_config2(args) -> dict:
         total = _force(handle)
         assert total <= next_pow2(csr_cap), "csr_cap overflow"
 
-    # Warmup: churn until the index has been through a full compaction
-    # cycle, so every delta-buffer shape tier the steady state touches
-    # is compiled before measurement.
-    warm = 0
-    while warm < 40 and (backend.compactions < 2 or warm < 3):
+    # Warmup: churn until the index has been through full compaction
+    # cycles AND the shape-tier set has stabilized — a tier first seen
+    # inside the measured loop would charge a 10s+ XLA compile to one
+    # tick (observed as a 7s p99 outlier with a count-based warmup).
+    warm, stable, seen = 0, 0, set()
+    while warm < 80 and (backend.compactions < 2 or stable < 10):
         collect(churn_tick()[1])
         warm += 1
+        tier = (backend._delta_buf_cap, backend._delta_k, backend._base_k)
+        if tier in seen:
+            stable += 1
+        else:
+            seen.add(tier)
+            stable = 0
     backend.wait_compaction()
-    log(f"warmup: {warm} churn ticks, {backend.compactions} compactions")
+    log(f"warmup: {warm} churn ticks, {backend.compactions} compactions, "
+        f"{len(seen)} shape tiers")
 
     # Double-buffered like the server's tick batcher: tick t's fan-out
     # is collected after tick t+1 dispatches, overlapping the device
@@ -680,8 +752,9 @@ def bench_config2(args) -> dict:
     dispatch_ms = phase["dispatch"] / nt * 1e3
 
     # device-side attribution, net of the link: chained-slope the delta
-    # sort at the steady-state shape (the only device work flush does)
-    sort_ms = _churn_sort_slope_ms(backend)
+    # sort at the steady-state shape (the only device work flush does).
+    # Clamped at 0: a sub-0.1ms sort can drown in link-jitter noise.
+    sort_ms = max(_churn_sort_slope_ms(backend), 0.0)
 
     log(f"random-walk: {n} clients, {churn_total / ticks:.0f} resubs/tick, "
         f"sustained {sustained:.2f} ms/tick  iter p50 {p50:.2f}  "

@@ -1,0 +1,111 @@
+"""f32 device-quantizer agreement envelope vs the golden host quantizer.
+
+The sim path quantizes on device in f32 (ops/tick.py
+``device_coord_clamp``); the authoritative broker path quantizes on
+host in f64 (spatial/quantize.py, cube_area.rs:23-44 semantics). This
+file PINS where the two agree exactly, so "trust the sim path inside
+the envelope" is a tested claim, not a docstring hope:
+
+* power-of-two cube sizes: every f32 step (divide, ceil, multiply,
+  mod) is an exponent shift and therefore exact — agreement holds for
+  ALL normal finite inputs up to int64-saturation territory (|x| <=
+  2^62 tested);
+* non-power-of-two sizes: the f32 quotient x/size carries <= 0.5 ulp
+  error, so once |x|/size approaches the 24-bit mantissa limit the
+  ceil lands on the wrong integer for a large fraction of inputs. The
+  tested safe envelope is |x| <= size * 2^21 (quotient error <= 2^-3
+  of a grid step, sampled densely incl. boundary-adjacent values);
+  the test also asserts divergence REALLY happens past size * 2^26,
+  so the documented bound is load-bearing, not vacuous;
+* f32 subnormals (|x| < 2^-126) diverge (the device quotient flushes)
+  and are excluded from the envelope — no game transmits positions
+  there.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.spatial import jaxconf  # noqa: F401
+import jax.numpy as jnp
+
+from worldql_server_tpu.ops.tick import device_coord_clamp
+from worldql_server_tpu.spatial.quantize import coord_clamp
+
+
+def _host(xs: np.ndarray, size: int) -> np.ndarray:
+    return np.array([coord_clamp(float(x), size) for x in xs])
+
+
+def _device(xs: np.ndarray, size: int) -> np.ndarray:
+    return np.asarray(device_coord_clamp(jnp.asarray(xs), size))
+
+
+def _samples(rng, mag: float, n: int = 8_000) -> np.ndarray:
+    """Uniform draws at one magnitude plus boundary-adversarial values:
+    (approximate) grid multiples and their one-ulp neighbours."""
+    xs = (rng.uniform(-1, 1, n) * mag).astype(np.float32)
+    return xs
+
+
+def _with_boundaries(xs: np.ndarray, size: int) -> np.ndarray:
+    mult = (np.round(xs.astype(np.float64) / size) * size).astype(np.float32)
+    return np.concatenate([
+        xs, mult, np.nextafter(mult, np.float32(np.inf)),
+        np.nextafter(mult, np.float32(-np.inf)),
+    ])
+
+
+@pytest.mark.parametrize("size", [8, 16, 64])
+def test_pow2_sizes_exact_to_int64_range(size):
+    """Power-of-two sizes: exact agreement for every sampled normal
+    finite f32 from 2^-120 up to 2^62."""
+    rng = np.random.default_rng(20_000 + size)
+    for p in (-120, -60, -3, 3, 10, 20, 24, 25, 31, 40, 55, 62):
+        xs = _with_boundaries(_samples(rng, 2.0 ** p), size)
+        xs = xs[np.abs(xs) >= np.finfo(np.float32).tiny]
+        np.testing.assert_array_equal(
+            _device(xs, size), _host(xs, size),
+            err_msg=f"size={size} magnitude=2^{p}",
+        )
+
+
+@pytest.mark.parametrize("size", [10, 12, 48])
+def test_non_pow2_sizes_exact_inside_envelope(size):
+    """Non-power-of-two sizes: exact agreement for |x| <= size * 2^21
+    (quotient error well under a grid step), sampled across magnitudes
+    including grid-boundary +/- 1 ulp."""
+    rng = np.random.default_rng(30_000 + size)
+    bound = size * 2.0 ** 21
+    for frac in (1e-6, 1e-3, 0.03, 0.3, 1.0):
+        xs = _with_boundaries(_samples(rng, bound * frac), size)
+        xs = np.clip(xs, -bound, bound)
+        xs = xs[np.abs(xs) >= np.finfo(np.float32).tiny]
+        np.testing.assert_array_equal(
+            _device(xs, size), _host(xs, size),
+            err_msg=f"size={size} magnitude={bound * frac:g}",
+        )
+
+
+@pytest.mark.parametrize("size", [10, 12, 48])
+def test_non_pow2_divergence_outside_envelope_is_real(size):
+    """Past size * 2^26 the f32 quotient loses sub-integer resolution:
+    a substantial fraction of inputs MUST disagree — proving the
+    documented envelope bound reflects a real cliff (if this ever
+    starts passing exactly, the device path changed and the envelope
+    should be re-derived)."""
+    rng = np.random.default_rng(40_000 + size)
+    xs = _samples(rng, size * 2.0 ** 27, n=20_000)
+    diverged = (_device(xs, size) != _host(xs, size)).mean()
+    assert diverged > 0.01, (
+        f"expected real divergence beyond the envelope, got {diverged:.2%}"
+    )
+
+
+def test_specials_match_host_totality():
+    """NaN -> +size, +/-inf saturate, +/-0.0 -> +size: the device path
+    must mirror the host's Rust-style total quantizer on specials."""
+    xs = np.array([np.nan, np.inf, -np.inf, 0.0, -0.0], np.float32)
+    for size in (10, 16):
+        np.testing.assert_array_equal(_device(xs, size), _host(xs, size))

@@ -17,6 +17,7 @@ import pytest
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.protocol.types import Record, Vector3
 from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+from worldql_server_tpu.storage.postgres_store import PostgresRecordStore
 from worldql_server_tpu.storage.sqlite_store import SqliteRecordStore
 from worldql_server_tpu.storage.store import open_store
 
@@ -340,5 +341,8 @@ def test_open_store_dispatch(tmp_path):
     )
     with pytest.raises(ValueError):
         open_store("bogus://", config)
-    with pytest.raises(ImportError):
-        open_store("postgres://u@h/db", config)  # no driver in this image
+    # postgres:// always constructs: external drivers when installed,
+    # the built-in pure-Python wire driver (storage/pgwire.py) otherwise
+    pg = open_store("postgres://u@h/db", config)
+    assert isinstance(pg, PostgresRecordStore)
+    assert pg._driver_name in ("asyncpg", "psycopg", "pgwire")

@@ -22,8 +22,15 @@ round-trips.
 
 Quantization note: this sim path quantizes in f32 on device
 (``device_coord_clamp``), semantically mirroring the golden host
-quantizer (spatial/quantize.py, cube_area.rs:23-44) but not bit-exact
-for coordinates beyond f32 resolution. The authoritative broker path
+quantizer (spatial/quantize.py, cube_area.rs:23-44). The agreement
+envelope is PINNED by tests/test_quantizer_envelope.py: exact for all
+normal finite inputs when the cube size is a power of two (every f32
+step is an exponent shift; tested to |x| <= 2^62), and exact for
+|x| <= size * 2^21 for non-power-of-two sizes (the f32 quotient loses
+sub-integer resolution near |x|/size ~ 2^24 and diverges heavily past
+size * 2^26); f32 subnormals (|x| < 2^-126) are outside the envelope.
+Specials match the host exactly (NaN → +size, ±inf → ±i64::MAX,
+saturating arithmetic). The authoritative broker path
 (spatial/tpu_backend.py) always quantizes host-side in f64; this module
 serves the embedded-simulation / benchmark workloads where positions
 are device-resident. Hash collisions between distinct cubes merge
@@ -58,16 +65,21 @@ def device_coord_clamp(x: jax.Array, size: int) -> jax.Array:
     golden host quantizer (cube_area.rs:23-44).
     """
     size_f = jnp.float32(size)
+    i64_max = jnp.int64(2**63 - 1)
     a = jnp.abs(x)
     mult = jnp.where(x < 0, -1, 1).astype(jnp.int64)
     rounded = jnp.ceil(a / size_f) * size_f
     rounded = jnp.where(a == 0.0, size_f, rounded)
     exact = (jnp.mod(a, size_f) == 0.0) & (x != 0.0)
     ri = rounded.astype(jnp.int64)
-    res = jnp.where(rounded > a, ri, ri + size)
+    # saturating +size like the host (_sat_add): past the int64 cast's
+    # saturation point a plain add wraps negative
+    bumped = jnp.where(ri > i64_max - size, i64_max, ri + size)
+    res = jnp.where(rounded > a, ri, bumped)
     res = jnp.where(exact, a.astype(jnp.int64), res)
-    # NaN → +size like the host quantizer (XLA's NaN→int cast is
-    # platform-defined, so guard explicitly).
+    # NaN → +size and ±inf → ±i64::MAX like the host quantizer (XLA's
+    # NaN/inf→int casts are platform-defined, so guard explicitly).
+    res = jnp.where(jnp.isinf(x), i64_max, res)
     return jnp.where(jnp.isnan(x), jnp.int64(size), res * mult)
 
 

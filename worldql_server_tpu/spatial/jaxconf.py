@@ -24,14 +24,31 @@ jax.config.update("jax_enable_x64", True)
 # bulk load spent ~90s compiling vs ~0.5s executing). The cache cuts
 # every process after the first to sub-second loads of the serialized
 # executables (measured 30.5s -> 3.6s on v5e through the axon tunnel).
-# Default lives next to the package so benches, tests and servers run
-# from a checkout share it; override with WQL_JAX_CACHE_DIR, disable
-# with WQL_JAX_CACHE_DIR="".
-_cache_dir = os.environ.get(
-    "WQL_JAX_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
-)
+# Default: next to the package (a checkout's benches/tests/servers
+# share it) when that directory is writable — site-packages installs
+# usually are not, so fall back to the user cache dir rather than
+# silently losing the cache (and spamming write warnings) in exactly
+# the deployed case. Override with WQL_JAX_CACHE_DIR, disable with
+# WQL_JAX_CACHE_DIR="".
+
+
+def _default_cache_dir() -> str:
+    repo_adjacent = os.path.join(
+        os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    if os.access(os.path.dirname(repo_adjacent), os.W_OK):
+        return repo_adjacent
+    return os.path.join(
+        os.environ.get(
+            "XDG_CACHE_HOME", os.path.expanduser("~/.cache")
+        ),
+        "worldql_server_tpu", "jax_cache",
+    )
+
+
+_cache_dir = os.environ.get("WQL_JAX_CACHE_DIR", _default_cache_dir())
 if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

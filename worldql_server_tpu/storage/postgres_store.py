@@ -7,9 +7,11 @@ cell with a btree index on region_id (query_constants.rs:84-121),
 ids (query_constants.rs:2-38), lazy DDL on UNDEFINED_TABLE with retry
 (client.rs:178-225), and idempotent ``init_database`` (init.rs:10-26).
 
-Requires ``asyncpg`` or ``psycopg`` — neither ships in this image, so
-construction raises a clear error until one is installed; the logic is
-kept driver-thin behind ``_exec``/``_fetch`` so either driver slots in.
+Drivers: prefers ``asyncpg`` then ``psycopg`` when installed (binary
+protocol); otherwise falls back to the built-in pure-Python v3 wire
+client (``storage/pgwire.py``) so ``postgres://`` works with zero
+dependencies. The logic is kept driver-thin behind ``_exec``/``_fetch``
+so all three slot in identically.
 """
 
 from __future__ import annotations
@@ -39,10 +41,12 @@ def _load_driver():
         return "psycopg", psycopg
     except ImportError:
         pass
-    raise ImportError(
-        "postgres:// store requires asyncpg or psycopg; neither is "
-        "installed — use sqlite:// or memory:// instead"
-    )
+    # Built-in pure-Python v3 wire driver (storage/pgwire.py): always
+    # available, asyncpg-shaped surface, text protocol. The external
+    # drivers stay preferred for their binary-protocol performance.
+    from . import pgwire
+
+    return "pgwire", pgwire
 
 
 _NAV_DDL = (
@@ -88,7 +92,7 @@ class PostgresRecordStore(RecordStore):
     # region: lifecycle
 
     async def init(self) -> None:
-        if self._driver_name == "asyncpg":
+        if self._driver_name in ("asyncpg", "pgwire"):
             self._conn = await self._driver.connect(self._url)
         else:  # psycopg (async API)
             self._conn = await self._driver.AsyncConnection.connect(
@@ -107,14 +111,14 @@ class PostgresRecordStore(RecordStore):
     # region: driver shims
 
     async def _exec(self, sql: str, *params) -> str:
-        if self._driver_name == "asyncpg":
+        if self._driver_name in ("asyncpg", "pgwire"):
             return await self._conn.execute(sql, *params)
         async with self._conn.cursor() as cur:
             await cur.execute(_psycopg_placeholders(sql), params)
             return str(cur.rowcount)
 
     async def _fetch(self, sql: str, *params) -> list:
-        if self._driver_name == "asyncpg":
+        if self._driver_name in ("asyncpg", "pgwire"):
             return await self._conn.fetch(sql, *params)
         async with self._conn.cursor() as cur:
             await cur.execute(_psycopg_placeholders(sql), params)
