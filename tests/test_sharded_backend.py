@@ -218,3 +218,121 @@ def test_dist_env_with_wrong_backend_is_a_config_error(monkeypatch):
         config.validate()
     config.spatial_backend = "sharded"
     config.validate()  # sharded accepts it
+
+
+def test_sharded_compaction_folds_on_device_without_reupload():
+    """Steady-state compaction must fold per-shard on device with no
+    full-base re-upload: H2D is O(boundary keys), not O(index). A full
+    `_upload_base` during compaction is only legitimate for the very
+    first base install or a shard-imbalance re-shard."""
+    _require_devices(8)
+    mesh = make_fanout_mesh(2, 4)
+    rng = random.Random(13)
+    cpu = CpuSpatialBackend(16)
+    b = ShardedTpuSpatialBackend(16, mesh, compact_threshold=64)
+    peers = [uuid.uuid4() for _ in range(64)]
+
+    uploads = []
+    real_upload = b._upload_base
+
+    def counting_upload(*a, **kw):
+        uploads.append(len(a[0]))
+        return real_upload(*a, **kw)
+
+    b._upload_base = counting_upload
+
+    def rand_pos():
+        return Vector3(
+            rng.uniform(-300, 300), rng.uniform(-300, 300),
+            rng.uniform(-300, 300),
+        )
+
+    # initial load → first base install may upload
+    for _ in range(150):
+        w = f"w{rng.randrange(3)}"
+        p, pos = rng.choice(peers), rand_pos()
+        cpu.add_subscription(w, p, pos)
+        b.add_subscription(w, p, pos)
+    b.flush()
+    b.wait_compaction()
+    baseline_uploads = len(uploads)
+
+    # steady churn: every subsequent compaction must fold on device
+    for _ in range(3):
+        for _ in range(120):
+            w = f"w{rng.randrange(3)}"
+            p, pos = rng.choice(peers), rand_pos()
+            cpu.add_subscription(w, p, pos)
+            b.add_subscription(w, p, pos)
+            if rng.random() < 0.3:
+                w2, p2, pos2 = (f"w{rng.randrange(3)}",
+                                rng.choice(peers), rand_pos())
+                cpu.remove_subscription(w2, p2, pos2)
+                b.remove_subscription(w2, p2, pos2)
+        b.flush()
+        b.wait_compaction()
+
+    assert b.compactions >= 2, b.device_stats()
+    assert b.compaction_failures == 0
+    assert len(uploads) == baseline_uploads, (
+        f"compaction re-uploaded the base: {uploads[baseline_uploads:]}"
+    )
+
+    # and the folded index still answers exactly like the oracle
+    queries = [
+        LocalQuery(f"w{rng.randrange(3)}", rand_pos(), rng.choice(peers))
+        for _ in range(128)
+    ]
+    for c, t in zip(cpu.match_local_batch(queries),
+                    b.match_local_batch(queries)):
+        assert set(c) == set(t)
+
+
+def test_sharded_reshard_on_imbalance_falls_back():
+    """When the key-range boundaries drift past the imbalance bound
+    (forced here via a tiny RESHARD_IMBALANCE), compaction must fall
+    back to a full re-shard upload — and stay correct."""
+    _require_devices(8)
+    mesh = make_fanout_mesh(2, 4)
+    rng = random.Random(17)
+    cpu = CpuSpatialBackend(16)
+    b = ShardedTpuSpatialBackend(16, mesh, compact_threshold=32)
+    b.RESHARD_IMBALANCE = -1.0  # every compaction takes the fallback
+    peers = [uuid.uuid4() for _ in range(32)]
+
+    uploads = []
+    real_upload = b._upload_base
+
+    def counting_upload(*a, **kw):
+        uploads.append(len(a[0]))
+        return real_upload(*a, **kw)
+
+    b._upload_base = counting_upload
+
+    def rand_pos():
+        return Vector3(
+            rng.uniform(-200, 200), rng.uniform(-200, 200),
+            rng.uniform(-200, 200),
+        )
+
+    for _ in range(3):
+        for _ in range(100):
+            w = f"w{rng.randrange(2)}"
+            p, pos = rng.choice(peers), rand_pos()
+            cpu.add_subscription(w, p, pos)
+            b.add_subscription(w, p, pos)
+        b.flush()
+        b.wait_compaction()
+    assert b.compactions >= 1
+    assert b.compaction_failures == 0
+    # the forced-imbalance bound must actually route compactions to the
+    # re-shard upload (one initial install + >= 1 compaction fallback)
+    assert len(uploads) >= 2, uploads
+
+    queries = [
+        LocalQuery(f"w{rng.randrange(2)}", rand_pos(), rng.choice(peers))
+        for _ in range(64)
+    ]
+    for c, t in zip(cpu.match_local_batch(queries),
+                    b.match_local_batch(queries)):
+        assert set(c) == set(t)

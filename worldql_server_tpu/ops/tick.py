@@ -77,8 +77,12 @@ def device_coord_clamp(x: jax.Array, size: int) -> jax.Array:
     bumped = jnp.where(ri > i64_max - size, i64_max, ri + size)
     res = jnp.where(rounded > a, ri, bumped)
     res = jnp.where(exact, a.astype(jnp.int64), res)
-    # NaN → +size and ±inf → ±i64::MAX like the host quantizer (XLA's
-    # NaN/inf→int casts are platform-defined, so guard explicitly).
+    # NaN → +size, ±inf → ±i64::MAX, and finite |x| >= 2^63 →
+    # ±i64::MAX like the host quantizer's Rust-style saturating casts
+    # (XLA's out-of-range float→int casts are platform-defined, so
+    # every saturation case is guarded explicitly; f32(2^63) is exactly
+    # representable).
+    res = jnp.where(a >= jnp.float32(2.0**63), i64_max, res)
     res = jnp.where(jnp.isinf(x), i64_max, res)
     return jnp.where(jnp.isnan(x), jnp.int64(size), res * mult)
 

@@ -52,6 +52,7 @@ from ..spatial.tpu_backend import (
     match_core,
     probe_buckets_for,
     probe_tables,
+    run_remainders,
     run_remainders_np,
     two_tier_first_pass,
     two_tier_second_pass,
@@ -179,19 +180,137 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             )
         return kernel(sk_stack, rem_stack)
 
+    #: re-shard (full re-upload) only when the largest shard exceeds
+    #: this multiple of the mean — keys are uniform hashes, so the old
+    #: key-range boundaries stay balanced as the index grows and the
+    #: bound ~never trips in practice
+    RESHARD_IMBALANCE = 2.0
+
     def _compact_device(
         self, snap: dict, cap2: int, host_arrays, k, n_buckets: int
     ) -> dict:
-        """Mesh-aware compaction: the resident base is a [n_space, cap]
-        per-shard stack while the delta is flat, and the folded index
-        needs fresh run-boundary split points — which only the host
-        mirror (already folded by ``_compact_work``'s identical stable
-        transform) knows. So re-shard from the host: ``_upload_base``
-        recomputes the splits and lays out new space-sharded stacks.
-        Runs on the compaction worker thread, so the upload never
-        touches the owning event loop."""
+        """Mesh-aware compaction in O(churn) transfer: every shard owns
+        a contiguous KEY range (the old split boundaries), the delta is
+        already device-resident and replicated, so each shard folds
+        (its base rows ∪ the delta rows hashing into its range) locally
+        — one vmapped on-device sort per shard, H2D limited to the
+        [n_space] boundary-key vector. The old global sort restricted
+        to a key range IS the new shard content, so the host mirror
+        (folded by ``_compact_work``'s identical stable transform) and
+        the device stacks stay row-aligned via the re-derived splits.
+
+        Falls back to a full host re-upload (fresh balanced splits)
+        when there is no resident base/boundary state yet or when
+        shard sizes drift past RESHARD_IMBALANCE — deferred
+        re-sharding: uniform key hashes keep old boundaries balanced,
+        so the common compaction ships ~nothing over the link. Runs on
+        the compaction worker thread, so neither path touches the
+        owning event loop."""
         hk, hk2, hp = host_arrays
-        return self._upload_base(hk, hk2, hp, k)
+        base = snap.get("base_bundle")
+        if (
+            base is None
+            or base.get("splits") is None
+            or self.n_space == 1
+            or snap.get("delta_buf") is None
+        ):
+            return self._upload_base(hk, hk2, hp, k)
+
+        old_splits = base["splits"]
+        old_bk = snap["bk"]
+        # boundary KEYS from the old row splits (first key of each
+        # shard after the first); the new fold re-derives row splits
+        # from the same keys, so runs still never straddle shards
+        bounds = np.empty(self.n_space + 1, np.int64)
+        bounds[0] = np.iinfo(np.int64).min
+        bounds[-1] = np.int64(PAD_KEY)  # live keys are always < PAD_KEY
+        for s in range(1, self.n_space):
+            row = int(old_splits[s])
+            bounds[s] = old_bk[row] if row < old_bk.size else PAD_KEY
+
+        new_splits = np.empty(self.n_space + 1, np.int64)
+        new_splits[0] = 0
+        new_splits[-1] = hk.size
+        new_splits[1:-1] = np.searchsorted(
+            hk, bounds[1:-1], side="left"
+        )
+        # live rows per shard: dead/pad rows were rewritten to PAD_KEY
+        # before the host sort, so the live prefix ends at the first
+        # PAD row and the pad tail must not skew balance accounting
+        live_n = int(np.searchsorted(hk, PAD_KEY, side="left"))
+        edges = np.minimum(new_splits, live_n)
+        counts = np.diff(edges)
+        mean = max(live_n / self.n_space, 1.0)
+        if counts.max() > self.RESHARD_IMBALANCE * mean + 8:
+            return self._upload_base(hk, hk2, hp, k)
+
+        cap_shard = next_pow2(int(counts.max()))
+        # probe tables sized for the busiest SHARD's cube count, not
+        # the global one (global would allocate S× the needed rows)
+        n_cubes = 1
+        for s in range(self.n_space):
+            a, b = int(edges[s]), int(edges[s + 1])
+            if b > a:
+                n_cubes = max(n_cubes, n_distinct(hk[a:b]))
+        n_buckets = probe_buckets_for(n_cubes)
+        bk, bk2, bp = base["dev"][:3]
+        if cap_shard > bk.shape[1] + snap["delta_buf"][0].shape[0]:
+            # cannot happen (new rows <= old rows + delta rows), but a
+            # silent wrong-shape fold would corrupt the index — guard
+            return self._upload_base(hk, hk2, hp, k)
+        dev = self._fold_shards(
+            bk, bk2, bp, snap["delta_buf"],
+            jnp.asarray(bounds[:-1]), jnp.asarray(bounds[1:]),
+            cap_shard, n_buckets,
+        )
+        return {
+            "dev": dev,
+            "cap": self.n_space * cap_shard,
+            "splits": new_splits,
+            "shard_cap": cap_shard,
+        }
+
+    def _fold_shards(self, bk, bk2, bp, delta, lo, hi, cap2: int,
+                     n_buckets: int):
+        """vmapped per-shard fold: local base rows + the delta rows in
+        [lo, hi) → fresh sorted shard with run-remainders and probe
+        tables. Tombstones and out-of-range delta rows sink past the
+        live rows as PAD_KEY (their peers are <0 or their keys padded,
+        so no consumer can see them)."""
+        key = ("fold_shards", cap2, n_buckets, bk.shape, delta[0].shape)
+        kernel = self._kernels.get(key)
+        if kernel is None:
+            def fold_one(bk, bk2, bp, lo, hi, dk, dk2, dp):
+                in_range = (dk >= lo) & (dk < hi) & (dp >= 0)
+                dkm = jnp.where(in_range, dk, PAD_KEY)
+                dpm = jnp.where(in_range, dp, -1)
+                keys = jnp.concatenate(
+                    [jnp.where(bp < 0, PAD_KEY, bk), dkm]
+                )
+                keys2 = jnp.concatenate([bk2, dk2])
+                peers = jnp.concatenate([bp, dpm])
+                order = jnp.argsort(keys, stable=True)[:cap2]
+                sk = keys[order]
+                rem = run_remainders(sk)
+                tk, tp, oflow = probe_tables(
+                    sk, rem, n_buckets=n_buckets
+                )
+                return (sk, keys2[order], peers[order], rem, tk, tp,
+                        oflow)
+
+            sub = self._sharding("space", None)
+            vec = self._sharding("space")
+            rep = self._sharding(None)
+            tbl = self._sharding("space", None, None)
+            kernel = self._kernels[key] = jax.jit(
+                jax.vmap(
+                    fold_one,
+                    in_axes=(0, 0, 0, 0, 0, None, None, None),
+                ),
+                in_shardings=(sub, sub, sub, vec, vec, rep, rep, rep),
+                out_shardings=(sub, sub, sub, sub, tbl, tbl, vec),
+            )
+        return kernel(bk, bk2, bp, lo, hi, *delta)
 
     # -- delta seams: the delta segment is replicated across the mesh,
     # so allocate/write/sort with explicit replicated out_shardings —

@@ -38,7 +38,11 @@ def _default_cache_dir() -> str:
             os.path.dirname(os.path.abspath(__file__)))),
         ".jax_cache",
     )
-    if os.access(os.path.dirname(repo_adjacent), os.W_OK):
+    # probe the directory itself when it exists (it may belong to
+    # another uid), its parent otherwise
+    probe = repo_adjacent if os.path.isdir(repo_adjacent) \
+        else os.path.dirname(repo_adjacent)
+    if os.access(probe, os.W_OK):
         return repo_adjacent
     return os.path.join(
         os.environ.get(

@@ -250,24 +250,32 @@ class PgWireConnection:
         sslmode = q.get("sslmode", ["prefer"])[0]
 
         reader, writer = await asyncio.open_connection(host, port)
-        if sslmode in ("require", "verify-ca", "verify-full"):
-            writer.write(struct.pack(">ii", 8, SSL_REQUEST))
-            await writer.drain()
-            answer = await reader.readexactly(1)
-            if answer != b"S":
-                writer.close()
-                raise PgWireError(
-                    {"C": "08001", "M": "server refused TLS"}
-                )
-            ctx = ssl_mod.create_default_context()
-            if sslmode == "require":  # parity with libpq: no CA check
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl_mod.CERT_NONE
-            await writer.start_tls(ctx, server_hostname=host)
+        try:
+            if sslmode in ("require", "verify-ca", "verify-full"):
+                writer.write(struct.pack(">ii", 8, SSL_REQUEST))
+                await writer.drain()
+                answer = await reader.readexactly(1)
+                if answer != b"S":
+                    raise PgWireError(
+                        {"C": "08001", "M": "server refused TLS"}
+                    )
+                ctx = ssl_mod.create_default_context()
+                if sslmode == "require":  # libpq parity: no cert check
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl_mod.CERT_NONE
+                elif sslmode == "verify-ca":  # CA yes, hostname no
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl_mod.CERT_REQUIRED
+                await writer.start_tls(ctx, server_hostname=host)
 
-        conn = cls(reader, writer, {"user": user, "database": database})
-        await conn._startup(user, password, database)
-        return conn
+            conn = cls(reader, writer, {"user": user, "database": database})
+            await conn._startup(user, password, database)
+            return conn
+        except BaseException:
+            # a failed startup/auth must not leak the socket (stores
+            # retry connects in a loop — one fd per attempt adds up)
+            writer.close()
+            raise
 
     async def _startup(self, user: str, password: str, database: str) -> None:
         body = b""
