@@ -356,7 +356,7 @@ def _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids, peers,
         csr_cap = max(2048, int(warm * 1.5))
         _, dev_ms, _ = _device_probes(
             tpu, batch, csr_cap, stages=False,
-            reps_pair=(2, 8) if m >= 262_144 else (4, 32),
+            reps_pair=(2, 8) if m >= 262_144 else (8, 64),
         )
 
         world_ids, positions, sender_ids, repls = batch
@@ -387,7 +387,7 @@ def _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids, peers,
 
 
 def _device_probes(tpu, batch, csr_cap: int, *, stages: bool = True,
-                   reps_pair: tuple = (4, 32)):
+                   reps_pair: tuple = (8, 64)):
     """(link round-trip ms, device compute ms/tick, per-stage ms dict).
 
     The rtt probe is a 4-byte H2D+D2H. The compute probes chain R

@@ -77,12 +77,14 @@ def device_coord_clamp(x: jax.Array, size: int) -> jax.Array:
     bumped = jnp.where(ri > i64_max - size, i64_max, ri + size)
     res = jnp.where(rounded > a, ri, bumped)
     res = jnp.where(exact, a.astype(jnp.int64), res)
-    # NaN → +size, ±inf → ±i64::MAX, and finite |x| >= 2^63 →
+    # NaN → +size, ±inf → ±i64::MAX, and saturation-zone finites →
     # ±i64::MAX like the host quantizer's Rust-style saturating casts
     # (XLA's out-of-range float→int casts are platform-defined, so
-    # every saturation case is guarded explicitly; f32(2^63) is exactly
-    # representable).
-    res = jnp.where(a >= jnp.float32(2.0**63), i64_max, res)
+    # every cast is guarded explicitly). The guard tests ROUNDED — the
+    # actual cast input — not `a`: f32 round-up can push `rounded` to
+    # exactly 2^63 while `a` is still below it, and rounded >= a always
+    # holds, so this also covers the exact-branch cast of `a`.
+    res = jnp.where(rounded >= jnp.float32(2.0**63), i64_max, res)
     res = jnp.where(jnp.isinf(x), i64_max, res)
     return jnp.where(jnp.isnan(x), jnp.int64(size), res * mult)
 
