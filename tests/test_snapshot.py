@@ -321,13 +321,6 @@ def test_restore_rides_the_bulk_fold_path(tmp_path):
     upload — no delta residue, no compaction debt (the round-3 bench
     paid ~90 s of delta sorts + drains for a 1M restore; the fold path
     measured 1.6 s build + 3.9 s flush on v5e)."""
-    import numpy as np
-
-    from worldql_server_tpu.spatial.snapshot import (
-        load_snapshot, save_snapshot,
-    )
-    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
-
     rng = np.random.default_rng(23)
     src = TpuSpatialBackend(cube_size=16)
     n = 30_000

@@ -1251,20 +1251,25 @@ class TpuSpatialBackend(SpatialBackend):
         if (
             n > self.SYNC_COMPACT_FACTOR * threshold
             or self._delta_live + n >= self.SYNC_COMPACT_FACTOR * threshold
-            or (self._base_stale and 8 * n >= total_live)
+            or (
+                self._base_stale
+                and self._delta_live + n >= max(total_live // 32, 1024)
+            )
         ):
             # Fold straight into a new base when: the load is huge
             # (initial index build, snapshot restore); OR the delta
             # would overrun into sync-fallback territory anyway — e.g.
             # per-world bulk calls that are individually under the
             # limit but jointly a full rebuild; OR an upload is already
-            # owed (mid-load-phase) and this call is a real fraction of
-            # the index, so folding costs one more host sort but zero
-            # extra device traffic — the upload is DEFERRED to the next
-            # flush either way, so a whole load phase ships ONE base
-            # and ends fully compacted (no trailing delta segment
-            # slowing every subsequent query batch). No delta dict
-            # fills, one vectorized host sort.
+            # owed (mid-load-phase) and the pending rows are a real
+            # fraction (>= 1/32) of the index, so folding costs one more
+            # host sort but zero extra device traffic — the upload is
+            # DEFERRED to the next flush either way, so a whole load
+            # phase (even 64+ small per-world calls) ships ONE base and
+            # ends fully compacted: no trailing delta segment slowing
+            # every subsequent query batch, no delta-tier kernel
+            # compiles on the flush path. No delta dict fills, one
+            # vectorized host sort.
             self._rebuild_base_with(keys, wids, cubes, pids)
             return
         if self._dn + n > self._dcap:
@@ -1514,6 +1519,18 @@ class TpuSpatialBackend(SpatialBackend):
         including tombstones, so the pending scatter list is moot."""
         if not self._base_stale:
             return
+        if self._dn:
+            # a load phase is ending (stale base = no dispatch since
+            # the rebuilds) with a delta tail the fraction threshold
+            # didn't catch — live rows, or tombstone-only rows that
+            # would still cost a device sort: fold it in now, so the
+            # flush ships ONE fully-compacted base instead of also
+            # sorting/uploading a delta segment (and compiling its
+            # shape tier). The rebuild clears all delta state.
+            self._rebuild_base_with(
+                np.empty(0, np.int64), np.empty(0, np.int32),
+                np.empty((0, 3), np.int64), np.empty(0, np.int64),
+            )
         # flag cleared only AFTER the upload: a transient device/link
         # failure here must leave the flush retryable, not permanently
         # drop the base segment from device queries
@@ -1532,13 +1549,12 @@ class TpuSpatialBackend(SpatialBackend):
             np.empty((0, 3), np.int64), np.empty(0, np.int64),
         )
         self.compactions += 1
-        # the rebuild marked dirty; complete the flush for the new state
-        # (this runs INSIDE flush, after its own stale-upload step — the
-        # rebuilt base must reach the device before this flush returns)
+        # the rebuild marked dirty (and _clear_delta reset all delta
+        # state); complete the flush for the new state. This runs
+        # INSIDE flush, after its own stale-upload step — the rebuilt
+        # base must reach the device before this flush returns.
         self._upload_stale_base()
         self._dirty = False
-        self._delta_stale = False
-        self._delta_bundle = None
 
     def _start_compaction(self) -> None:
         """Fold base + device-resident delta into a fresh base on a
