@@ -245,13 +245,13 @@ def bench_config5(args) -> dict:
         jax.profiler.trace(args.profile) if args.profile
         else contextlib.nullcontext()
     )
-    # Best-of-2 sustained passes: the tunneled link's congestion swings
+    # Best-of-3 sustained passes: the tunneled link's congestion swings
     # a single pass several-fold while device compute stays flat — the
     # min is the code's number, the attribution probes below say how
     # much link remains even in it.
     sust_runs = []
     with profile_ctx:
-        for _ in range(2):
+        for _ in range(3):
             _, sustained, total_fanout, csr_cap = run_pipelined_adaptive(
                 tpu, batches, csr_cap, depth=8
             )
@@ -331,6 +331,9 @@ def bench_config5(args) -> dict:
         "p99_ms_depth2": round(pctl(lat2, 99), 3),
         "link_rtt_ms": round(rtt_ms, 3),
         "device_compute_ms": round(compute_ms, 4),
+        # the engine's own rate, net of the tunnel: what a deployment
+        # with locally-attached chips gets per chip
+        "device_queries_per_s": round(args.queries / (compute_ms / 1e3)),
         "device_stage_ms": stages,
         "sustained_runs_ms": [round(s, 3) for s in sust_runs],
         "queries_per_tick_sweep": sweep,
