@@ -332,8 +332,12 @@ def bench_config5(args) -> dict:
         "link_rtt_ms": round(rtt_ms, 3),
         "device_compute_ms": round(compute_ms, 4),
         # the engine's own rate, net of the tunnel: what a deployment
-        # with locally-attached chips gets per chip
-        "device_queries_per_s": round(args.queries / (compute_ms / 1e3)),
+        # with locally-attached chips gets per chip (null when the
+        # kernel is too small for the slope to resolve — quick mode)
+        "device_queries_per_s": (
+            round(args.queries / (compute_ms / 1e3))
+            if compute_ms >= MIN_RESOLVED_MS else None
+        ),
         "device_stage_ms": stages,
         "sustained_runs_ms": [round(s, 3) for s in sust_runs],
         "queries_per_tick_sweep": sweep,
@@ -374,17 +378,20 @@ def _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids, peers,
         t0 = time.perf_counter()
         cpu.match_local_batch(queries)
         cpu_ms = (time.perf_counter() - t0) * 1e3 * (m / cpu_n)
+        resolved = dev_ms >= MIN_RESOLVED_MS
         rec = {
             "queries": m,
             "speak_fraction": round(m / args.subs, 4),
             "device_compute_ms": round(dev_ms, 3),
-            "device_queries_per_s": round(m / (dev_ms / 1e3)),
+            "device_queries_per_s": (
+                round(m / (dev_ms / 1e3)) if resolved else None
+            ),
             "cpu_ms": round(cpu_ms, 1),
-            "vs_cpu": round(cpu_ms / dev_ms, 1),
+            "vs_cpu": round(cpu_ms / dev_ms, 1) if resolved else None,
         }
         out.append(rec)
         log(f"sweep m={m}: device {dev_ms:.2f} ms "
-            f"({rec['device_queries_per_s']:,}/s)  cpu {cpu_ms:.0f} ms  "
+            f"({rec['device_queries_per_s']}/s)  cpu {cpu_ms:.0f} ms  "
             f"({rec['vs_cpu']}x)")
     return out
 
@@ -486,17 +493,27 @@ def _device_probes(tpu, batch, csr_cap: int, *, stages: bool = True,
     def slope_ms(chained) -> float:
         return chained_slope_ms(chained, (queries, flat_segs), reps_pair)
 
-    full_ms = slope_ms(make_chained("full"))
+    # monotone clamp chain (0 <= bounds <= tier1 <= full): a sub-jitter
+    # kernel (tiny quick-mode shapes) can produce meaningless negative
+    # slopes, and the emitted stages must never sum past the total
+    # they attribute
+    full_ms = max(slope_ms(make_chained("full")), 0.0)
     stage_ms = {}
     if stages:
-        bounds_ms = slope_ms(make_chained("bounds"))
-        tier1_ms = slope_ms(make_chained("tier1"))
+        bounds_ms = max(slope_ms(make_chained("bounds")), 0.0)
+        tier1_ms = max(slope_ms(make_chained("tier1")), bounds_ms)
+        full_ms = max(full_ms, tier1_ms)
         stage_ms = {
             "run_bounds_ms": round(bounds_ms, 4),
-            "tier1_gather_ms": round(max(tier1_ms - bounds_ms, 0.0), 4),
-            "tier2_csr_ms": round(max(full_ms - tier1_ms, 0.0), 4),
+            "tier1_gather_ms": round(tier1_ms - bounds_ms, 4),
+            "tier2_csr_ms": round(full_ms - tier1_ms, 4),
         }
     return pctl(rtts, 50), full_ms, stage_ms
+
+
+#: slopes under this are link noise, not a resolved kernel time — rates
+#: derived from them would be absurd (a 16K-query tick is never 10 µs)
+MIN_RESOLVED_MS = 0.01
 
 
 def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
