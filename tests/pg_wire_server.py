@@ -4,8 +4,14 @@ Speaks enough of the frontend/backend v3 protocol to exercise
 `storage/pgwire.py` over a REAL TCP socket: startup (including the
 SSLRequest refusal dance), the full auth matrix (trust, cleartext,
 md5, SCRAM-SHA-256 with genuine RFC 5802 verification), the simple
-query cycle, typed text-format result rows, and ErrorResponse framing
-with SQLSTATE codes.
+query cycle, the EXTENDED query cycle (Parse/Bind/Describe/Execute/
+Close/Sync with named prepared statements, typed parameter decoding by
+the declared OIDs, and error-discards-until-Sync semantics), typed
+text-format result rows, and ErrorResponse framing with SQLSTATE
+codes. One fidelity shortcut: RowDescription is sent with the Execute
+results rather than at Describe-portal time (the engine is literal-SQL
+and only knows result shapes after running the statement); the client
+tolerates either ordering.
 
 The SQL "engine" behind it is a literal-SQL port of the fake asyncpg
 backend (test_postgres_store.py): it recognizes exactly the statement
@@ -28,7 +34,9 @@ import re
 import struct
 from datetime import datetime, timezone
 
-from worldql_server_tpu.storage.pgwire import _parse_timestamp
+from worldql_server_tpu.storage.pgwire import (
+    _parse_timestamp, bind_params, decode_text,
+)
 
 _OID = {"int4": 23, "float8": 701, "varchar": 1043, "bytea": 17,
         "timestamptz": 1184}
@@ -254,6 +262,7 @@ class WirePgServer:
         self.engine = MiniPgEngine()
         self.handler = handler or self.engine.run
         self.auth_attempts = 0
+        self.parse_count = 0
         self._server = None
         self._writers: set = set()
         self.port = None
@@ -329,6 +338,10 @@ class WirePgServer:
         writer.write(self._msg(b"Z", b"I"))
         await writer.drain()
 
+        prepared: dict[str, tuple[str, list[int]]] = {}
+        portals: dict[str, str] = {}       # name → bound literal SQL
+        skip_to_sync = False               # error: discard until Sync
+
         while True:
             head = await reader.readexactly(5)
             tag = head[:1]
@@ -336,44 +349,146 @@ class WirePgServer:
             body = await reader.readexactly(length - 4)
             if tag == b"X":
                 return
-            if tag != b"Q":
-                writer.write(self._error("0A000", "simple protocol only"))
+            if skip_to_sync and tag != b"S":
+                continue
+            if tag == b"Q":
+                sql = body.rstrip(b"\0").decode()
+                try:
+                    result = self.handler(sql)
+                except WireSqlError as exc:
+                    writer.write(self._error(exc.sqlstate, exc.message))
+                else:
+                    self._write_result(writer, result)
                 writer.write(self._msg(b"Z", b"I"))
                 await writer.drain()
-                continue
-            sql = body.rstrip(b"\0").decode()
-            try:
-                result = self.handler(sql)
-            except WireSqlError as exc:
-                writer.write(self._error(exc.sqlstate, exc.message))
-            else:
-                if isinstance(result, str):
-                    writer.write(self._msg(
-                        b"C", result.encode() + b"\0"
+            elif tag == b"S":              # Sync: end of extended cycle
+                skip_to_sync = False
+                portals.clear()            # portals die at cycle end
+                writer.write(self._msg(b"Z", b"I"))
+                await writer.drain()
+            elif tag == b"P":              # Parse
+                self.parse_count += 1
+                name_end = body.index(b"\0")
+                name = body[:name_end].decode()
+                sql_end = body.index(b"\0", name_end + 1)
+                sql = body[name_end + 1:sql_end].decode()
+                (nparams,) = struct.unpack(
+                    ">h", body[sql_end + 1:sql_end + 3]
+                )
+                oids = list(struct.unpack(
+                    f">{nparams}i",
+                    body[sql_end + 3:sql_end + 3 + 4 * nparams],
+                )) if nparams else []
+                prepared[name] = (sql, oids)
+                writer.write(self._msg(b"1", b""))
+            elif tag == b"B":              # Bind
+                off = body.index(b"\0")
+                portal = body[:off].decode()
+                end = body.index(b"\0", off + 1)
+                stmt = body[off + 1:end].decode()
+                off = end + 1
+                (nfmt,) = struct.unpack(">h", body[off:off + 2])
+                fmts = struct.unpack(
+                    f">{nfmt}h", body[off + 2:off + 2 + 2 * nfmt]
+                )
+                assert all(f == 0 for f in fmts), "text format only"
+                off += 2 + 2 * nfmt
+                (nvals,) = struct.unpack(">h", body[off:off + 2])
+                off += 2
+                if stmt not in prepared:
+                    writer.write(self._error(
+                        "26000", f"prepared statement {stmt!r} not found"
                     ))
+                    skip_to_sync = True
+                    continue
+                sql, oids = prepared[stmt]
+                values = []
+                for i in range(nvals):
+                    (ln,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        values.append(None)
+                    else:
+                        text = body[off:off + ln].decode()
+                        off += ln
+                        # decode by the DECLARED type — exactly what a
+                        # real backend's input functions do. A real
+                        # backend infers OID-0 params from column
+                        # context; this double cannot, so a non-NULL
+                        # value declared 0 fails loudly rather than
+                        # silently decoding as text.
+                        oid = oids[i] if i < len(oids) else 0
+                        if oid == 0:
+                            writer.write(self._error(
+                                "42P18",
+                                f"could not determine data type of "
+                                f"parameter ${i + 1}",
+                            ))
+                            skip_to_sync = True
+                            break
+                        values.append(decode_text(oid, text))
+                if skip_to_sync:
+                    continue
+                # the engine is literal-SQL: substitute the decoded
+                # values back with the client's own quoting rules
+                portals[portal] = bind_params(sql, tuple(values))
+                writer.write(self._msg(b"2", b""))
+            elif tag == b"D":              # Describe: deferred (see
+                pass                       # module docstring)
+            elif tag == b"C":              # Close
+                kind = chr(body[0])
+                cname = body[1:body.index(b"\0", 1)].decode()
+                (prepared if kind == "S" else portals).pop(cname, None)
+                writer.write(self._msg(b"3", b""))
+            elif tag == b"E":              # Execute
+                portal = body[:body.index(b"\0")].decode()
+                bound = portals.get(portal)
+                if bound is None:
+                    writer.write(self._error(
+                        "34000", f"portal {portal!r} does not exist"
+                    ))
+                    skip_to_sync = True
+                    continue
+                try:
+                    result = self.handler(bound)
+                except WireSqlError as exc:
+                    writer.write(self._error(exc.sqlstate, exc.message))
+                    skip_to_sync = True
                 else:
-                    names, oids, rows = result
-                    desc = struct.pack(">h", len(names))
-                    for name, oid in zip(names, oids):
-                        desc += (name.encode() + b"\0"
-                                 + struct.pack(">ihihih", 0, 0, oid,
-                                               -1, -1, 0))
-                    writer.write(self._msg(b"T", desc))
-                    for row in rows:
-                        data = struct.pack(">h", len(row))
-                        for v in row:
-                            text = encode_text(v)
-                            if text is None:
-                                data += struct.pack(">i", -1)
-                            else:
-                                raw = text.encode()
-                                data += struct.pack(">i", len(raw)) + raw
-                        writer.write(self._msg(b"D", data))
-                    writer.write(self._msg(
-                        b"C", f"SELECT {len(rows)}".encode() + b"\0"
-                    ))
-            writer.write(self._msg(b"Z", b"I"))
-            await writer.drain()
+                    self._write_result(writer, result)
+            elif tag == b"H":              # Flush
+                await writer.drain()
+            else:
+                writer.write(self._error(
+                    "0A000", f"unsupported message {tag!r}"
+                ))
+                skip_to_sync = True
+
+    def _write_result(self, writer, result) -> None:
+        """RowDescription + DataRows + CommandComplete (no Z — the
+        caller owns cycle framing)."""
+        if isinstance(result, str):
+            writer.write(self._msg(b"C", result.encode() + b"\0"))
+            return
+        names, oids, rows = result
+        desc = struct.pack(">h", len(names))
+        for name, oid in zip(names, oids):
+            desc += (name.encode() + b"\0"
+                     + struct.pack(">ihihih", 0, 0, oid, -1, -1, 0))
+        writer.write(self._msg(b"T", desc))
+        for row in rows:
+            data = struct.pack(">h", len(row))
+            for v in row:
+                text = encode_text(v)
+                if text is None:
+                    data += struct.pack(">i", -1)
+                else:
+                    raw = text.encode()
+                    data += struct.pack(">i", len(raw)) + raw
+            writer.write(self._msg(b"D", data))
+        writer.write(self._msg(
+            b"C", f"SELECT {len(rows)}".encode() + b"\0"
+        ))
 
     # -- auth backends --
 

@@ -155,6 +155,163 @@ def test_command_tag_returned():
 
 # endregion
 
+# region: extended query protocol (Parse/Bind/Execute + statement cache)
+
+
+def test_extended_params_round_trip_every_type():
+    """Typed parameters cross the wire as protocol-level Bind values
+    (never SQL text) and come back through the engine intact."""
+    ts = datetime(2024, 6, 1, 12, 30, 0, 123456, tzinfo=timezone.utc)
+    seen = []
+
+    def handler(sql):
+        seen.append(sql)
+        return "SELECT 0"
+
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        await conn.execute(
+            "INSERT x VALUES ($1,$2,$3,$4,$5,$6,$7)",
+            None, True, -42, 2.5, "it's", b"\x00\xfe", ts,
+        )
+        await conn.close()
+
+    run(with_server("trust", fn, handler=handler))
+    # the server-side double re-binds the DECODED values literally —
+    # proving each type survived the Bind encode → OID decode round
+    assert seen == [
+        "INSERT x VALUES (NULL,TRUE,-42,2.5,'it''s',"
+        "'\\x00fe'::bytea,'2024-06-01T12:30:00.123456+00:00'"
+        "::timestamptz)"
+    ]
+
+
+def test_extended_statement_cache_parses_once():
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        for i in range(5):
+            await conn.fetch(
+                "SELECT region_id FROM navigation.regions WHERE "
+                "world_name=$1 AND rx=$2 AND ry=$3 AND rz=$4",
+                "w", i, 0, 0,
+            )
+        assert server.parse_count == 1  # one Parse, five Binds
+        # a different SQL shape parses separately
+        await conn.fetch(
+            "SELECT table_suffix FROM navigation.tables WHERE "
+            "world_name=$1 AND tx=$2 AND ty=$3 AND tz=$4",
+            "w", 0, 0, 0,
+        )
+        assert server.parse_count == 2
+        await conn.close()
+    run(with_server("trust", fn))
+
+
+def test_extended_cache_eviction_bounds_names():
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        conn.STMT_CACHE_MAX = 4
+        for i in range(10):
+            # distinct SQL shapes (comment varies) — forces eviction
+            await conn.fetch(
+                f"SELECT region_id FROM navigation.regions WHERE "
+                f"world_name=$1 AND rx={i} AND ry=$2 AND rz=$3",
+                "w", 0, 0,
+            )
+        assert len(conn._stmts) <= 4
+        # the LRU survivor re-executes without a new Parse
+        before = server.parse_count
+        await conn.fetch(
+            "SELECT region_id FROM navigation.regions WHERE "
+            "world_name=$1 AND rx=9 AND ry=$2 AND rz=$3",
+            "w", 0, 0,
+        )
+        assert server.parse_count == before
+        await conn.close()
+    run(with_server("trust", fn))
+
+
+def test_extended_error_recycles_statement():
+    """An error inside an extended cycle must not poison the cache or
+    the connection: the next call re-parses and succeeds."""
+    calls = []
+
+    def handler(sql):
+        calls.append(sql)
+        if len(calls) == 1:
+            raise WireSqlError("42P01", "relation does not exist")
+        return "SELECT 0"
+
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        with pytest.raises(PgWireError) as err:
+            await conn.execute("SELECT a FROM t WHERE b=$1", 1)
+        assert err.value.sqlstate == "42P01"
+        assert conn._stmts == {}        # failed cycle not cached
+        assert await conn.execute("SELECT a FROM t WHERE b=$1", 2) \
+            == "SELECT 0"
+        assert len(conn._stmts) == 1
+        await conn.close()
+    run(with_server("trust", fn, handler=handler))
+
+
+def test_extended_type_change_reparses():
+    """The cache key includes the declared param OIDs: the same SQL
+    bound with different Python types is a different server-side
+    statement (Parse freezes the types)."""
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+
+        def nav(v):
+            return conn.fetch(
+                "SELECT region_id FROM navigation.regions WHERE "
+                "world_name=$1 AND rx=$2 AND ry=$3 AND rz=$4",
+                "w", v, 0, 0,
+            )
+        await nav(1)
+        assert server.parse_count == 1
+        await nav(1.5)                  # int8 → float8 at $2
+        assert server.parse_count == 2
+        await nav(2)                    # int8 again: cached
+        assert server.parse_count == 2
+        assert len(conn._stmts) == 2
+        await conn.close()
+    run(with_server("trust", fn))
+
+
+def test_extended_error_closes_orphaned_name():
+    """A statement name orphaned by an error cycle is Closed on the
+    next cycle, not leaked for the connection's lifetime."""
+    calls = []
+
+    def handler(sql):
+        calls.append(sql)
+        if len(calls) == 1:
+            raise WireSqlError("42P01", "relation does not exist")
+        return "SELECT 0"
+
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        with pytest.raises(PgWireError):
+            await conn.execute("SELECT a FROM t WHERE b=$1", 1)
+        assert conn._dead_stmts == ["_wql1"]
+        await conn.execute("SELECT a FROM t WHERE b=$1", 2)
+        assert conn._dead_stmts == []   # Close rode the second cycle
+        await conn.close()
+    run(with_server("trust", fn, handler=handler))
+
+
+def test_parameterless_statements_use_simple_protocol():
+    async def fn(server):
+        conn = await pgwire.connect(server.url())
+        await conn.execute('CREATE SCHEMA IF NOT EXISTS "w_x"')
+        assert server.parse_count == 0  # DDL rode the simple protocol
+        await conn.close()
+    run(with_server("trust", fn))
+
+
+# endregion
+
 # region: the store, end-to-end over the socket
 
 

@@ -14,11 +14,14 @@ Scope (deliberately minimal, fully standard):
 * optional TLS via the SSLRequest dance (``?sslmode=require``);
 * the SIMPLE QUERY protocol ('Q' → RowDescription/DataRow/
   CommandComplete/ErrorResponse/ReadyForQuery) with text-format
-  result decoding by type OID;
-* asyncpg-style ``$N`` parameters bound CLIENT-side as SQL literals
-  (safe quoting; the server's standard_conforming_strings default) —
-  the store's identifiers are already sanitizer-gated
-  (utils/names.py), parameters here are data values only;
+  result decoding by type OID — used for statements without
+  parameters (DDL), like tokio-postgres's ``batch_execute``;
+* the EXTENDED QUERY protocol (Parse/Bind/Describe/Execute/Sync)
+  for every parameterized statement: ``$N`` values travel as typed
+  protocol-level parameters — they never enter SQL text, matching
+  the reference's injection-safety posture (client.rs:161-162,
+  navigation.rs:56-64) — with an LRU-bounded named-statement cache
+  so hot statements parse once per connection;
 * errors surface as :class:`PgWireError` with ``.sqlstate``, which is
   what the store's UNDEFINED_TABLE lazy-DDL retry path keys on
   (client.rs:178-225).
@@ -117,6 +120,58 @@ def bind_params(sql: str, params: tuple) -> str:
 
 # endregion
 
+# region: extended-protocol parameter encoding
+
+
+def param_oid(value) -> int:
+    """Declared parameter type for Parse. Explicit OIDs (rather than 0
+    = infer) let the server type-check the Bind values and keep the
+    in-process test double's decoding honest. bool must precede int
+    (bool is an int subclass)."""
+    if value is None:
+        return 0                      # NULL carries no type
+    if isinstance(value, bool):
+        return _OID_BOOL
+    if isinstance(value, int):
+        return _OID_INT8
+    if isinstance(value, float):
+        return _OID_FLOAT8
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return _OID_BYTEA
+    if isinstance(value, datetime):
+        return _OID_TIMESTAMPTZ
+    if isinstance(value, date):
+        return _OID_DATE
+    if isinstance(value, str):
+        return _OID_TEXT
+    raise TypeError(f"cannot bind {type(value).__name__} as parameter")
+
+
+def param_text(value) -> str | None:
+    """One Python value → text-format Bind value (None = SQL NULL)."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return "t" if value else "f"
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float):
+        if value != value:
+            return "NaN"
+        if value in (float("inf"), float("-inf")):
+            return ("-" if value < 0 else "") + "Infinity"
+        return repr(value)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return "\\x" + bytes(value).hex()
+    if isinstance(value, (datetime, date)):
+        return value.isoformat()
+    if isinstance(value, str):
+        return value
+    raise TypeError(f"cannot bind {type(value).__name__} as parameter")
+
+
+# endregion
+
 # region: text-format decoding
 
 _OID_BOOL = 16
@@ -124,6 +179,7 @@ _OID_BYTEA = 17
 _OID_INT8 = 20
 _OID_INT2 = 21
 _OID_INT4 = 23
+_OID_TEXT = 25
 _OID_OID = 26
 _OID_FLOAT4 = 700
 _OID_FLOAT8 = 701
@@ -217,7 +273,15 @@ class _Scram:
 
 
 class PgWireConnection:
-    """One server connection speaking the simple-query protocol."""
+    """One server connection: simple-query protocol for parameterless
+    statements, extended-query protocol (with a named-statement cache)
+    for everything with ``$N`` parameters."""
+
+    #: named-statement cache bound (per connection). The store's hot
+    #: statements (navigation lookup/insert, region read, dedupe
+    #: delete) are a handful of shapes; multi-row INSERT shapes vary by
+    #: row count, so the cache is LRU-bounded rather than unbounded.
+    STMT_CACHE_MAX = 64
 
     def __init__(self, reader, writer, params: dict):
         self._reader = reader
@@ -229,6 +293,12 @@ class PgWireConnection:
         # reads on the shared stream and cross-wire each other's rows
         # (asyncpg raises InterfaceError here; we just queue)
         self._lock = asyncio.Lock()
+        # keyed by (sql, declared param OIDs): Parse freezes the types,
+        # so the same SQL bound with different Python types is a
+        # different server-side statement
+        self._stmts: dict[tuple, str] = {}
+        self._stmt_seq = 0
+        self._dead_stmts: list[str] = []   # to Close on the next cycle
 
     # -- connection establishment --
 
@@ -362,18 +432,34 @@ class PgWireConnection:
                 )
         return fields
 
-    # -- queries (asyncpg-compatible surface) --
+    @staticmethod
+    def _parse_row_desc(payload: bytes) -> list[int]:
+        (ncols,) = struct.unpack(">h", payload[:2])
+        oids, off = [], 2
+        for _ in range(ncols):
+            end = payload.index(b"\0", off)
+            oid = struct.unpack(">i", payload[end + 7:end + 11])[0]
+            oids.append(oid)
+            off = end + 19
+        return oids
 
-    async def _query(self, sql: str) -> tuple[list, str]:
-        if self._closed:
-            raise PgWireError({"C": "08003", "M": "connection is closed"})
-        async with self._lock:
-            return await self._query_locked(sql)
+    @staticmethod
+    def _parse_data_row(payload: bytes, oids: list[int]) -> tuple:
+        (ncols,) = struct.unpack(">h", payload[:2])
+        off, row = 2, []
+        for c in range(ncols):
+            (ln,) = struct.unpack(">i", payload[off:off + 4])
+            off += 4
+            if ln == -1:
+                row.append(None)
+            else:
+                text = payload[off:off + ln].decode()
+                off += ln
+                row.append(decode_text(oids[c], text))
+        return tuple(row)
 
-    async def _query_locked(self, sql: str) -> tuple[list, str]:
-        self._send(b"Q", sql.encode() + b"\0")
-        await self._writer.drain()
-
+    async def _read_cycle(self) -> tuple[list, str]:
+        """Drain one query cycle (either protocol) to ReadyForQuery."""
         rows: list[tuple] = []
         oids: list[int] = []
         tag_line = ""
@@ -381,28 +467,9 @@ class PgWireConnection:
         while True:
             tag, payload = await self._recv()
             if tag == b"T":             # RowDescription
-                (ncols,) = struct.unpack(">h", payload[:2])
-                oids, off = [], 2
-                for _ in range(ncols):
-                    end = payload.index(b"\0", off)
-                    oid = struct.unpack(
-                        ">i", payload[end + 7:end + 11]
-                    )[0]
-                    oids.append(oid)
-                    off = end + 19
+                oids = self._parse_row_desc(payload)
             elif tag == b"D":           # DataRow
-                (ncols,) = struct.unpack(">h", payload[:2])
-                off, row = 2, []
-                for c in range(ncols):
-                    (ln,) = struct.unpack(">i", payload[off:off + 4])
-                    off += 4
-                    if ln == -1:
-                        row.append(None)
-                    else:
-                        text = payload[off:off + ln].decode()
-                        off += ln
-                        row.append(decode_text(oids[c], text))
-                rows.append(tuple(row))
+                rows.append(self._parse_data_row(payload, oids))
             elif tag == b"C":           # CommandComplete
                 tag_line = payload.rstrip(b"\0").decode()
             elif tag == b"E":
@@ -411,14 +478,97 @@ class PgWireConnection:
                 if error is not None:
                     raise error
                 return rows, tag_line
-            # 'N' notices, 'I' empty query, 'S' params: ignored
+            # '1' parse / '2' bind / '3' close complete, 'n' no data,
+            # 's' portal suspended, 'N' notices, 'I' empty query,
+            # 'S' params: ignored
+
+    # -- queries (asyncpg-compatible surface) --
+
+    async def _query(self, sql: str) -> tuple[list, str]:
+        """Simple-query protocol: parameterless statements (DDL and
+        navigation schema setup — tokio-postgres's batch_execute
+        equivalent, client.rs:178-225)."""
+        if self._closed:
+            raise PgWireError({"C": "08003", "M": "connection is closed"})
+        async with self._lock:
+            self._send(b"Q", sql.encode() + b"\0")
+            await self._writer.drain()
+            return await self._read_cycle()
+
+    async def _query_ext(self, sql: str, params: tuple) -> tuple[list, str]:
+        """Extended-query protocol: Parse (cached per connection) →
+        Bind (typed text-format parameters — values NEVER enter SQL
+        text) → Describe → Execute → Sync, pipelined in one flush."""
+        if self._closed:
+            raise PgWireError({"C": "08003", "M": "connection is closed"})
+        oids = tuple(param_oid(p) for p in params)
+        key = (sql, oids)
+        async with self._lock:
+            # names orphaned by an earlier error cycle: Close them on
+            # this pipeline (they no longer back any cache entry)
+            for dead in self._dead_stmts:
+                self._send(b"C", b"S" + dead.encode() + b"\0")
+            self._dead_stmts.clear()
+            name = self._stmts.pop(key, None)
+            new_parse = name is None
+            if new_parse:
+                # evict LRU entries past the bound; Close rides the
+                # same pipeline ahead of the Parse
+                while len(self._stmts) >= self.STMT_CACHE_MAX:
+                    old_key, old_name = next(iter(self._stmts.items()))
+                    del self._stmts[old_key]
+                    self._send(b"C", b"S" + old_name.encode() + b"\0")
+                self._stmt_seq += 1
+                name = f"_wql{self._stmt_seq}"
+                body = name.encode() + b"\0" + sql.encode() + b"\0"
+                body += struct.pack(">h", len(oids))
+                for oid in oids:
+                    body += struct.pack(">i", oid)
+                self._send(b"P", body)
+
+            bind = b"\0" + name.encode() + b"\0"
+            bind += struct.pack(">hh", 1, 0)        # all params text
+            bind += struct.pack(">h", len(params))
+            for p in params:
+                text = param_text(p)
+                if text is None:
+                    bind += struct.pack(">i", -1)
+                else:
+                    raw = text.encode()
+                    bind += struct.pack(">i", len(raw)) + raw
+            bind += struct.pack(">hh", 1, 0)        # all results text
+            self._send(b"B", bind)
+            self._send(b"D", b"P\0")                # describe portal
+            self._send(b"E", b"\0" + struct.pack(">i", 0))
+            self._send(b"S", b"")
+            await self._writer.drain()
+            try:
+                result = await self._read_cycle()
+            except PgWireError:
+                # not re-cached: if the Parse failed the name does not
+                # exist server-side; if it parsed but Bind/Execute
+                # errored (or a cached statement went bad — 26000
+                # after a pooler swap) re-parsing next call is the
+                # safe recovery either way. The name may still exist
+                # server-side — Close it on the next cycle (Close on
+                # a nonexistent statement is a no-op by protocol).
+                self._dead_stmts.append(name)
+                raise
+            self._stmts[key] = name     # (re-)insert at LRU tail
+            return result
 
     async def execute(self, sql: str, *params) -> str:
-        _, tag_line = await self._query(bind_params(sql, params))
+        if params:
+            _, tag_line = await self._query_ext(sql, params)
+        else:
+            _, tag_line = await self._query(sql)
         return tag_line
 
     async def fetch(self, sql: str, *params) -> list:
-        rows, _ = await self._query(bind_params(sql, params))
+        if params:
+            rows, _ = await self._query_ext(sql, params)
+        else:
+            rows, _ = await self._query(sql)
         return rows
 
     async def close(self) -> None:
