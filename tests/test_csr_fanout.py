@@ -1,10 +1,13 @@
 """CSR / sparse result-compaction paths (spatial/tpu_backend.py).
 
 The CSR layout is what the bench and distributed delivery consume; the
-two-tier gather (tier 1 at CSR_K_LO, hot runs re-gathered at full K)
-must be indistinguishable from the dense result for every workload
-shape. These tests pin that equivalence against the dense path and the
-CPU oracle, including the overflow-tier sentinel contract.
+run-window assembly (counts = RAW run lengths, per-(query, segment)
+8-lane-row regions, -1 holes where a lane was tombstoned or
+replication-filtered) must be indistinguishable from the dense result
+for every workload shape. These tests pin that equivalence against the
+dense path and the CPU oracle — through the PRODUCT decoder
+(_decode_csr), so the wire layout and its walk cannot drift apart —
+including the capacity-overflow sentinel contract.
 """
 
 import uuid
@@ -22,14 +25,11 @@ def _peers(n, base=0):
     return [uuid.UUID(int=base + i + 1) for i in range(n)]
 
 
-def csr_lists(counts, flat, m):
-    counts = np.asarray(counts)[:m]
-    flat = np.asarray(flat)
-    out, pos = [], 0
-    for c in counts:
-        out.append(sorted(int(t) for t in flat[pos:pos + c]))
-        pos += c
-    return out
+def csr_lists(b, counts, flat, m):
+    """Decode through the backend's own CSR walk, mapped back to dense
+    peer ids for comparison with dense_lists."""
+    lists = b._decode_csr(np.asarray(counts), np.asarray(flat), m)
+    return [sorted(b._peer_ids[u] for u in lst) for lst in lists]
 
 
 def dense_lists(tgt):
@@ -37,8 +37,8 @@ def dense_lists(tgt):
 
 
 def build_hot_cold(hot_cubes=6, hot_occupancy=40, cold=200):
-    """Index with a few hot cubes (runs far above CSR_K_LO) and many
-    singleton cubes — the Zipf shape the two-tier gather exists for."""
+    """Index with a few hot cubes (runs far above one CSR row) and many
+    singleton cubes — the Zipf shape the run-window CSR serves."""
     b = TpuSpatialBackend(16, compact_threshold=32)
     rng = np.random.default_rng(3)
     cubes, peers = [], []
@@ -55,7 +55,7 @@ def build_hot_cold(hot_cubes=6, hot_occupancy=40, cold=200):
     b.bulk_add_subscriptions(W, peers, np.asarray(cubes, np.int64))
     b.flush()
     b.wait_compaction()
-    assert b._base_k > b.CSR_K_LO  # two-tier actually engages
+    assert b._base_k > 8  # hot runs span multiple CSR rows
     # cube labels are max-corner multiples: label c covers (c-16, c],
     # so c - 0.5 is a position inside cube c
     return b, np.asarray(cubes, np.float64) - 0.5, peers
@@ -78,16 +78,14 @@ def test_csr_matches_dense_with_hot_cubes():
     batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
 
     dense = b.match_arrays(*batch)
-    # csr_cap sized so the overflow tier (t_cap // 64) fits this
-    # hot-heavy workload (~half the queries hit a hot cube)
     m, res = b.match_arrays_async(*batch, csr_cap=16384)
     counts, flat, total = res
     assert int(total) <= 16384
-    got = csr_lists(counts, flat, m)
+    got = csr_lists(b, counts, flat, m)
     want = dense_lists(dense)
     assert got == want
-    # hot queries really did overflow tier 1
-    assert max(len(x) for x in want) > b.CSR_K_LO
+    # hot queries really did span multiple CSR rows
+    assert max(len(x) for x in want) > 8
 
 
 def test_csr_matches_dense_across_segments_and_replication():
@@ -109,7 +107,7 @@ def test_csr_matches_dense_across_segments_and_replication():
         dense = b.match_arrays(*batch)
         m, res = b.match_arrays_async(*batch, csr_cap=8192)
         counts, flat, total = res
-        assert csr_lists(counts, flat, m) == dense_lists(dense)
+        assert csr_lists(b, counts, flat, m) == dense_lists(dense)
 
 
 def test_csr_agrees_with_cpu_oracle():
@@ -127,7 +125,7 @@ def test_csr_agrees_with_cpu_oracle():
     batch = query_batch(b, sub_pos[qidx], senders)
     m, res = b.match_arrays_async(*batch, csr_cap=8192)
     counts, flat, _ = res
-    got = csr_lists(counts, flat, m)
+    got = csr_lists(b, counts, flat, m)
     queries = [
         LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
                    Replication.EXCEPT_SELF)
@@ -137,30 +135,47 @@ def test_csr_agrees_with_cpu_oracle():
         assert g == sorted(b._peer_ids[p] for p in want)
 
 
-def test_overflow_tier_exhaustion_signals_retry():
-    """More overflowing (hot) queries than h_cap slots → total returns
-    the impossible t_cap + 1 so callers retry with doubled capacity —
+def test_capacity_overflow_signals_retry():
+    """A row-padded layout that outgrows t_cap → total returns the
+    impossible t_cap + 1 so callers retry with doubled capacity —
     never a silently truncated result."""
-    hot_cubes = 80  # > h_cap = max(64, 4096 // 64) = 64
+    hot_cubes = 80
     b, sub_pos, peers = build_hot_cold(
         hot_cubes=hot_cubes, hot_occupancy=20, cold=10
     )
-    # one query per hot cube → 80 overflow rows
+    # one query per hot cube → 80 × ceil(20/8)*8 = 1920 padded slots
     qpos = np.asarray(
         [[16 * (h + 1) - 0.5, 15.5, 15.5] for h in range(hot_cubes)]
     )
     batch = query_batch(b, qpos, [uuid.uuid4()] * hot_cubes)
-    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    m, res = b.match_arrays_async(*batch, csr_cap=1024)
     counts, flat, total = res
-    t_cap = 4096
-    assert int(total) == t_cap + 1  # sentinel, not silent truncation
+    # sentinel (dispatched_cap + 1, where the dispatcher may have
+    # raised the requested 1024 to the zone-A floor) — the contract is
+    # total > requested cap, never a silently truncated result
+    assert int(total) > 1024
+    assert int(total) != hot_cubes * 20
 
-    # the documented retry (doubled capacity) succeeds and is exact
-    m, res = b.match_arrays_async(*batch, csr_cap=2 * t_cap)
+    # the documented retry (doubled capacity) succeeds and is exact;
+    # counts are RAW run lengths, and with absent senders no lane is
+    # filtered, so the raw total is the delivered total
+    m, res = b.match_arrays_async(*batch, csr_cap=4096)
     counts, flat, total = res
     assert int(total) == hot_cubes * 20
     dense = b.match_arrays(*batch)
-    assert csr_lists(counts, flat, m) == dense_lists(dense)
+    assert csr_lists(b, counts, flat, m) == dense_lists(dense)
+
+
+def test_raw_counts_exceed_filtered_lists():
+    """counts are RAW run lengths: a sender inside a hot cube still
+    counts itself in counts (its lane ships as a -1 hole under
+    EXCEPT_SELF) while the decoded list excludes it."""
+    b, sub_pos, peers = build_hot_cold(hot_cubes=1, hot_occupancy=20)
+    batch = query_batch(b, sub_pos[:1], [peers[0]])
+    m, res = b.match_arrays_async(*batch, csr_cap=2048)
+    counts, flat, total = res
+    assert int(np.asarray(counts)[0].sum()) == 20      # raw, incl. self
+    assert len(csr_lists(b, counts, flat, m)[0]) == 19  # filtered
 
 
 def test_delivery_path_uses_csr_and_falls_back_dense_on_overflow():
@@ -242,13 +257,13 @@ def build_hot_cold_sharded(mesh, hot_cubes=6, hot_occupancy=40, cold=200):
     b.bulk_add_subscriptions(W, peers, np.asarray(cubes, np.int64))
     b.flush()
     b.wait_compaction()
-    assert b._base_k > b.CSR_K_LO
+    assert b._base_k > 8
     return b, np.asarray(cubes, np.float64) - 0.5, peers
 
 
-def test_sharded_csr_two_tier_matches_dense():
-    """The mesh kernel's two-tier gather (overflow mask pmax-merged
-    over 'space' before each batch shard selects) must equal the dense
+def test_sharded_csr_matches_dense():
+    """The mesh kernel's run-window CSR (global raw counts pmax-merged
+    over 'space', per-batch-shard flat regions) must equal the dense
     mesh result — including queries whose hot run lives on a single
     space shard."""
     _require_devices(8)
@@ -269,34 +284,39 @@ def test_sharded_csr_two_tier_matches_dense():
             b, sub_pos[qidx], [peers[i] for i in qidx], repl
         )
         dense = b.match_arrays(*batch)
-        m, res = b.match_arrays_async(*batch, csr_cap=16384)
+        m, res = b.match_arrays_async(*batch, csr_cap=32768)
         counts, flat, total = res
-        assert int(total) <= 16384
-        assert csr_lists(counts, flat, m) == dense_lists(dense)
+        assert int(total) <= 32768
+        assert csr_lists(b, counts, flat, m) == dense_lists(dense)
 
 
-def test_sharded_overflow_tier_exhaustion_signals_retry():
+def test_sharded_capacity_overflow_signals_retry():
+    """One batch shard overflowing its local region budget must raise
+    the global retry sentinel."""
     _require_devices(8)
     from worldql_server_tpu.parallel import make_fanout_mesh
 
     mesh = make_fanout_mesh(2, 4)
-    hot_cubes = 160  # > per-batch-shard h_cap = 64 even split over 2
+    hot_cubes = 160
     b, sub_pos, peers = build_hot_cold_sharded(
         mesh, hot_cubes=hot_cubes, hot_occupancy=20, cold=10
     )
+    # 160 × 24 = 3840 padded slots split over 2 batch shards — a
+    # csr_cap of 2048 gives each shard 1024, well under its ~1920
     qpos = np.asarray(
         [[16 * (h + 1) - 0.5, 15.5, 15.5] for h in range(hot_cubes)]
     )
     batch = query_batch(b, qpos, [uuid.uuid4()] * hot_cubes)
-    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    m, res = b.match_arrays_async(*batch, csr_cap=2048)
     counts, flat, total = res
-    assert int(total) == 4096 + 1  # sentinel
+    assert int(total) > 2048          # sentinel
+    assert int(total) != hot_cubes * 20
 
     m, res = b.match_arrays_async(*batch, csr_cap=16384)
     counts, flat, total = res
     assert int(total) == hot_cubes * 20
     dense = b.match_arrays(*batch)
-    assert csr_lists(counts, flat, m) == dense_lists(dense)
+    assert csr_lists(b, counts, flat, m) == dense_lists(dense)
 
 
 def test_sparse_path_matches_dense():

@@ -1,11 +1,11 @@
 """Bucket probe-table index: correctness against the binary-search
-path, overflow fallback, and build invariants.
+path, overflow/collision fallback, and build invariants.
 
-The probe table replaces the per-query searchsorted (20 dependent
-gather rounds at 1M rows) with one 64-byte bucket-row gather; these
-tests pin that both run-bounds branches agree exactly, and that an
-overflowed table (load factor > 1, or an adversarial bucket) routes
-queries through the binary-search branch rather than dropping matches.
+The packed probe table replaces the per-query searchsorted (20
+dependent gather rounds at 1M rows) with one [M, 2E] i32 bucket-row
+gather; these tests pin that both run-bounds branches agree exactly,
+and that an overflowed or tag-collided table routes queries through
+the binary-search branch rather than dropping matches.
 """
 
 from __future__ import annotations
@@ -33,7 +33,7 @@ from worldql_server_tpu.spatial.tpu_backend import (
 
 def build_segment(rng, n_cubes=200, s_cap=1024, dead_frac=0.1):
     """Synthetic sorted segment: keys with runs, some tombstones, pad
-    tail. Returns the 7-array device segment plus host mirrors."""
+    tail. Returns the device segment columns plus host mirrors."""
     cube_keys = np.sort(
         rng.integers(-(2**62), 2**62, n_cubes * 2, dtype=np.int64)
     )
@@ -70,21 +70,23 @@ def make_queries(rng, keys, keys2, m=64, cap=128):
     )
 
 
+def build_table(d_sk, n_buckets):
+    return jax.jit(
+        probe_tables, static_argnames=("n_buckets",)
+    )(d_sk, n_buckets=n_buckets)
+
+
 @pytest.mark.parametrize("n_cubes", [1, 7, 200])
 def test_probe_matches_binary_search(n_cubes):
     rng = np.random.default_rng(42 + n_cubes)
     d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, n_cubes)
     qk, qk2 = make_queries(rng, keys, keys2)
     nb = probe_buckets_for(n_cubes)
-    tk, tp, oflow = jax.jit(
-        probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, rem, n_buckets=nb)
+    tbl, oflow = build_table(d_sk, nb)
     assert int(oflow[0]) == 0, "healthy load factor must never overflow"
 
     lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
-    lo_p, cnt_p = jax.jit(
-        _probe_run_bounds, static_argnames=("spill",)
-    )(tk, tp, d_sk2, qk, qk2, spill=int(oflow[1]) > 0)
+    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tbl, d_sk2, rem, qk, qk2)
     cnt_ref = np.asarray(cnt_ref)
     found = cnt_ref > 0
     assert (np.asarray(cnt_p) == cnt_ref).all()
@@ -95,70 +97,37 @@ def test_table_stores_every_cube_once():
     rng = np.random.default_rng(3)
     d_sk, _, _, rem, keys, _ = build_segment(rng, 150)
     nb = probe_buckets_for(150)
-    tk, tp, oflow = jax.jit(
-        probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, rem, n_buckets=nb)
-    stored = np.asarray(tk).ravel()
-    stored = stored[stored != int(PAD_KEY)]
-    assert sorted(stored.tolist()) == sorted(set(keys.tolist()))
-    # payloads carry the run start of each cube's FIRST row
-    tkn = np.asarray(tk).ravel()
-    tpn = np.asarray(tp).ravel()
+    tbl, oflow = build_table(d_sk, nb)
+    assert int(oflow[0]) == 0
+    t = np.asarray(tbl)
+    e = PROBE_E
     sk_host = np.asarray(d_sk)
-    for key, pay in zip(tkn, tpn):
-        if key == int(PAD_KEY):
-            continue
-        lo = int(pay) >> 31
-        rem_v = int(pay) & ((1 << 31) - 1)
-        assert sk_host[lo] == key
-        assert lo == 0 or sk_host[lo - 1] != key  # run start
-        assert (sk_host[lo:lo + rem_v] == key).all()
-
-
-def test_spill_level_recovers_hot_bucket():
-    """With n_buckets=1 and a few dozen cubes, only PROBE_E fit the
-    primary bucket — the rest must land in the spill level and stay
-    probeable WITHOUT the binary-search fallback (oflow == 0)."""
-    rng = np.random.default_rng(9)
-    d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 20)
-    tk, tp, oflow = jax.jit(
-        probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, rem, n_buckets=1)
-    assert int(oflow[0]) == 0, "spill level must absorb the overflow"
-    n_unique = len(set(keys.tolist()))
-    stored = np.asarray(tk).ravel()
-    assert (stored != int(PAD_KEY)).sum() == n_unique
-    # and the spill rows (past the single primary bucket) hold the rest
-    spill_rows = np.asarray(tk)[1:].ravel()
-    assert (spill_rows != int(PAD_KEY)).sum() == n_unique - PROBE_E
-
-    qk, qk2 = make_queries(rng, keys, keys2)
-    lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
-    lo_p, cnt_p = jax.jit(
-        _probe_run_bounds, static_argnames=("spill",)
-    )(tk, tp, d_sk2, qk, qk2, spill=int(oflow[1]) > 0)
-    cnt_ref = np.asarray(cnt_ref)
-    found = cnt_ref > 0
-    assert (np.asarray(cnt_p) == cnt_ref).all()
-    assert (np.asarray(lo_p)[found] == np.asarray(lo_ref)[found]).all()
+    stored_tags = []
+    for row in t:
+        tags, los = row[:e], row[e:]
+        for tag, lo in zip(tags, los):
+            if lo < 0:
+                continue  # empty slot
+            stored_tags.append((int(tag), int(lo)))
+            # the slot's lo is a run START whose key matches the tag
+            assert (sk_host[lo] >> 32).astype(np.int32) == tag
+            assert lo == 0 or sk_host[lo - 1] != sk_host[lo]
+    assert len(stored_tags) == len(set(keys.tolist()))
 
 
 def test_overflow_falls_back_to_binary_search():
-    """Overflowing BOTH levels (n_buckets=1: 8 primary slots + 16
-    spill buckets x 8 slots, vs ~200 cubes) must route ALL queries
-    through binary search, so no match is ever dropped."""
+    """Overflowing the single bucket (n_buckets=1: E slots vs ~200
+    cubes) must route ALL queries through binary search, so no match
+    is ever dropped."""
     rng = np.random.default_rng(9)
     d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 200)
-    tk, tp, oflow = jax.jit(
-        probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, rem, n_buckets=1)
+    tbl, oflow = build_table(d_sk, 1)
     n_unique = len(set(keys.tolist()))
-    spill_slots = 16 * PROBE_E
-    assert int(oflow[0]) >= n_unique - PROBE_E - spill_slots
+    assert int(oflow[0]) >= n_unique - PROBE_E
     assert int(oflow[0]) > 0
 
     qk, qk2 = make_queries(rng, keys, keys2)
-    seg = (d_sk, d_sk2, d_sp, rem, tk, tp, oflow)
+    seg = (d_sk, d_sk2, d_sp, rem, tbl, oflow)
     lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
     lo_s, cnt_s = jax.jit(_seg_run_bounds)(seg, qk, qk2)
     assert (np.asarray(cnt_s) == np.asarray(cnt_ref)).all()
@@ -166,18 +135,45 @@ def test_overflow_falls_back_to_binary_search():
     assert (np.asarray(lo_s)[found] == np.asarray(lo_ref)[found]).all()
 
 
+def test_tag_collision_marks_overflow():
+    """Two DIFFERENT cubes sharing (bucket, tag) are the one case the
+    32-bit tag could mis-route; the build must detect the duplicate
+    and mark the segment for binary-search fallback."""
+    # two keys equal in their top 32 bits, different low bits — with
+    # n_buckets=1 both land in bucket 0 with identical tags
+    keys = np.array(
+        [(7 << 32) | 1, (7 << 32) | 1, (7 << 32) | 9], dtype=np.int64
+    )
+    d_sk = jnp.asarray(pad_to(np.sort(keys), 64, PAD_KEY))
+    tbl, oflow = build_table(d_sk, 1)
+    assert int(oflow[0]) >= 1
+
+    # and the fallback still answers exactly
+    keys2 = (
+        np.sort(keys).view(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    ).view(np.int64)
+    d_sk2 = jnp.asarray(pad_to(keys2, 64, np.int64(0)))
+    d_sp = jnp.asarray(pad_to(np.arange(3, dtype=np.int32), 64,
+                              np.int32(-1)))
+    rem = jax.jit(run_remainders)(d_sk)
+    seg = (d_sk, d_sk2, d_sp, rem, tbl, oflow)
+    qk = jnp.asarray(pad_to(np.sort(keys)[2:3], 8, PAD_KEY))
+    qk2 = jnp.asarray(pad_to(keys2[2:3], 8, QUERY_PAD_KEY2))
+    lo_s, cnt_s = jax.jit(_seg_run_bounds)(seg, qk, qk2)
+    assert int(np.asarray(cnt_s)[0]) == 1
+    assert int(np.asarray(lo_s)[0]) == 2
+
+
 def test_empty_segment_all_pad():
     d_sk = jnp.full(64, PAD_KEY, jnp.int64)
-    rem = jax.jit(run_remainders)(d_sk)
-    tk, tp, oflow = jax.jit(
-        probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, rem, n_buckets=8)
+    tbl, oflow = build_table(d_sk, 8)
     assert int(oflow[0]) == 0
-    assert (np.asarray(tk) == int(PAD_KEY)).all()
+    e = PROBE_E
+    assert (np.asarray(tbl)[:, e:] == -1).all()  # every lo slot empty
 
 
 def test_backend_segments_carry_probe_tables():
-    """End-to-end: a backend flush produces 7-array segments whose
+    """End-to-end: a backend flush produces 6-array segments whose
     probe path answers the same fan-out as the full dispatch."""
     import uuid as uuid_mod
 
@@ -197,4 +193,4 @@ def test_backend_segments_carry_probe_tables():
     segs, ks, kinds = b._segments()
     assert all(len(s) == SEG_ARRAYS for s in segs)
     for s in segs:
-        assert int(np.asarray(s[6])[0]) == 0  # no overflow at this size
+        assert int(np.asarray(s[5])[0]) == 0  # no overflow at this size

@@ -143,9 +143,11 @@ def test_mutations_between_ticks_apply_before_flush():
 
 
 def test_cancel_mid_flush_does_not_redeliver():
-    """A stop() that lands mid-delivery re-queues only the undelivered
-    tail — already-broadcast messages must not be sent twice by the
-    drain flush (ADVICE r1)."""
+    """A stop() landing mid-flush must not double-send (ADVICE r1).
+    With batched delivery the window is two-sided: a cancel BEFORE the
+    device collect re-queues the whole batch for the drain flush; a
+    cancel once delivery has started counts the batch as delivered
+    (fast-path frames are already in transport buffers)."""
 
     async def scenario():
         h = Harness(CpuSpatialBackend, interval=60.0)
@@ -157,23 +159,33 @@ def test_cancel_mid_flush_does_not_redeliver():
         for i in range(4):
             await h.local(a, pos, f"m{i}")
 
-        # Cancel the flush after two deliveries by hooking broadcast_to.
-        real_broadcast = h.peer_map.broadcast_to
-        sent = 0
+        # Case 1: cancel INSIDE the device collect (before delivery):
+        # everything re-queues, nothing was sent.
+        real_dispatch = h.backend.dispatch_local_batch
 
-        async def hooked(message, targets):
-            nonlocal sent
-            await real_broadcast(message, targets)
-            sent += 1
-            if sent == 2:
-                raise asyncio.CancelledError
+        def dispatch_cancels(queries):
+            raise asyncio.CancelledError
 
-        h.peer_map.broadcast_to = hooked
+        h.backend.dispatch_local_batch = dispatch_cancels
         with pytest.raises(asyncio.CancelledError):
             await h.ticker.flush()
-        h.peer_map.broadcast_to = real_broadcast
+        h.backend.dispatch_local_batch = real_dispatch
+        assert h.locals_for(b) == []
 
-        await h.ticker.flush()  # drain delivers only the tail
+        # Case 2: cancel INSIDE the delivery: the batch counts as
+        # delivered — the drain flush must not double-send.
+        real_deliver = h.peer_map.deliver_batch
+
+        async def deliver_then_cancel(pairs):
+            await real_deliver(pairs)
+            raise asyncio.CancelledError
+
+        h.peer_map.deliver_batch = deliver_then_cancel
+        with pytest.raises(asyncio.CancelledError):
+            await h.ticker.flush()
+        h.peer_map.deliver_batch = real_deliver
+
+        await h.ticker.flush()  # drain: nothing left to deliver twice
         assert [m.parameter for m in h.locals_for(b)] == [
             "m0", "m1", "m2", "m3"
         ]

@@ -31,11 +31,39 @@ class PeerSendError(Exception):
     pass
 
 
+class FramedPayload:
+    """One serialized Message shared across every recipient of a
+    broadcast. ``payload`` is the wire bytes; ``cache`` holds
+    transport-framed variants (e.g. the complete WebSocket frame) so a
+    message delivered to N same-transport peers frames ONCE, not N
+    times — server→client WS frames are unmasked and therefore
+    byte-identical for every recipient."""
+
+    __slots__ = ("payload", "cache")
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.cache: dict[str, bytes] = {}
+
+
+#: synchronous fast-path writer a transport may attach to its peers:
+#: returns True when the frame was handed to the transport's buffer
+#: without awaiting (the hot path for per-tick fan-out), False to fall
+#: back to the awaited ``send_raw`` (saturated buffer, closing, or the
+#: transport has no sync path)
+TryWrite = Callable[[FramedPayload], bool]
+
+#: batch variant: hand a peer's whole per-tick frame list to the
+#: transport in one write (writev-style) — all or nothing
+TryWriteMany = Callable[[list[FramedPayload]], bool]
+
+
 class Peer:
     """Uniform outbound handle over any transport (peer.rs:33-88)."""
 
-    __slots__ = ("uuid", "addr", "kind", "_send_raw", "tracks_heartbeat",
-                 "last_heartbeat", "closed")
+    __slots__ = ("uuid", "addr", "kind", "_send_raw", "_try_write",
+                 "_try_write_many", "tracks_heartbeat", "last_heartbeat",
+                 "closed")
 
     def __init__(
         self,
@@ -44,11 +72,15 @@ class Peer:
         send_raw: SendRaw,
         kind: str = "unknown",
         tracks_heartbeat: bool = False,
+        try_write: TryWrite | None = None,
+        try_write_many: TryWriteMany | None = None,
     ):
         self.uuid = uuid
         self.addr = addr
         self.kind = kind
         self._send_raw = send_raw
+        self._try_write = try_write
+        self._try_write_many = try_write_many
         self.tracks_heartbeat = tracks_heartbeat
         self.last_heartbeat = time.monotonic()
         self.closed = False
@@ -73,6 +105,23 @@ class Peer:
             await self._send_raw(data)
         except Exception as exc:
             raise PeerSendError(str(exc)) from exc
+
+    def try_write(self, framed: FramedPayload) -> bool:
+        """Synchronous fast-path delivery; False = use ``send_raw``."""
+        if self.closed or self._try_write is None:
+            return False
+        return self._try_write(framed)
+
+    def try_write_many(self, framed_list: list[FramedPayload]) -> bool:
+        """One coalesced write of a whole per-tick frame list; False =
+        deliver each frame via ``send_raw`` instead."""
+        if self.closed:
+            return False
+        if self._try_write_many is not None:
+            return self._try_write_many(framed_list)
+        if self._try_write is not None and len(framed_list) == 1:
+            return self._try_write(framed_list[0])
+        return False
 
     def __repr__(self) -> str:
         return f"Peer({self.kind}, {self.uuid}, {self.addr})"
@@ -150,24 +199,93 @@ class PeerMap:
 
     # endregion
 
-    # region: broadcasts — serialize once, send concurrently
+    # region: broadcasts — serialize once, frame once per transport,
+    # write synchronously where the transport allows, await the rest
 
     async def _broadcast(self, message: Message, peers: Iterable[Peer]) -> None:
-        data = serialize_message(message)
-        peers = list(peers)
-        results = await asyncio.gather(
-            *(p.send_raw(data) for p in peers), return_exceptions=True
-        )
-        errors = 0
-        for result in results:
-            if isinstance(result, Exception):
-                errors += 1
-                logger.debug("broadcast error: %s", result)
+        framed = FramedPayload(serialize_message(message))
+        n, errors = 0, 0
+        slow: list[Peer] = []
+        for p in peers:
+            n += 1
+            if not p.try_write(framed):
+                slow.append(p)
+        if slow:
+            results = await asyncio.gather(
+                *(p.send_raw(framed.payload) for p in slow),
+                return_exceptions=True,
+            )
+            for result in results:
+                if isinstance(result, Exception):
+                    errors += 1
+                    logger.debug("broadcast error: %s", result)
         if self.metrics is not None:
             self.metrics.inc("broadcast.messages")
-            self.metrics.inc("broadcast.sends", len(peers) - errors)
+            self.metrics.inc("broadcast.sends", n - errors)
             if errors:
                 self.metrics.inc("broadcast.send_errors", errors)
+
+    async def deliver_batch(
+        self,
+        pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+    ) -> int:
+        """Deliver a tick's worth of resolved fan-outs.
+
+        Three levels of batching against the reference's per-message
+        lock + join_all (peer_map.rs:22-40):
+        * serialize once per message — and when the message still
+          carries its inbound wire bytes (``Message.wire``: LocalMessage
+          fan-out re-broadcasts the sender's bytes verbatim), skip
+          re-serialization entirely;
+        * frame once per transport kind (FramedPayload cache);
+        * ONE ``try_write_many`` per peer per tick — each peer's frames
+          coalesce into a single transport write (writev-style) instead
+          of one write per delivery.
+        Peers whose transport can't take the sync write (saturated, or
+        no fast path) fall back to awaited sends in one gather at the
+        end. Returns the number of sends attempted."""
+        outbox: dict[Peer, list[FramedPayload]] = {}
+        n = n_msgs = 0
+        for message, uuids in pairs:
+            n_msgs += 1
+            data = message.wire
+            framed = FramedPayload(
+                serialize_message(message) if data is None else data
+            )
+            for u in uuids:
+                p = self._map.get(u)
+                if p is None:
+                    continue
+                n += 1
+                outbox.setdefault(p, []).append(framed)
+        slow: list[tuple[Peer, list[FramedPayload]]] = []
+        for p, framed_list in outbox.items():
+            if not p.try_write_many(framed_list):
+                slow.append((p, framed_list))
+        errors = 0
+        if slow:
+            # SEQUENTIAL per peer: concurrent send() calls on one
+            # websockets connection raise ConcurrencyError (and would
+            # reorder frames anyway); distinct peers still overlap
+            async def drain_peer(p: Peer, fl: list[FramedPayload]) -> int:
+                failed = 0
+                for f in fl:
+                    try:
+                        await p.send_raw(f.payload)
+                    except Exception as exc:
+                        failed += 1
+                        logger.debug("batch delivery error: %s", exc)
+                return failed
+            for failed in await asyncio.gather(
+                *(drain_peer(p, fl) for p, fl in slow)
+            ):
+                errors += failed
+        if self.metrics is not None:
+            self.metrics.inc("broadcast.messages", n_msgs)
+            self.metrics.inc("broadcast.sends", n - errors)
+            if errors:
+                self.metrics.inc("broadcast.send_errors", errors)
+        return n
 
     async def broadcast_all(self, message: Message) -> None:
         await self._broadcast(message, self._map.values())

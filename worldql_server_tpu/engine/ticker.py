@@ -19,6 +19,7 @@ reference-equivalent immediate path and never constructs this class.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import time
 
@@ -51,6 +52,8 @@ class TickBatcher:
         self.messages = 0
         self.last_batch = 0
         self.last_tick_ms = 0.0
+        self.last_resolve_ms = 0.0   # dispatch + device/backend collect
+        self.last_deliver_ms = 0.0   # PeerMap.deliver_batch
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name="tick-batcher")
@@ -87,7 +90,8 @@ class TickBatcher:
                 return
             t0 = time.perf_counter()
 
-            delivered = 0
+            dispatched = False
+            deliver_task = None
             try:
                 handle = self.backend.dispatch_local_batch(
                     [query for _, query in batch]
@@ -95,26 +99,47 @@ class TickBatcher:
                 targets = await asyncio.to_thread(
                     self.backend.collect_local_batch, handle
                 )
-
-                for (message, _), tgts in zip(batch, targets):
-                    # Count before sending: a cancel landing inside the
-                    # broadcast means partially-sent — re-sending would
-                    # duplicate to the peers already written.
-                    delivered += 1
-                    if tgts:
-                        await self.peer_map.broadcast_to(message, tgts)
+                dispatched = True
+                self.last_resolve_ms = (time.perf_counter() - t0) * 1e3
+                # One batched delivery: every message's frame goes to
+                # its targets' transport buffers synchronously; only
+                # saturated/fast-path-less peers cost an await at the
+                # end (engine/peers.py deliver_batch). Shielded: a
+                # cancel must not abort the awaited (slow-path) tail
+                # half-sent — fast-path frames are already in
+                # transport buffers and re-sending would duplicate.
+                deliver_task = asyncio.ensure_future(
+                    self.peer_map.deliver_batch([
+                        (message, tgts)
+                        for (message, _), tgts in zip(batch, targets)
+                        if tgts
+                    ])
+                )
+                await asyncio.shield(deliver_task)
             except asyncio.CancelledError:
-                # stop() cancelled the timer mid-flush: re-queue only the
-                # undelivered tail so the drain flush can't double-send
-                # messages already broadcast above.
-                self._queue = batch[delivered:] + self._queue
+                if not dispatched:
+                    # stop() landed before the device collect: the
+                    # whole batch is still owed — re-queue it for the
+                    # drain flush.
+                    self._queue = batch + self._queue
+                elif deliver_task is not None:
+                    # delivery already in flight: let it finish (peers
+                    # without a sync fast path — e.g. ZMQ — are only
+                    # served by this awaited tail; abandoning it would
+                    # silently drop their frames).
+                    with contextlib.suppress(Exception):
+                        await deliver_task
                 raise
 
             self.ticks += 1
             self.messages += len(batch)
             self.last_batch = len(batch)
             self.last_tick_ms = (time.perf_counter() - t0) * 1e3
+            self.last_deliver_ms = self.last_tick_ms - self.last_resolve_ms
             if self.metrics is not None:
                 self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)
+                self.metrics.observe_ms(
+                    "tick.deliver_ms", self.last_deliver_ms
+                )
                 self.metrics.inc("tick.flushes")
                 self.metrics.inc("tick.messages", len(batch))

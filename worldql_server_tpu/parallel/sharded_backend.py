@@ -38,24 +38,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..spatial.hashing import PAD_KEY, n_distinct, next_pow2, pad_to
 from ..spatial.tpu_backend import (
+    CSR_ROW,
     SEG_ARRAYS,
     TpuSpatialBackend,
     _alloc_buffers,
-    _concat_parts,
     _grow_buffers,
-    _merge_two_tier_csr,
     _scatter_dead,
     _sort_segment_dev,
     _write_chunk,
-    compact_csr,
     compact_sparse,
     match_core,
     probe_buckets_for,
     probe_tables,
+    run_bounds_all,
+    run_csr_assemble,
     run_remainders,
     run_remainders_np,
-    two_tier_first_pass,
-    two_tier_second_pass,
 )
 
 
@@ -98,16 +96,16 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         return NamedSharding(self.mesh, P(*spec))
 
     def _base_specs(self):
-        # (key, key2, peer, run-remainder, tbl_key, tbl_pay, oflow) —
-        # 1-D columns and [B, E] probe tables as per-shard stacks
+        # (key, key2, peer, run-remainder, tbl, oflow) — 1-D columns
+        # and the [B, 2E] packed probe table as per-shard stacks
         v = P("space", None)
         t = P("space", None, None)
-        return (v, v, v, v, t, t, v)
+        return (v, v, v, v, t, v)
 
     def _delta_specs(self):
         v = P(None)
         t = P(None, None)
-        return (v, v, v, v, t, t, v)
+        return (v, v, v, v, t, v)
 
     def _query_specs(self):
         # (key, key2, sender, repl)
@@ -139,23 +137,21 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         sub = self._sharding("space", None)
         sk = jax.device_put(padded_keys, sub)
         rem = jax.device_put(rems, sub)
-        tk, tp, oflow = self._probe_stack(
-            sk, rem, probe_buckets_for(n_cubes)
-        )
+        tbl, oflow = self._probe_stack(sk, probe_buckets_for(n_cubes))
         return {
             "dev": (
                 sk,
                 jax.device_put(stack(keys2, np.int64(0)), sub),
                 jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
                                sub),
-                rem, tk, tp, oflow,
+                rem, tbl, oflow,
             ),
             "cap": self.n_space * cap,
             "splits": np.asarray(splits, np.int64),
             "shard_cap": cap,
         }
 
-    def _probe_stack(self, sk_stack, rem_stack, n_buckets: int):
+    def _probe_stack(self, sk_stack, n_buckets: int):
         """Per-shard probe tables for a [n_space, cap] base stack —
         vmapped over the shard dim with matching shardings, so each
         device builds the table for its own rows locally."""
@@ -164,21 +160,15 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         if kernel is None:
             kernel = self._kernels[key] = jax.jit(
                 jax.vmap(
-                    lambda sk, rem: probe_tables(
-                        sk, rem, n_buckets=n_buckets
-                    )
+                    lambda sk: probe_tables(sk, n_buckets=n_buckets)
                 ),
-                in_shardings=(
-                    self._sharding("space", None),
-                    self._sharding("space", None),
-                ),
+                in_shardings=(self._sharding("space", None),),
                 out_shardings=(
-                    self._sharding("space", None, None),
                     self._sharding("space", None, None),
                     self._sharding("space", None),
                 ),
             )
-        return kernel(sk_stack, rem_stack)
+        return kernel(sk_stack)
 
     #: re-shard (full re-upload) only when the largest shard exceeds
     #: this multiple of the mean — keys are uniform hashes, so the old
@@ -292,10 +282,8 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 order = jnp.argsort(keys, stable=True)[:cap2]
                 sk = keys[order]
                 rem = run_remainders(sk)
-                tk, tp, oflow = probe_tables(
-                    sk, rem, n_buckets=n_buckets
-                )
-                return (sk, keys2[order], peers[order], rem, tk, tp,
+                tbl_a, oflow = probe_tables(sk, n_buckets=n_buckets)
+                return (sk, keys2[order], peers[order], rem, tbl_a,
                         oflow)
 
             sub = self._sharding("space", None)
@@ -308,7 +296,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                     in_axes=(0, 0, 0, 0, 0, None, None, None),
                 ),
                 in_shardings=(sub, sub, sub, vec, vec, rep, rep, rep),
-                out_shardings=(sub, sub, sub, sub, tbl, tbl, vec),
+                out_shardings=(sub, sub, sub, sub, tbl, vec),
             )
         return kernel(bk, bk2, bp, lo, hi, *delta)
 
@@ -353,7 +341,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             v, t = self._sharding(None), self._sharding(None, None)
             kernel = self._kernels[key] = jax.jit(
                 _sort_segment_dev, static_argnames=("n_buckets",),
-                out_shardings=(v, v, v, v, t, t, v),
+                out_shardings=(v, v, v, v, t, v),
             )
         return kernel(*bufs, n_buckets=n_buckets)
 
@@ -426,55 +414,47 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             )
         ) + self._query_specs()
 
-        if variant == "csr2":
-            t_cap, h_cap, k_lo = extra
+        if variant == "csr":
+            # per-batch-shard result budget: each shard assembles its
+            # own flat region; the host walks them shard by shard
+            t_cap_local = extra // self.n_batch
 
-            def local2(*args):
+            def local_csr(*args):
                 segs = list(local_segs(args))
                 queries = args[na * n_seg:]
-                parts, over_l, los, cnts = two_tier_first_pass(
-                    segs, ks, k_lo, queries
+                los, cnts_local = run_bounds_all(segs, queries)
+                # a run lives on exactly one space shard — the global
+                # raw counts (and therefore the layout every shard
+                # agrees on) are the pmax union
+                cnts = [
+                    jax.lax.pmax(c, "space") for c in cnts_local
+                ]
+                counts, flat, total = run_csr_assemble(
+                    segs, los, cnts, cnts_local, queries, t_cap_local
                 )
-                tgt1 = jax.lax.pmax(_concat_parts(parts), "space")
+                # owner shards wrote real lanes, the rest -1: max is a
+                # lossless merge (same argument as the dense path)
+                flat = jax.lax.pmax(flat, "space")
+                total = jax.lax.pmax(total, "space")
+                return counts, flat, total.reshape(1)
 
-                # a run lives on exactly one space shard, so the global
-                # overflow mask is the pmax union — every space shard
-                # must see it before selecting, or their tier-2 rows
-                # would disagree
-                over = jax.lax.pmax(over_l.astype(jnp.int32), "space") > 0
-                n_over = over.sum(dtype=jnp.int32)
-
-                oidx = jnp.argsort(~over, stable=True)[:h_cap]
-                oidx = oidx.astype(jnp.int32)
-                ovalid = over[oidx]
-                tgt2 = jax.lax.pmax(_concat_parts(two_tier_second_pass(
-                    segs, ks, los, cnts, oidx, queries
-                )), "space")
-
-                # globalize the per-batch-shard selection indices
-                m_local = queries[0].shape[0]
-                goidx = oidx + jnp.int32(
-                    jax.lax.axis_index("batch") * m_local
-                )
-                return (tgt1, tgt2, over, goidx, ovalid,
-                        n_over.reshape(1))
-
-            matched2 = jax.shard_map(
-                local2, mesh=mesh, in_specs=in_specs,
+            matched_csr = jax.shard_map(
+                local_csr, mesh=mesh, in_specs=in_specs,
                 out_specs=(
-                    P("batch", None), P("batch", None), P("batch"),
-                    P("batch"), P("batch"), P("batch"),
+                    P("batch", None), P("batch"), P("batch"),
                 ),
             )
 
             def fn(*args):
-                tgt1, tgt2, over, goidx, ovalid, n_over = matched2(*args)
-                # each batch shard has its own h_cap slot budget — the
-                # retry sentinel triggers on the worst shard
-                return _merge_two_tier_csr(
-                    tgt1, tgt2, over, goidx, ovalid, n_over.max(),
-                    h_cap, t_cap,
+                counts, flat, totals = matched_csr(*args)
+                # any shard overflowing its local budget triggers the
+                # global retry sentinel
+                total = jnp.where(
+                    (totals > t_cap_local).any(),
+                    jnp.int32(extra + 1),
+                    totals.sum(dtype=jnp.int32),
                 )
+                return counts, flat, total
         else:
             matched = jax.shard_map(
                 local, mesh=mesh, in_specs=in_specs,
@@ -482,12 +462,9 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             )
             if variant == "dense":
                 fn = matched
-            elif variant == "sparse":
-                def fn(*args):
-                    return compact_sparse(matched(*args), c=extra)
             else:
                 def fn(*args):
-                    return compact_csr(matched(*args), t_cap=extra)
+                    return compact_sparse(matched(*args), c=extra)
 
         in_shardings = tuple(
             NamedSharding(mesh, spec) for spec in in_specs
@@ -513,12 +490,31 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
-        if max(ks) <= self.CSR_K_LO:
-            return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
-        # hot-cube index: two-tier gather on the mesh (overflow slots
-        # budgeted per batch shard)
-        extra = (t_cap, self._csr_h_cap(t_cap), self.CSR_K_LO)
-        return self._kernel("csr2", kinds, ks, extra)(*flat, *queries)
+        # keep every batch shard's region a whole number of CSR rows
+        t_cap = max(t_cap, self.n_batch * CSR_ROW * 8)
+        return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
+
+    def _decode_csr(self, counts, flat, m: int):
+        """The mesh flat result is per-batch-shard regions of
+        ``t_cap // n_batch`` slots concatenated; walk each shard's
+        queries against its own region. The dense-fallback layout
+        (counts.ndim == 1) is host-built and global — no regions."""
+        if counts.ndim == 1:
+            return super()._decode_csr(counts, flat, m)
+        nb = self.n_batch
+        t_cap_local = len(flat) // nb
+        m_local = counts.shape[0] // nb
+        out: list = []
+        for b in range(nb):
+            if len(out) >= m:
+                break
+            sub = super()._decode_csr(
+                counts[b * m_local:(b + 1) * m_local],
+                flat[b * t_cap_local:(b + 1) * t_cap_local],
+                min(m_local, m - len(out)),
+            )
+            out.extend(sub)
+        return out
 
     # endregion
 

@@ -254,6 +254,7 @@ def deserialize_message(buf: bytes | bytearray | memoryview) -> Message:
             entities=_read_obj_vector(table, _MSG_ENTITIES, Entity),
             position=_read_vec3d(table, _MSG_POSITION),
             flex=_read_bytes(table, _MSG_FLEX),
+            wire=buf,
         )
     except DeserializeError:
         raise

@@ -146,6 +146,7 @@ class NativeCodec:
                 Vector3(msg.x, msg.y, msg.z) if msg.has_pos else None
             ),
             flex=_view(msg.flex, msg.flex_len),
+            wire=data,
         )
 
     @staticmethod

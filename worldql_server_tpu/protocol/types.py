@@ -135,7 +135,17 @@ class Message:
     entities: list[Entity] = field(default_factory=list)
     position: Vector3 | None = None
     flex: bytes | None = None
+    #: inbound wire bytes this Message was decoded from (set by the
+    #: decoder; excluded from equality). Fan-out paths that re-broadcast
+    #: a message VERBATIM (LocalMessage — the reference re-serializes
+    #: the identical struct, message.rs:120-134) reuse these bytes and
+    #: skip the encoder entirely. Never set on mutated/constructed
+    #: messages; ``with_`` clears it.
+    wire: bytes | None = field(default=None, compare=False, repr=False)
 
     def with_(self, **kwargs) -> "Message":
-        """Copy with replacements (Rust struct-update syntax analog)."""
+        """Copy with replacements (Rust struct-update syntax analog).
+        The copy never inherits ``wire`` — it no longer matches the
+        mutated content unless explicitly re-set."""
+        kwargs.setdefault("wire", None)
         return replace(self, **kwargs)

@@ -32,14 +32,15 @@ answering (and mutating); removals that touch snapshot rows are logged
 as (key, peer) pairs and replayed against the new base at swap time,
 so the swap itself is O(replay) on the owning thread.
 
-A query is two binary searches per segment (``searchsorted``
-left/right) giving the contiguous run of subscribers of its cube, an
-exactness check of (world, cube) against the run's first row, a
-fixed-degree-K gather of peer ids, and a replication mask — all fused
-by XLA into one kernel launch for the whole batch. K is the max cube
-occupancy per segment, rounded to a power of two; segment and query
-capacities are power-of-two tiers so the number of compiled shapes
-stays logarithmic.
+A query resolves its cube's contiguous subscriber run per segment via
+ONE packed bucket-probe row gather (probe_tables; binary search is the
+per-segment fallback), verifies exactness against the second key
+family, and the batch's CSR result assembles straight from those run
+windows (match_run_csr) — row gathers and index scans only, no data
+scatter, no per-query gather-degree bound. The dense [M, K] path
+(match_core; K = max cube occupancy, power-of-two) remains for the
+overflow fallback and parity tests. Segment and query capacities are
+power-of-two tiers so the number of compiled shapes stays logarithmic.
 
 Quantization always runs host-side in numpy f64 (golden semantics,
 cube_area.rs:23-44); the device only ever compares integer labels, so
@@ -82,54 +83,29 @@ _XYZ_PAD = np.int64(-(2 ** 62))
 # Device kernels
 # --------------------------------------------------------------------
 
-#: slots per probe-table bucket — one bucket row is a 64-byte gather
-#: (the TPU's sweet spot: an [M, 8] i64 row gather costs about the same
-#: as an [M] scalar gather, measured on v5e)
+#: slots per probe-table bucket — one bucket row is one aligned row
+#: gather, and row-gather cost is pure BYTES on v5e (an [M, 16] i32 row
+#: gather costs ~half an [M, 16] i64 one, measured)
 PROBE_E = 8
-#: primary-level bucket-count ceiling: beyond this the two-level table
-#: would exceed ~72 MB; past the cap the load factor rises and cubes
-#: spill to the second level (and, last, to binary search) — correctness
-#: never depends on the table fitting
-PROBE_MAX_BUCKETS = 1 << 19
-#: seeds folding the two bucket hashes away from the key hash families
-#: (and from each other — a cube that overflows its level-1 bucket must
-#: land in an independent level-2 bucket)
+#: bucket-count ceiling: at the cap the packed table is
+#: 2^21 × 16 lanes × 4 B = 128 MB and the load factor at ~630K distinct
+#: cubes is ~0.3 cubes/bucket — bucket overflow is ~impossible, and
+#: correctness never depends on the table fitting (oflow routes the
+#: segment to binary search)
+PROBE_MAX_BUCKETS = 1 << 21
+#: seed folding the bucket hash away from both key hash families
 _PROBE_SEED = jnp.uint64(0xA0761D6478BD642F)
-_PROBE_SEED2 = jnp.uint64(0x8BB84B93962EACC9)
 
-SEG_ARRAYS = 7  # (key, key2, peer, run_rem, tbl_key, tbl_pay, oflow)
+SEG_ARRAYS = 6  # (key, key2, peer, run_rem, tbl, oflow)
 
 
 def probe_buckets_for(n_cubes: int) -> int:
-    """Primary bucket-count tier for a segment with ``n_cubes`` distinct
-    cubes: 2x headroom (load factor <= 0.5) against PROBE_E-slot buckets
-    keeps the expected spill per table below ~1e-3 cubes until the
-    bucket cap, and spilled cubes stay probeable via the second level —
-    only a cube overflowing BOTH levels (~never: the spill level is
-    nearly empty) routes its segment to binary search. At the cap the
-    primary load factor rises with n_cubes (~1.2 at 630K cubes: a few
-    spilled cubes, trivially absorbed by the 2^15-bucket spill level)."""
+    """Bucket-count tier for a segment with ``n_cubes`` distinct cubes:
+    2x headroom (load factor <= 0.5) against PROBE_E-slot buckets makes
+    bucket overflow ~never (Poisson tail at λ<=0.5, e=8), and any
+    overflowing or tag-colliding build falls back to binary search for
+    the whole segment (oflow) — slower, never wrong."""
     return min(next_pow2(2 * max(n_cubes, 8)), PROBE_MAX_BUCKETS)
-
-
-def spill_buckets_for(n_buckets: int) -> int:
-    """Spill-level bucket count paired with a primary of ``n_buckets``.
-    Sized for the expected spill population (tens of cubes at worst
-    primary load), not the cube count."""
-    return max(n_buckets // 16, 16)
-
-
-def probe_split(total_rows: int) -> tuple[int, int]:
-    """Recover ``(n_buckets, n_spill)`` from a combined table's row
-    count. ``b + spill_buckets_for(b)`` is strictly increasing in b, so
-    the split is unambiguous; shapes are static under trace, so this
-    runs at trace time."""
-    b = 1 << (max(total_rows, 1).bit_length() - 1)
-    while b >= 1:
-        if b + spill_buckets_for(b) == total_rows:
-            return b, spill_buckets_for(b)
-        b >>= 1
-    raise ValueError(f"not a probe-table row count: {total_rows}")
 
 
 def _bucket_hash(keys, seed=_PROBE_SEED):
@@ -142,140 +118,121 @@ def _bucket_hash(keys, seed=_PROBE_SEED):
     return x ^ (x >> jnp.uint64(31))
 
 
-def probe_tables(sorted_keys, run_rem, *, n_buckets: int):
-    """Build the two-level bucket probe table for a sorted segment on
-    device.
+def probe_tables(sorted_keys, *, n_buckets: int):
+    """Build the single-level PACKED bucket probe table for a sorted
+    segment on device.
 
-    The table replaces the per-query binary search (20 dependent gather
-    rounds into a 1M-row segment, ~8 ms for a 16K batch on v5e) with
-    bucket-row gathers (~1.4 ms end-to-end run-bounds, verify gather and
-    cond dispatch included): each distinct cube's run start lands in
-    primary bucket ``hash1(key) & (B-1)``, at most PROBE_E entries per
-    bucket; cubes overflowing their primary bucket rehash with an
-    independent seed into ``B2 = spill_buckets_for(B)`` spill buckets
-    appended to the same array, so a hot bucket costs one extra row
-    gather instead of disabling the whole table. Returns
-    ``(tbl_key [B+B2, E], tbl_pay [B+B2, E], oflow [2])`` — ``tbl_pay``
-    packs ``(run_start << 31) | run_len``; ``oflow[0]`` counts cubes
-    that fit NEITHER level (queries then take the binary-search branch
-    of :func:`_seg_run_bounds`; ~never — the spill level is nearly
-    empty) and ``oflow[1]`` the spill-level population (0 for almost
-    every table: queries then skip the spill gather entirely).
+    ``tbl`` is [B, 2E] i32: each bucket row holds E key TAGS (the
+    top-32 bits of the 64-bit first-family key; pad 0) followed by E
+    run-start indices into the sorted segment (pad -1). A query
+    resolves its run with ONE [M, 2E] i32 row gather plus two [M]
+    element gathers (run remainder, second-key exactness) — vs two i64
+    row gathers per LEVEL plus a spill branch in the two-level layout
+    this replaces. Row-gather cost on v5e is pure gathered bytes
+    (micro-measured), so the packed i32 row costs ~half the old
+    primary level alone: run-bounds fell 2.03 → ~0.9 ms at 16K queries
+    against 1M rows.
 
-    Cost: two [S] argsorts + four scatters — amortized into the flush /
-    compaction launch that sorted the segment anyway.
+    Exactness contract: a probe hit proves tag (32 bits) + bucket
+    (log2 B bits of an independent mix of the same key) agreement, and
+    the caller's second-key gather proves 64 independent bits more. A
+    cube whose (bucket, tag) collides with a DIFFERENT cube — the one
+    case where the tag alone could mis-route a query to a wrong run —
+    is detected here at build time and routes the segment to the
+    binary-search fallback via ``oflow``, exactly like bucket
+    overflow: slower, never wrong.
+
+    Returns ``(tbl [B, 2E] i32, oflow [1] i32)`` — ``oflow[0]`` counts
+    cubes that overflowed their bucket's E slots or tag-collided
+    in-bucket (~never at load factor <= 0.5).
+
+    Cost: one [S] i64 argsort + two scatters — amortized into the
+    flush / compaction launch that sorted the segment anyway.
     """
     s = sorted_keys.shape[0]
     e = PROBE_E
-    n2 = spill_buckets_for(n_buckets)
-    total = (n_buckets + n2) * e
     idx = jnp.arange(s, dtype=jnp.int32)
     first = jnp.concatenate([
         jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]
     ]) & (sorted_keys != PAD_KEY)
 
-    def pack_level(bucket_rows, member, sentinel):
-        """Group ``member`` lanes by bucket row and assign slot ranks:
-        stable-sort by bucket (non-members to ``sentinel``), rank lanes
-        within their bucket run, and compute scatter slots — skipped
-        lanes get a DISTINCT out-of-bounds slot each, keeping the
-        unique_indices promise honest (mode="drop" ignores them).
-        Returns (order, slots, overflowed-lane mask in order-space)."""
-        bb = jnp.where(member, bucket_rows, jnp.int32(sentinel))
-        order = jnp.argsort(bb, stable=True)
-        sb = bb[order]
-        runstart = jnp.concatenate(
-            [jnp.ones((1,), bool), sb[1:] != sb[:-1]]
-        )
-        rank = idx - jax.lax.cummax(jnp.where(runstart, idx, 0))
-        in_level = sb < sentinel
-        fit = in_level & (rank < e)
-        slots = jnp.where(fit, sb * e + rank, total + idx)
-        return order, slots, in_level & (rank >= e)
-
     b = (_bucket_hash(sorted_keys) & jnp.uint64(n_buckets - 1)).astype(
-        jnp.int32
+        jnp.int64
     )
-    order, slot1, over1 = pack_level(b, first, n_buckets)
-    keys_o = sorted_keys[order]
-    pay_o = (order.astype(jnp.int64) << jnp.int64(31)) | run_rem[
-        order
-    ].astype(jnp.int64)
-
-    # spill level: overflowed cubes rehash into the appended rows
-    b2 = n_buckets + (
-        _bucket_hash(keys_o, _PROBE_SEED2) & jnp.uint64(n2 - 1)
-    ).astype(jnp.int32)
-    order2, slot2, over2 = pack_level(b2, over1, n_buckets + n2)
-    oflow = jnp.stack([
-        over2.sum(dtype=jnp.int32),
-        over1.sum(dtype=jnp.int32),
-    ])
-
-    # the two levels write disjoint row ranges, so the chained scatters
-    # cannot clobber each other
-    tk = (
-        jnp.full(total, PAD_KEY, jnp.int64)
-        .at[slot1].set(keys_o, mode="drop", unique_indices=True)
-        .at[slot2].set(keys_o[order2], mode="drop", unique_indices=True)
+    tag = (sorted_keys >> jnp.int64(32)).astype(jnp.int32)
+    # order run starts by (bucket, tag): bucket runs give slot ranks,
+    # and duplicate (bucket, tag) pairs land adjacent for detection
+    sentinel = jnp.int64(1) << jnp.int64(62)
+    comp = jnp.where(
+        first,
+        (b << jnp.int64(32))
+        | (tag.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)),
+        sentinel,
     )
-    tp = (
-        jnp.zeros(total, jnp.int64)
-        .at[slot1].set(pay_o, mode="drop", unique_indices=True)
-        .at[slot2].set(pay_o[order2], mode="drop", unique_indices=True)
+    order = jnp.argsort(comp, stable=True).astype(jnp.int32)
+    sc = comp[order]
+    member = sc < sentinel
+    dup = jnp.concatenate([
+        jnp.zeros((1,), bool), sc[1:] == sc[:-1]
+    ]) & member
+    sb = (sc >> jnp.int64(32)).astype(jnp.int32)
+    bstart = jnp.concatenate([jnp.ones((1,), bool), sb[1:] != sb[:-1]])
+    rank = idx - jax.lax.cummax(jnp.where(bstart, idx, 0))
+    fit = member & (rank < e) & ~dup
+    oflow = (member & ~fit).sum(dtype=jnp.int32)[None]
+
+    # skipped lanes get DISTINCT out-of-bounds slots, keeping the
+    # unique_indices promise honest (mode="drop" ignores them)
+    total = n_buckets * 2 * e
+    row0 = sb * (2 * e)
+    tag_slot = jnp.where(fit, row0 + rank, total + idx)
+    lo_slot = jnp.where(fit, row0 + e + rank, total + s + idx)
+    # init pattern per bucket: E tag lanes of 0, E lo lanes of -1 — a
+    # pad-tag false hit carries lo -1 and can never win the per-query
+    # max in _probe_run_bounds
+    init = jnp.tile(
+        jnp.concatenate([
+            jnp.zeros(e, jnp.int32), jnp.full(e, -1, jnp.int32)
+        ]),
+        n_buckets,
     )
-    rows = n_buckets + n2
-    return tk.reshape(rows, e), tp.reshape(rows, e), oflow
+    tbl = (
+        init
+        .at[tag_slot].set(tag[order], mode="drop", unique_indices=True)
+        .at[lo_slot].set(order, mode="drop", unique_indices=True)
+    )
+    return tbl.reshape(n_buckets, 2 * e), oflow
 
 
-def _probe_run_bounds(tbl_key, tbl_pay, sub_key2, q_key, q_key2, *,
-                      spill: bool):
-    """Per-query (run start, run length) via bucket-row gathers — one
-    row when the spill level is empty (``spill=False``, the common
-    case), primary + spill when it holds cubes.
-
-    A table hit proves first-key equality (the bucket stores the exact
-    64-bit key, and a cube lives in exactly one level); the second-key
-    exactness gather against the segment is unchanged from the
-    binary-search path, so the ~2^-128 mis-route contract holds
-    identically."""
+def _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2):
+    """Per-query (run start, run length) via ONE packed bucket-row
+    gather + the run-remainder and second-key element gathers. See
+    probe_tables for the exactness contract."""
     s = sub_key2.shape[0]
-    nb, n2 = probe_split(tbl_key.shape[0])
-    b1 = (_bucket_hash(q_key) & jnp.uint64(nb - 1)).astype(jnp.int32)
-    rk = jnp.take(tbl_key, b1, axis=0)  # [M, E] — one 64-byte row each
-    rp = jnp.take(tbl_pay, b1, axis=0)
-    if spill:
-        b2 = nb + (
-            _bucket_hash(q_key, _PROBE_SEED2) & jnp.uint64(n2 - 1)
-        ).astype(jnp.int32)
-        rk = jnp.concatenate([rk, jnp.take(tbl_key, b2, axis=0)], axis=1)
-        rp = jnp.concatenate([rp, jnp.take(tbl_pay, b2, axis=0)], axis=1)
-    hit = rk == q_key[:, None]          # <= 1 lane: keys unique per table
-    pay = jnp.where(hit, rp, 0).max(axis=1)
-    lo = (pay >> jnp.int64(31)).astype(jnp.int32)
-    rem = (pay & jnp.int64((1 << 31) - 1)).astype(jnp.int32)
-    li = jnp.minimum(lo, s - 1)
-    found = hit.any(axis=1) & (sub_key2[li] == q_key2)
-    return lo, jnp.where(found, rem, 0)
+    nb = tbl.shape[0]
+    e = tbl.shape[1] // 2
+    b = (_bucket_hash(q_key) & jnp.uint64(nb - 1)).astype(jnp.int32)
+    rows = jnp.take(tbl, b, axis=0)     # [M, 2E] i32 — one row gather
+    q_tag = (q_key >> jnp.int64(32)).astype(jnp.int32)
+    hit = rows[:, :e] == q_tag[:, None]
+    # <= 1 real lane can hit (build rejects in-bucket tag dups); pad
+    # lanes carry lo -1 and lose the max to any real run start
+    lo = jnp.where(hit, rows[:, e:], jnp.int32(-1)).max(axis=1)
+    li = jnp.clip(lo, 0, s - 1)
+    found = (lo >= 0) & (sub_key2[li] == q_key2)
+    return li, jnp.where(found, sub_rem[li], 0)
 
 
 def _seg_run_bounds(seg, q_key, q_key2):
-    """Run bounds for one 7-array segment: primary-only bucket probe
-    when the table built cleanly (almost always), primary+spill probe
-    when some cubes spilled, binary search when cubes fit neither level
-    (oflow[0] > 0). Both branch scalars live on device — no host sync
-    decides them."""
-    sub_key, sub_key2, _, sub_rem, tbl_key, tbl_pay, oflow = seg
-
-    def probe(spill: bool):
-        return lambda: _probe_run_bounds(
-            tbl_key, tbl_pay, sub_key2, q_key, q_key2, spill=spill
-        )
-
+    """Run bounds for one 6-array segment: packed bucket probe when the
+    table built cleanly (almost always), binary search when any cube
+    overflowed or tag-collided (oflow[0] > 0). The branch scalar lives
+    on device — no host sync decides it."""
+    sub_key, sub_key2, _, sub_rem, tbl, oflow = seg
     return jax.lax.cond(
         oflow[0] > 0,
         lambda: _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2),
-        lambda: jax.lax.cond(oflow[1] > 0, probe(True), probe(False)),
+        lambda: _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2),
     )
 
 
@@ -402,142 +359,221 @@ def compact_sparse(tgt, *, c: int):
     return rows.astype(jnp.int32), tgt[rows], nz.sum(dtype=jnp.int32)
 
 
-def compact_csr(tgt, *, t_cap: int):
-    """CSR compaction of a dense [M, K] target table: returns
-    ``(counts[M], flat[t_cap], total)`` — per-query fan-out counts and
-    all target peer ids concatenated in query order. This is the layout
-    the host needs to build per-peer frames, and it shrinks the
-    device→host result from M×K to ~total ints (the dominant cost on
-    the wire back). On ``total > t_cap`` overflow the tail is dropped;
-    callers detect via ``total`` and re-fetch dense."""
-    cnt = (tgt >= 0).sum(axis=1, dtype=jnp.int32)
-    starts = jnp.cumsum(cnt) - cnt  # exclusive prefix
-    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32)
-    flat = _csr_scatter(flat, tgt, starts,
-                        jnp.ones(tgt.shape[0], bool), t_cap)
-    return cnt, flat[:t_cap], cnt.sum(dtype=jnp.int32)
+#: CSR zone-A row width: one identity row of this many lanes per query
+CSR_ROW = 8
+#: CSR zone-B row width: hot-remainder regions pad to multiples of
+#: this. Wider rows amortize zone B's per-row metadata gather (the
+#: dominant Zipf-crowd cost — hot regions average hundreds of lanes)
+#: over 4x more output lanes at <= 31 pad slots per hot region.
+CSR_ROW_B = 32
 
 
-def _csr_scatter(flat, tgt, starts, row_live, t_cap):
-    """Scatter one tier's [R, K] targets into the CSR flat buffer at
-    ``starts[r] + position-among-valid``. ``row_live`` masks whole rows
-    (rows owned by the other tier scatter nothing).
-
-    Every lane gets a DISTINCT index — valid lanes their CSR slot,
-    skipped lanes a unique out-of-bounds slot (``mode="drop"``) — so
-    the scatter is honestly ``unique_indices`` and XLA lowers it
-    without collision handling: measured 3.2 → 1.4 ms for a 16K-query
-    merge on v5e vs the old clamp-to-shared-slot scatter-max."""
-    present = tgt >= 0
-    valid = present & row_live[:, None]
-    slot = jnp.cumsum(present, axis=1) - 1
-    lane = jnp.arange(tgt.size, dtype=jnp.int32).reshape(tgt.shape)
-    idx = jnp.where(valid, starts[:, None] + slot, t_cap + 1 + lane)
-    return flat.at[idx].set(
-        jnp.where(valid, tgt, -1), mode="drop", unique_indices=True
-    )
-
-
-def two_tier_first_pass(segs, ks, k_lo, queries):
-    """Tier 1 of the two-tier gather: per-segment run bounds + a
-    min(K, k_lo) gather for every query, and the raw overflow mask.
-    ``segs`` is a list of SEG_ARRAYS-tuples. Returns
-    ``(tgt1_parts, over, los, cnts)`` — the caller merges parts and
-    (on a mesh) unions the mask across shards before selection.
-
-    Padding queries never overflow: their key2 pad (QUERY_PAD_KEY2)
-    deliberately differs from the index rows' key2 pad, so a padding
-    query's probe of a segment's padding run fails the second-key
-    exactness check (shared by both run-bounds branches) and counts
-    as 0."""
-    q_key, q_key2, q_sender, q_repl = queries
-    los, cnts, parts = [], [], []
-    over = None
-    for seg, k in zip(segs, ks):
-        k_l = min(k, k_lo)
+def run_bounds_all(segs, queries):
+    """Per-segment (run start, RAW run length) for every query."""
+    q_key, q_key2 = queries[0], queries[1]
+    los, cnts = [], []
+    for seg in segs:
         lo, cnt = _seg_run_bounds(seg, q_key, q_key2)
         los.append(lo)
         cnts.append(cnt)
-        parts.append(_gather_filtered(
-            seg[2], lo, cnt, q_sender, q_repl, k=k_l
-        ))
-        seg_over = cnt > k_l
-        over = seg_over if over is None else over | seg_over
-    return parts, over, los, cnts
+    return los, cnts
 
 
-def two_tier_second_pass(segs, ks, los, cnts, oidx, queries):
-    """Tier 2: re-gather the selected (overflowing) queries at full K
-    per segment. Returns the per-segment target parts."""
-    _, _, q_sender, q_repl = queries
-    return [
-        _gather_filtered(
-            seg[2], lo[oidx], cnt[oidx],
-            q_sender[oidx], q_repl[oidx], k=k,
-        )
-        for seg, k, lo, cnt in zip(segs, ks, los, cnts)
-    ]
+def csr_layout(cnts, rows_cap, row_lanes=CSR_ROW_B):
+    """The row-padded zone-B layout from raw per-segment lengths:
+    query q's segment-s region occupies ``ceil(cnt / row_lanes)``
+    rows of ``row_lanes`` lanes at ``row_start[q, s]`` (q-major,
+    segment-minor). Returns ``(counts [M, nseg], row_start [M*nseg],
+    owner [rows_cap], total_rows)`` where ``owner[j]`` is the
+    flattened (q, s) slot that output row j belongs to — pure scans
+    plus ONE tiny index scatter, no data movement."""
+    counts = jnp.stack(cnts, axis=1)               # [M, nseg] raw
+    prows = ((counts + (row_lanes - 1)) // row_lanes).reshape(-1)
+    row_start = jnp.cumsum(prows) - prows          # [M*nseg]
+    total_rows = prows.sum(dtype=jnp.int32)
+    slot = jnp.arange(prows.shape[0], dtype=jnp.int32)
+    mark = jnp.where(prows > 0, row_start, rows_cap + 1 + slot)
+    owner = jax.lax.cummax(
+        jnp.zeros(rows_cap, jnp.int32)
+        .at[mark].max(slot, mode="drop")
+    )
+    return counts, row_start, owner, total_rows
 
 
-def _concat_parts(parts):
-    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+def match_run_csr(flat_args, nseg, t_cap):
+    """Fan-out CSR assembled STRAIGHT from the index's run windows.
 
+    Every query's targets are one contiguous slice of a segment's
+    sorted peer column, so the flat CSR result is a permutation of
+    window reads: per output row, gather 8 lanes starting at
+    ``run_start + 8 * block``. There is NO data scatter, no per-query
+    gather degree K, and no two-tier overflow machinery — a 2-member
+    cube and a 250-member Zipf crowd cost exactly their output size.
+    (This replaced a two-tier k_lo/h_cap design whose tier-2 dense
+    [hot, K] table and element scatters dominated the kernel: 71 ms →
+    ~4 ms at 16K Zipf queries on v5e.)
 
-def match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap):
-    """CSR fan-out with a two-tier gather: the gather degree K is set
-    by the HOTTEST cube in a segment, but almost every query's run is
-    tiny — a full-K gather pays the hot cube's cost for all M queries
-    (the dominant kernel cost under Zipf hotspots). Tier 1 gathers
-    min(K, k_lo) per segment for every query; the few queries whose raw
-    run overran k_lo are re-gathered at full K on an ``h_cap``-slot
-    tier. If more than h_cap queries overflow, ``total`` returns the
-    impossible value t_cap + 1 so the host retries with doubled
-    capacities (same contract as a flat-buffer overflow).
-
-    Returns ``(counts[M], flat[t_cap], total)`` like compact_csr."""
-    nseg = len(ks)
+    Layout/contract: ``counts [M, nseg]`` are RAW run lengths; query
+    q's segment-s region spans ``ceil(counts[q, s]/8)*8`` slots
+    (q-major, segment-minor), and within a region the device leaves
+    ``-1`` holes where a lane was tombstoned or replication-filtered
+    (local_message.rs:60-86) — consumers read ``counts[q, s]`` lanes
+    and keep the ``>= 0`` ones. ``total`` is the raw lane total, or
+    the impossible ``t_cap + 1`` when the padded layout overflows
+    ``t_cap`` (caller retries bigger, same contract as before)."""
     na = SEG_ARRAYS
     segs = [tuple(flat_args[na * i:na * i + na]) for i in range(nseg)]
     queries = flat_args[na * nseg:]
+    los, cnts = run_bounds_all(segs, queries)
+    return run_csr_assemble(segs, los, cnts, cnts, queries, t_cap)
 
-    parts, over, los, cnts = two_tier_first_pass(segs, ks, k_lo, queries)
-    tgt1 = _concat_parts(parts)
-    n_over = over.sum(dtype=jnp.int32)
 
-    # Overflow rows first (stable, so query order is kept within tiers)
-    oidx = jnp.argsort(~over, stable=True)[:h_cap].astype(jnp.int32)
-    ovalid = over[oidx]
-    tgt2 = _concat_parts(
-        two_tier_second_pass(segs, ks, los, cnts, oidx, queries)
-    )
-    return _merge_two_tier_csr(
-        tgt1, tgt2, over, oidx, ovalid, n_over, h_cap, t_cap
+def _repl_mask(vals, sender_col, repl_col):
+    """Replication filter lanes (local_message.rs:60-86)."""
+    is_sender = vals == sender_col
+    return jnp.where(
+        repl_col == int(_REPL_EXCEPT),
+        ~is_sender,
+        jnp.where(repl_col == int(_REPL_ONLY), is_sender, True),
     )
 
 
-def _merge_two_tier_csr(tgt1, tgt2, over, oidx, ovalid, n_over, h_cap, t_cap):
-    """Fold the two gather tiers into one CSR result. ``n_over`` is the
-    worst-case overflow-row count against the ``h_cap`` slot budget
-    (per selection domain — the sharded backend passes the max across
-    batch shards, since each shard has its own slot budget)."""
-    cnt1 = (tgt1 >= 0).sum(axis=1, dtype=jnp.int32)
-    cnt2 = (tgt2 >= 0).sum(axis=1, dtype=jnp.int32)
-    counts = jnp.where(over, 0, cnt1)
-    counts = counts.at[oidx].max(jnp.where(ovalid, cnt2, 0))
-    starts = jnp.cumsum(counts) - counts
+def zone_b_cnts(cnts):
+    """Zone-B raw lengths from per-segment raw lengths: segment 0's
+    first CSR row ships in zone A, the remainder (and every other
+    segment's full run) owner-maps into zone B."""
+    return [jnp.maximum(cnts[0] - CSR_ROW, 0)] + list(cnts[1:])
 
-    flat = jnp.full(t_cap + 1, -1, dtype=jnp.int32)
-    flat = _csr_scatter(flat, tgt1, starts, ~over, t_cap)
-    flat = _csr_scatter(flat, tgt2, starts[oidx], ovalid, t_cap)
 
+def run_csr_assemble(segs, los, cnts, cnts_local, queries, t_cap):
+    """The assembly core of :func:`match_run_csr`. ``cnts`` are the
+    GLOBAL raw run lengths defining the layout; ``cnts_local`` what
+    THIS device's segment columns actually hold (single-chip: the
+    same arrays; on a mesh each space shard passes its local counts,
+    so only the run's owning shard contributes lanes and a pmax merge
+    reassembles the flat result).
+
+    Two zones (the cost split that makes both crowd regimes cheap):
+
+    * **zone A** — rows [0, M): row q is query q's IDENTITY row,
+      holding the first ``min(cnt0, 8)`` lanes of its segment-0 run.
+      No owner map, no per-row metadata gathers — one window gather
+      plus elementwise masks. For a uniform crowd (runs almost always
+      <= 8) this zone is ~the whole result.
+    * **zone B** — rows [M, total): owner-mapped rows for segment 0
+      remainders past lane 8 and every other segment's runs. Pays the
+      per-row metadata gathers, but only hot rows exist here — under
+      a Zipf crowd this zone is ~the whole result and amortizes its
+      metadata over full 8-lane rows.
+    """
+    nseg = len(segs)
+    q_sender, q_repl = queries[2], queries[3]
+    m = q_sender.shape[0]
+    rows_cap_b = (t_cap - m * CSR_ROW) // CSR_ROW_B
+    assert rows_cap_b >= 1, "t_cap must cover the zone-A identity rows"
+    counts = jnp.stack(cnts, axis=1)               # [M, nseg] raw
+
+    # --- zone A: one identity row per query, segment 0 ---
+    offs8 = jnp.arange(CSR_ROW, dtype=jnp.int32)[None, :]
+    vals_a = _window_gather(segs[0][2], los[0], CSR_ROW)
+    valid_a = (
+        (offs8 < jnp.minimum(cnts[0], CSR_ROW)[:, None])
+        & (cnts_local[0] > 0)[:, None]
+        & (vals_a >= 0)
+        & _repl_mask(vals_a, q_sender[:, None], q_repl[:, None])
+    )
+    zone_a = jnp.where(valid_a, vals_a, -1)
+
+    # --- zone B: owner-mapped hot rows (CSR_ROW_B lanes each) ---
+    # All per-row metadata packs into TWO i64 slot columns, so a row
+    # costs two element gathers instead of six — the dominant zone-B
+    # cost on v5e is per-row gather latency, not lanes.
+    cnts_b = zone_b_cnts(cnts)
+    _, row_start, owner, total_rows_b = csr_layout(
+        cnts_b, rows_cap_b, CSR_ROW_B
+    )
+
+    def slotify(per_seg):
+        return jnp.stack(per_seg, axis=1).reshape(-1)
+
+    los_eff = [los[0] + CSR_ROW] + list(los[1:])  # seg-0 row 0 → zone A
+    own = [(cl > 0).astype(jnp.int64) for cl in cnts_local]
+    meta_a = (
+        slotify(los_eff).astype(jnp.int64)
+        | (slotify(cnts_b).astype(jnp.int64) << jnp.int64(31))
+        | (slotify(own) << jnp.int64(62))
+    )
+    sender_rep = [q_sender] * nseg
+    repl_rep = [q_repl.astype(jnp.int32)] * nseg
+    meta_b = (
+        row_start.astype(jnp.int64)
+        | ((slotify(sender_rep).astype(jnp.int64) + 1) << jnp.int64(25))
+        | (slotify(repl_rep).astype(jnp.int64) << jnp.int64(50))
+    )
+
+    j = jnp.arange(rows_cap_b, dtype=jnp.int32)
+    live_row = (j < total_rows_b)[:, None]
+    m_a = meta_a[owner]
+    m_b = meta_b[owner]
+    s_of = owner - (owner // nseg) * nseg
+    mask31 = jnp.int64((1 << 31) - 1)
+    mask25 = jnp.int64((1 << 25) - 1)
+    lo_row = (m_a & mask31).astype(jnp.int32)
+    cnt_row = ((m_a >> jnp.int64(31)) & mask31).astype(jnp.int32)
+    own_row = (m_a >> jnp.int64(62)) > 0
+    rs = (m_b & mask25).astype(jnp.int32)
+    sender_row = (((m_b >> jnp.int64(25)) & mask25)
+                  .astype(jnp.int32) - 1)[:, None]
+    repl_row = (m_b >> jnp.int64(50)).astype(jnp.int32)[:, None]
+    block = j - rs
+    offs = (block[:, None] * CSR_ROW_B
+            + jnp.arange(CSR_ROW_B, dtype=jnp.int32)[None, :])
+
+    zone_b = jnp.full((rows_cap_b, CSR_ROW_B), -1, jnp.int32)
+    for s, seg in enumerate(segs):
+        src = lo_row + block * CSR_ROW_B
+        vals = _window_gather(seg[2], src, CSR_ROW_B)
+        valid = (
+            (offs < cnt_row[:, None])
+            & own_row[:, None]                     # this shard owns it
+            & (vals >= 0)                          # tombstones
+            & (s_of == s)[:, None]
+            & live_row
+            & _repl_mask(vals, sender_row, repl_row)
+        )
+        zone_b = jnp.where(valid, vals, zone_b)
+
+    flat = jnp.concatenate([
+        zone_a.reshape(-1),
+        zone_b.reshape(-1),
+        jnp.full(t_cap - m * CSR_ROW - rows_cap_b * CSR_ROW_B, -1,
+                 jnp.int32),
+    ])
     total = counts.sum(dtype=jnp.int32)
-    total = jnp.where(n_over > h_cap, t_cap + 1, total)
-    return counts, flat[:t_cap], total
+    total = jnp.where(total_rows_b > rows_cap_b, t_cap + 1, total)
+    return counts, flat, total
 
 
-@partial(jax.jit, static_argnames=("ks", "k_lo", "h_cap", "t_cap"))
-def _match_csr2_kernel(*flat_args, ks, k_lo, h_cap, t_cap):
-    return match_two_tier_csr(flat_args, ks, k_lo, h_cap, t_cap)
+@partial(jax.jit, static_argnames=("nseg", "t_cap"))
+def _match_run_csr_kernel(*flat_args, nseg, t_cap):
+    return match_run_csr(flat_args, nseg, t_cap)
+
+
+def padded_slots(counts: np.ndarray) -> int:
+    """Host mirror of the zoned layout's flat-slot footprint for RAW
+    [M, nseg] counts: zone A is CSR_ROW per query, zone B rounds each
+    remainder/extra-segment run up to whole CSR_ROW_B rows."""
+    m = counts.shape[0]
+    rows = int(
+        ((np.maximum(counts[:, 0].astype(np.int64) - CSR_ROW, 0)
+          + CSR_ROW_B - 1) // CSR_ROW_B).sum()
+    )
+    for s in range(1, counts.shape[1]):
+        rows += int(
+            ((counts[:, s].astype(np.int64) + CSR_ROW_B - 1)
+             // CSR_ROW_B).sum()
+        )
+    return m * CSR_ROW + rows * CSR_ROW_B
 
 
 @partial(jax.jit, static_argnames=("ks",))
@@ -548,11 +584,6 @@ def _match_dense_kernel(*flat_args, ks):
 @partial(jax.jit, static_argnames=("ks", "c"))
 def _match_sparse_kernel(*flat_args, ks, c):
     return compact_sparse(_multi_match(flat_args, ks), c=c)
-
-
-@partial(jax.jit, static_argnames=("ks", "t_cap"))
-def _match_csr_kernel(*flat_args, ks, t_cap):
-    return compact_csr(_multi_match(flat_args, ks), t_cap=t_cap)
 
 
 @jax.jit
@@ -608,8 +639,8 @@ def _sort_segment_dev(keys, keys2, peers, n_buckets):
     order = jnp.argsort(keys, stable=True)
     sk = keys[order]
     rem = run_remainders(sk)
-    tk, tp, oflow = probe_tables(sk, rem, n_buckets=n_buckets)
-    return sk, keys2[order], peers[order], rem, tk, tp, oflow
+    tbl, oflow = probe_tables(sk, n_buckets=n_buckets)
+    return sk, keys2[order], peers[order], rem, tbl, oflow
 
 
 @partial(jax.jit, static_argnames=("cap2", "n_buckets"))
@@ -632,14 +663,14 @@ def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2, n_buckets):
     order = jnp.argsort(keys, stable=True)[:cap2]
     sk = keys[order]
     rem = run_remainders(sk)
-    tk, tp, oflow = probe_tables(sk, rem, n_buckets=n_buckets)
-    return sk, keys2[order], peers[order], rem, tk, tp, oflow
+    tbl, oflow = probe_tables(sk, n_buckets=n_buckets)
+    return sk, keys2[order], peers[order], rem, tbl, oflow
 
 
 @partial(jax.jit, static_argnames=("n_buckets",))
-def _probe_only_dev(sk, rem, n_buckets):
-    """Probe tables for an already-sorted uploaded segment."""
-    return probe_tables(sk, rem, n_buckets=n_buckets)
+def _probe_only_dev(sk, n_buckets):
+    """Probe table for an already-sorted uploaded segment."""
+    return probe_tables(sk, n_buckets=n_buckets)
 
 
 class _CollisionError(Exception):
@@ -675,15 +706,6 @@ class TpuSpatialBackend(SpatialBackend):
     #: treats it as wedged and abandons it — a hung device call must not
     #: let the delta log grow without bound
     COMPACT_STALL_SECS = 120.0
-    #: tier-1 gather degree for the CSR path: covers typical cube runs;
-    #: hotter runs re-gather at full K on the overflow tier. Measured on
-    #: v5e at 1M subs / 16K Zipf queries: overflow counts barely move
-    #: between 16 and 8 (751 → 801 — overflowing queries are hot cubes
-    #: far past either bound), while the tier-1 gather halves:
-    #: 4.5 → 3.4 ms full-kernel. 8 keeps uniform workloads (occupancy
-    #: ~ a handful) on the cheap tier.
-    CSR_K_LO = 8
-
     def __init__(self, cube_size: int, compact_threshold: int | None = None):
         super().__init__(cube_size)
         self._world_ids: dict[str, int] = {}
@@ -1829,15 +1851,15 @@ class TpuSpatialBackend(SpatialBackend):
         padded_keys = pad_to(keys, cap, PAD_KEY)
         sk = jnp.asarray(padded_keys)
         rem = jnp.asarray(run_remainders_np(padded_keys))
-        tk, tp, oflow = _probe_only_dev(
-            sk, rem, n_buckets=probe_buckets_for(n_distinct(keys))
+        tbl, oflow = _probe_only_dev(
+            sk, n_buckets=probe_buckets_for(n_distinct(keys))
         )
         return {
             "dev": (
                 sk,
                 jnp.asarray(pad_to(keys2, cap, np.int64(0))),
                 jnp.asarray(pad_to(pids.astype(np.int32), cap, np.int32(-1))),
-                rem, tk, tp, oflow,
+                rem, tbl, oflow,
             ),
             "cap": cap,
         }
@@ -1936,6 +1958,10 @@ class TpuSpatialBackend(SpatialBackend):
         device arrays. Shared by the array API and the server delivery
         path so the dispatch pipeline cannot drift between them."""
         if csr_cap is not None:
+            # zone A needs one identity row per (padded) query
+            csr_cap = max(
+                csr_cap, CSR_ROW * queries[0].shape[0] + 64
+            )
             result = self._dispatch_csr(
                 queries, segs, ks, kinds, next_pow2(csr_cap)
             )
@@ -1985,22 +2011,10 @@ class TpuSpatialBackend(SpatialBackend):
         flat = [a for seg in segs for a in seg]
         return _match_sparse_kernel(*flat, *queries, ks=ks, c=c)
 
-    @staticmethod
-    def _csr_h_cap(t_cap: int) -> int:
-        """Overflow-tier slot budget, sized off the result capacity so
-        the caller's capacity-doubling retry grows both together.
-        Shared by the single-chip and sharded dispatchers — the retry
-        contract must not drift between them."""
-        return max(64, t_cap // 64)
-
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
-        if max(ks) <= self.CSR_K_LO:
-            return _match_csr_kernel(*flat, *queries, ks=ks, t_cap=t_cap)
-        # hot-cube index: two-tier gather
-        return _match_csr2_kernel(
-            *flat, *queries, ks=ks, k_lo=self.CSR_K_LO,
-            h_cap=self._csr_h_cap(t_cap), t_cap=t_cap,
+        return _match_run_csr_kernel(
+            *flat, *queries, nseg=len(segs), t_cap=t_cap
         )
 
     def match_local_batch(
@@ -2049,7 +2063,11 @@ class TpuSpatialBackend(SpatialBackend):
         # clamped t_cap) always escapes instead of re-dispatching
         # forever.
         ceiling = next_pow2(m * sum(ks))
-        t_cap = next_pow2(max(self._delivery_cap, 2 * m))
+        t_cap = next_pow2(max(
+            self._delivery_cap,
+            # zone-A floor: one identity row per padded query
+            CSR_ROW * self._query_cap(m) + 64,
+        ))
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
             return (m, ("dense", tgt))
@@ -2072,15 +2090,15 @@ class TpuSpatialBackend(SpatialBackend):
             # inflation would park every batch on the dense ceiling
             # path forever
             self._adapt_delivery_cap(counts, grow=False)
-            return self._decode_csr(counts, flat)
+            return self._decode_csr(counts, flat, m)
         _, t_cap, (counts, flat, total), ctx = payload
         total = int(total)
         if total > t_cap:
-            # Rare: the tick's fan-out outgrew the hint (or the
-            # overflow tier) — re-resolve dense against the same index
-            # snapshot and raise the hint for future ticks. ``total``
-            # is exact unless it is the t_cap+1 overflow-tier sentinel,
-            # so convergence is one tick, not log2 doubling steps.
+            # Rare: the tick's fan-out outgrew the hint — re-resolve
+            # dense against the same index snapshot and raise the hint
+            # for future ticks. ``total`` is exact unless it is the
+            # t_cap+1 layout-overflow sentinel, so convergence is one
+            # tick, not log2 doubling steps.
             self._delivery_cap = max(
                 t_cap * 2 if total == t_cap + 1
                 else next_pow2(2 * total),
@@ -2088,36 +2106,80 @@ class TpuSpatialBackend(SpatialBackend):
             )
             qtuple, segs, ks, kinds = ctx
             tgt = np.asarray(self._dispatch(qtuple, segs, ks, kinds))[:m]
-            return self._decode_csr(*_dense_to_csr(tgt))
-        counts = np.asarray(counts)[:m]
+            return self._decode_csr(*_dense_to_csr(tgt), m)
+        # counts stays UNTRIMMED: padding queries resolve 0 rows, and
+        # the sharded decode needs the full padded layout to locate
+        # its per-batch-shard flat regions
+        counts = np.asarray(counts)
         self._adapt_delivery_cap(counts, grow=True)
-        return self._decode_csr(counts, np.asarray(flat))
+        return self._decode_csr(counts, np.asarray(flat), m)
 
     def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool) -> None:
-        """Track the capacity the observed tick actually needed: flat
-        slots for the total fan-out AND an overflow tier (t_cap // 64)
-        big enough for the hot-run rows — decaying below that would
-        oscillate between sentinel overflow and decay forever. Grows
+        """Track the capacity the observed tick actually needed. Grows
         immediately, decays by halves (one flash-crowd tick must not
         inflate every future tick's D2H)."""
-        total = int(counts.sum())
-        # filtered counts under-estimate raw run length; 128x (2x the
-        # h_cap divisor) leaves slack for that
-        n_hot = int((counts > self.CSR_K_LO).sum())
-        needed = next_pow2(max(2 * total, 128 * n_hot, 64))
+        # the footprint is the ZONED layout (match_run_csr) for raw
+        # [M, nseg] counts, or plain row padding for the dense
+        # fallback's exact [M] counts
+        if counts.ndim == 2:
+            padded = padded_slots(counts)
+        else:
+            padded = int(
+                ((counts + CSR_ROW - 1) // CSR_ROW).sum()
+            ) * CSR_ROW
+        needed = next_pow2(max(2 * padded, 64))
         if needed >= self._delivery_cap:
             if grow:
                 self._delivery_cap = needed
         else:
             self._delivery_cap = max(needed, self._delivery_cap // 2)
 
-    def _decode_csr(self, counts, flat) -> list[list[uuid_mod.UUID]]:
+    def _decode_csr(self, counts, flat, m: int) -> list[list[uuid_mod.UUID]]:
+        """Walk the CSR layout into per-query UUID lists.
+
+        Two layouts share the walk:
+        * ``counts.ndim == 2`` — match_run_csr's ZONED layout: RAW
+          [M, nseg] run lengths; query q's first up-to-8 segment-0
+          lanes sit in its zone-A identity row (``q * 8``), remainders
+          and other segments in q-major zone-B regions after
+          ``M * 8``. The device left ``-1`` holes for filtered lanes.
+        * ``counts.ndim == 1`` — exact counts from the dense fallback
+          (_dense_to_csr): hole-free, plain ``ceil(c/8)*8`` blocks.
+        """
         peer_list = self._peer_list
         out: list[list[uuid_mod.UUID]] = []
-        pos = 0
-        for c in counts:
-            out.append([peer_list[i] for i in flat[pos:pos + c]])
-            pos += c
+        if counts.ndim == 1:
+            pos = 0
+            for c in counts[:m]:
+                out.append([peer_list[i] for i in flat[pos:pos + c]])
+                pos += (c + CSR_ROW - 1) // CSR_ROW * CSR_ROW
+            return out
+        mq, nseg = counts.shape
+        base = mq * CSR_ROW
+        pos_b = 0
+        for q in range(min(m, mq)):
+            c0 = int(counts[q, 0])
+            lst = [
+                peer_list[i]
+                for i in flat[q * CSR_ROW:q * CSR_ROW + min(c0, CSR_ROW)]
+                if i >= 0
+            ]
+            if c0 > CSR_ROW:
+                r = c0 - CSR_ROW
+                at = base + pos_b * CSR_ROW_B
+                lst.extend(
+                    peer_list[i] for i in flat[at:at + r] if i >= 0
+                )
+                pos_b += (r + CSR_ROW_B - 1) // CSR_ROW_B
+            for s in range(1, nseg):
+                cs = int(counts[q, s])
+                if cs:
+                    at = base + pos_b * CSR_ROW_B
+                    lst.extend(
+                        peer_list[i] for i in flat[at:at + cs] if i >= 0
+                    )
+                    pos_b += (cs + CSR_ROW_B - 1) // CSR_ROW_B
+            out.append(lst)
         return out
 
     # endregion
@@ -2243,10 +2305,18 @@ def _sort_segment(keys, wids, xyz, pids):
 
 
 def _dense_to_csr(tgt: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Vectorized compaction of a dense [M, K] host table to CSR
-    (counts, flat) — touches only the real hits, not M*K cells."""
+    """Vectorized compaction of a dense [M, K] host table to the
+    row-padded CSR layout (_decode_csr's contract) — touches only the
+    real hits, not M*K cells."""
     mask = tgt >= 0
-    return mask.sum(axis=1), tgt[mask]
+    counts = mask.sum(axis=1).astype(np.int32)
+    prows = (counts + CSR_ROW - 1) // CSR_ROW
+    starts = (np.cumsum(prows) - prows) * CSR_ROW
+    flat = np.full(int(prows.sum()) * CSR_ROW, -1, np.int32)
+    rows = np.nonzero(mask)[0]
+    within = (np.cumsum(mask, axis=1) - 1)[mask]
+    flat[starts[rows] + within] = tgt[mask]
+    return counts, flat
 
 
 def run_remainders_np(sorted_keys: np.ndarray) -> np.ndarray:

@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import struct
 import uuid as uuid_mod
 
 from websockets.asyncio.server import serve
 from websockets.exceptions import ConnectionClosed
+from websockets.protocol import State
 
 from ..protocol import (
     DeserializeError,
@@ -28,23 +30,53 @@ from ..protocol import (
     deserialize_message,
     serialize_message,
 )
-from ..engine.peers import Peer
+from ..engine.peers import FramedPayload, Peer
 
 logger = logging.getLogger(__name__)
+
+#: transport write-buffer bound for the sync fast path. Below it,
+#: fan-out frames go straight to the asyncio transport buffer (TCP
+#: applies upstream backpressure); a peer that lets it grow past the
+#: bound is a dead-or-pathological consumer and is EVICTED — the
+#: reference's failed-send semantics (outgoing.rs:66-76; its zmq relay
+#: channel is likewise unbounded below failure). A mid-range buffer
+#: never triggers an awaited per-frame fallback: that path is ~10x
+#: slower and one slow peer would stall the whole tick's delivery.
+_WRITE_HARD_LIMIT = 8 << 20
+
+
+def ws_binary_frame(payload: bytes) -> bytes:
+    """A complete server→client binary frame (FIN, unmasked — RFC 6455
+    §5.2; servers MUST NOT mask). Identical bytes for every recipient,
+    which is what lets a broadcast frame once for all targets."""
+    n = len(payload)
+    if n < 126:
+        return struct.pack(">BB", 0x82, n) + payload
+    if n < 1 << 16:
+        return struct.pack(">BBH", 0x82, 126, n) + payload
+    return struct.pack(">BBQ", 0x82, 127, n) + payload
 
 
 class WebSocketTransport:
     def __init__(self, server):
         self.server = server
         self._ws_server = None
+        # strong refs to eviction tasks: the loop keeps only weak ones,
+        # and a GC'd task would silently skip the peer_map removal
+        self._evictions: set = set()
 
     async def start(self) -> None:
         config = self.server.config
+        # compression=None: the fan-out fast path writes raw frames
+        # below (uncompressed frames are always legal, but negotiating
+        # deflate would buy nothing and cost per-frame state), and
+        # FlatBuffers payloads don't compress usefully anyway
         self._ws_server = await serve(
             self._handle_connection,
             config.ws_host,
             config.ws_port,
             max_size=config.max_message_size,
+            compression=None,
         )
         logger.info(
             "WebSocket server listening on %s:%s", config.ws_host, config.ws_port
@@ -80,12 +112,69 @@ class WebSocketTransport:
                 logger.debug("peer %s did not complete handshake", addr)
                 return
 
+            def _writable() -> bool:
+                """OPEN + healthy buffer; a peer past the hard limit
+                is evicted (failed-send semantics, outgoing.rs:66-76)."""
+                transport = connection.transport
+                if (connection.state is not State.OPEN
+                        or transport is None or transport.is_closing()):
+                    return False
+                if transport.get_write_buffer_size() > _WRITE_HARD_LIMIT:
+                    logger.info(
+                        "[%s] write buffer over %d bytes — evicting",
+                        addr, _WRITE_HARD_LIMIT,
+                    )
+                    # abort() drops the buffered megabytes and closes
+                    # the socket NOW — the recv loop exits and its
+                    # finally runs the map removal too; the task makes
+                    # the removal prompt rather than
+                    # next-inbound-frame-delayed
+                    task = asyncio.get_running_loop().create_task(
+                        self.server.peer_map.remove(peer_uuid)
+                    )
+                    self._evictions.add(task)
+                    task.add_done_callback(self._evictions.discard)
+                    transport.abort()
+                    return False
+                return True
+
+            def try_write(framed: FramedPayload) -> bool:
+                """Sync fast path: hand the (shared) complete frame to
+                the asyncio transport buffer. Both this and the
+                library's ``send`` write whole frames atomically, so
+                the paths interleave safely."""
+                if not _writable():
+                    return False
+                frame = framed.cache.get("ws")
+                if frame is None:
+                    frame = ws_binary_frame(framed.payload)
+                    framed.cache["ws"] = frame
+                connection.transport.write(frame)
+                return True
+
+            def try_write_many(framed_list) -> bool:
+                """Whole per-tick outbox in ONE coalesced transport
+                write (``writelines`` — writev-style)."""
+                if not _writable():
+                    return False
+                frames = []
+                for framed in framed_list:
+                    frame = framed.cache.get("ws")
+                    if frame is None:
+                        frame = ws_binary_frame(framed.payload)
+                        framed.cache["ws"] = frame
+                    frames.append(frame)
+                connection.transport.writelines(frames)
+                return True
+
             peer = Peer(
                 uuid=peer_uuid,
                 addr=addr,
                 send_raw=connection.send,
                 kind="websocket",
                 tracks_heartbeat=False,
+                try_write=try_write,
+                try_write_many=try_write_many,
             )
             await self.server.peer_map.insert(peer)
             registered = True
