@@ -1001,7 +1001,8 @@ def _device_probes(tpu, batch, csr_cap: int, *, stages: bool = True,
                     los, cnts = run_bounds_all(seg_tuples, rolled)
                     counts, row_start, owner, total_rows = csr_layout(
                         zone_b_cnts(cnts),
-                        max((t_cap - mq * CSR_ROW) // CSR_ROW_B, 1),
+                        max((t_cap - mq * CSR_ROW * nseg) // CSR_ROW_B,
+                            1),
                         CSR_ROW_B,
                     )
                     fold = (

@@ -319,6 +319,33 @@ def test_sharded_capacity_overflow_signals_retry():
     assert csr_lists(b, counts, flat, m) == dense_lists(dense)
 
 
+def test_sharded_tiny_multiseg_tick_with_decayed_cap():
+    """A small multi-segment tick after the capacity hint decayed must
+    not trip the zone-A floor assert on any batch shard (the global
+    floor's slack is per-dispatch, each shard needs its own)."""
+    _require_devices(8)
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+    from worldql_server_tpu.parallel import make_fanout_mesh
+
+    mesh = make_fanout_mesh(4, 2)
+    b, sub_pos, peers = build_hot_cold_sharded(
+        mesh, hot_cubes=2, hot_occupancy=12, cold=40
+    )
+    for p in _peers(6, base=90_000):   # delta segment exists
+        b.add_subscription(W, p, (16 * 1, 16, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+    b._delivery_cap = 1                # decayed hint
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
+                   Replication.EXCEPT_SELF)
+        for i in range(5)
+    ]
+    got = b.match_local_batch(queries)
+    assert len(got) == 5 and all(len(g) >= 1 for g in got)
+
+
 def test_sparse_path_matches_dense():
     b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
     rng = np.random.default_rng(17)
@@ -347,14 +374,18 @@ def test_key1_collision_rejected_by_second_key():
 
     b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
     segs, ks, kinds = b._segments()
-    # craft queries aimed at REAL stored key1s with corrupted key2s
+    # craft queries aimed at REAL stored key1s with corrupted key2s —
+    # corrupting the TOP bits, which both the probe's 32-bit verify
+    # tag and the binary fallback's full compare reject (a real
+    # collision's key2 differs in all bits with overwhelming odds)
     stored_k1 = np.asarray(segs[0][0])[:8].copy()
     stored_k2 = np.asarray(segs[0][1])[:8].copy()
     m = len(stored_k1)
     cap = next_pow2(m)
     queries = (
         pad_to(stored_k1, cap, PAD_KEY),
-        pad_to(stored_k2 ^ np.int64(0x5A5A), cap, QUERY_PAD_KEY2),
+        pad_to(stored_k2 ^ (np.int64(0x5A5A) << np.int64(40)), cap,
+               QUERY_PAD_KEY2),
         pad_to(np.full(m, -1, np.int32), cap, np.int32(-1)),
         pad_to(np.zeros(m, np.int8), cap, np.int8(0)),
     )

@@ -56,24 +56,29 @@ def build_segment(rng, n_cubes=200, s_cap=1024, dead_frac=0.1):
 
 
 def make_queries(rng, keys, keys2, m=64, cap=128):
-    """Mix of hits, misses, and key2-corrupt probes."""
+    """Mix of hits, misses, and key2-corrupt probes. Corruption flips
+    TOP key2 bits: the probe's verify tag is key2's top-32 (the
+    binary fallback compares the full key2), so only top-bit
+    corruption is rejected by BOTH branches — which is what real
+    collisions look like (both families are independent hashes; a
+    wrong cube differs in all 64 bits with overwhelming odds)."""
     hit = rng.integers(0, len(keys), m)
     qk = keys[hit].copy()
     qk2 = keys2[hit].copy()
     miss = rng.random(m) < 0.3
     qk[miss] = rng.integers(-(2**62), 2**62, int(miss.sum()), dtype=np.int64)
     corrupt = (~miss) & (rng.random(m) < 0.2)
-    qk2[corrupt] ^= np.int64(0xDEAD)
+    qk2[corrupt] ^= np.int64(0xDEAD) << np.int64(36)
     return (
         jnp.asarray(pad_to(qk, cap, PAD_KEY)),
         jnp.asarray(pad_to(qk2, cap, QUERY_PAD_KEY2)),
     )
 
 
-def build_table(d_sk, n_buckets):
+def build_table(d_sk, d_sk2, n_buckets):
     return jax.jit(
         probe_tables, static_argnames=("n_buckets",)
-    )(d_sk, n_buckets=n_buckets)
+    )(d_sk, d_sk2, n_buckets=n_buckets)
 
 
 @pytest.mark.parametrize("n_cubes", [1, 7, 200])
@@ -82,11 +87,11 @@ def test_probe_matches_binary_search(n_cubes):
     d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, n_cubes)
     qk, qk2 = make_queries(rng, keys, keys2)
     nb = probe_buckets_for(n_cubes)
-    tbl, oflow = build_table(d_sk, nb)
+    tbl, oflow = build_table(d_sk, d_sk2, nb)
     assert int(oflow[0]) == 0, "healthy load factor must never overflow"
 
     lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
-    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tbl, d_sk2, rem, qk, qk2)
+    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tbl, rem, qk, qk2)
     cnt_ref = np.asarray(cnt_ref)
     found = cnt_ref > 0
     assert (np.asarray(cnt_p) == cnt_ref).all()
@@ -95,24 +100,26 @@ def test_probe_matches_binary_search(n_cubes):
 
 def test_table_stores_every_cube_once():
     rng = np.random.default_rng(3)
-    d_sk, _, _, rem, keys, _ = build_segment(rng, 150)
+    d_sk, d_sk2, _, rem, keys, keys2 = build_segment(rng, 150)
     nb = probe_buckets_for(150)
-    tbl, oflow = build_table(d_sk, nb)
+    tbl, oflow = build_table(d_sk, d_sk2, nb)
     assert int(oflow[0]) == 0
     t = np.asarray(tbl)
     e = PROBE_E
     sk_host = np.asarray(d_sk)
-    stored_tags = []
+    sk2_host = np.asarray(d_sk2)
+    stored = []
     for row in t:
-        tags, los = row[:e], row[e:]
-        for tag, lo in zip(tags, los):
+        tags, tags2, los = row[:e], row[e:2 * e], row[2 * e:]
+        for tag, tag2, lo in zip(tags, tags2, los):
             if lo < 0:
                 continue  # empty slot
-            stored_tags.append((int(tag), int(lo)))
-            # the slot's lo is a run START whose key matches the tag
+            stored.append((int(tag), int(lo)))
+            # the slot's lo is a run START whose keys match both tags
             assert (sk_host[lo] >> 32).astype(np.int32) == tag
+            assert (sk2_host[lo] >> 32).astype(np.int32) == tag2
             assert lo == 0 or sk_host[lo - 1] != sk_host[lo]
-    assert len(stored_tags) == len(set(keys.tolist()))
+    assert len(stored) == len(set(keys.tolist()))
 
 
 def test_overflow_falls_back_to_binary_search():
@@ -121,7 +128,7 @@ def test_overflow_falls_back_to_binary_search():
     is ever dropped."""
     rng = np.random.default_rng(9)
     d_sk, d_sk2, d_sp, rem, keys, keys2 = build_segment(rng, 200)
-    tbl, oflow = build_table(d_sk, 1)
+    tbl, oflow = build_table(d_sk, d_sk2, 1)
     n_unique = len(set(keys.tolist()))
     assert int(oflow[0]) >= n_unique - PROBE_E
     assert int(oflow[0]) > 0
@@ -145,14 +152,12 @@ def test_tag_collision_marks_overflow():
         [(7 << 32) | 1, (7 << 32) | 1, (7 << 32) | 9], dtype=np.int64
     )
     d_sk = jnp.asarray(pad_to(np.sort(keys), 64, PAD_KEY))
-    tbl, oflow = build_table(d_sk, 1)
-    assert int(oflow[0]) >= 1
-
-    # and the fallback still answers exactly
     keys2 = (
         np.sort(keys).view(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
     ).view(np.int64)
     d_sk2 = jnp.asarray(pad_to(keys2, 64, np.int64(0)))
+    tbl, oflow = build_table(d_sk, d_sk2, 1)
+    assert int(oflow[0]) >= 1
     d_sp = jnp.asarray(pad_to(np.arange(3, dtype=np.int32), 64,
                               np.int32(-1)))
     rem = jax.jit(run_remainders)(d_sk)
@@ -166,10 +171,11 @@ def test_tag_collision_marks_overflow():
 
 def test_empty_segment_all_pad():
     d_sk = jnp.full(64, PAD_KEY, jnp.int64)
-    tbl, oflow = build_table(d_sk, 8)
+    d_sk2 = jnp.zeros(64, jnp.int64)
+    tbl, oflow = build_table(d_sk, d_sk2, 8)
     assert int(oflow[0]) == 0
     e = PROBE_E
-    assert (np.asarray(tbl)[:, e:] == -1).all()  # every lo slot empty
+    assert (np.asarray(tbl)[:, 2 * e:] == -1).all()  # every lo slot empty
 
 
 def test_backend_segments_carry_probe_tables():

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..spatial.hashing import PAD_KEY, n_distinct, next_pow2, pad_to
 from ..spatial.tpu_backend import (
     CSR_ROW,
+    CSR_ROW_B,
     SEG_ARRAYS,
     TpuSpatialBackend,
     _alloc_buffers,
@@ -136,12 +137,13 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         )
         sub = self._sharding("space", None)
         sk = jax.device_put(padded_keys, sub)
+        sk2 = jax.device_put(stack(keys2, np.int64(0)), sub)
         rem = jax.device_put(rems, sub)
-        tbl, oflow = self._probe_stack(sk, probe_buckets_for(n_cubes))
+        tbl, oflow = self._probe_stack(sk, sk2, probe_buckets_for(n_cubes))
         return {
             "dev": (
                 sk,
-                jax.device_put(stack(keys2, np.int64(0)), sub),
+                sk2,
                 jax.device_put(stack(pids.astype(np.int32), np.int32(-1)),
                                sub),
                 rem, tbl, oflow,
@@ -151,7 +153,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             "shard_cap": cap,
         }
 
-    def _probe_stack(self, sk_stack, n_buckets: int):
+    def _probe_stack(self, sk_stack, sk2_stack, n_buckets: int):
         """Per-shard probe tables for a [n_space, cap] base stack —
         vmapped over the shard dim with matching shardings, so each
         device builds the table for its own rows locally."""
@@ -160,15 +162,20 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         if kernel is None:
             kernel = self._kernels[key] = jax.jit(
                 jax.vmap(
-                    lambda sk: probe_tables(sk, n_buckets=n_buckets)
+                    lambda sk, sk2: probe_tables(
+                        sk, sk2, n_buckets=n_buckets
+                    )
                 ),
-                in_shardings=(self._sharding("space", None),),
+                in_shardings=(
+                    self._sharding("space", None),
+                    self._sharding("space", None),
+                ),
                 out_shardings=(
                     self._sharding("space", None, None),
                     self._sharding("space", None),
                 ),
             )
-        return kernel(sk_stack)
+        return kernel(sk_stack, sk2_stack)
 
     #: re-shard (full re-upload) only when the largest shard exceeds
     #: this multiple of the mean — keys are uniform hashes, so the old
@@ -281,10 +288,10 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 peers = jnp.concatenate([bp, dpm])
                 order = jnp.argsort(keys, stable=True)[:cap2]
                 sk = keys[order]
+                sk2 = keys2[order]
                 rem = run_remainders(sk)
-                tbl_a, oflow = probe_tables(sk, n_buckets=n_buckets)
-                return (sk, keys2[order], peers[order], rem, tbl_a,
-                        oflow)
+                tbl_a, oflow = probe_tables(sk, sk2, n_buckets=n_buckets)
+                return (sk, sk2, peers[order], rem, tbl_a, oflow)
 
             sub = self._sharding("space", None)
             vec = self._sharding("space")
@@ -490,8 +497,14 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
-        # keep every batch shard's region a whole number of CSR rows
-        t_cap = max(t_cap, self.n_batch * CSR_ROW * 8)
+        # every batch shard's local region must cover its own zone-A
+        # identity rows PLUS at least one zone-B row — the base
+        # class's global floor divided by n_batch can land exactly on
+        # the zone-A size for small multi-segment ticks
+        m_local = queries[0].shape[0] // self.n_batch
+        need_local = (CSR_ROW * m_local * len(segs)
+                      + 2 * CSR_ROW_B)
+        t_cap = max(t_cap, next_pow2(self.n_batch * need_local))
         return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
 
     def _decode_csr(self, counts, flat, m: int):

@@ -118,35 +118,35 @@ def _bucket_hash(keys, seed=_PROBE_SEED):
     return x ^ (x >> jnp.uint64(31))
 
 
-def probe_tables(sorted_keys, *, n_buckets: int):
+def probe_tables(sorted_keys, sorted_keys2, *, n_buckets: int):
     """Build the single-level PACKED bucket probe table for a sorted
     segment on device.
 
-    ``tbl`` is [B, 2E] i32: each bucket row holds E key TAGS (the
-    top-32 bits of the 64-bit first-family key; pad 0) followed by E
-    run-start indices into the sorted segment (pad -1). A query
-    resolves its run with ONE [M, 2E] i32 row gather plus two [M]
-    element gathers (run remainder, second-key exactness) — vs two i64
-    row gathers per LEVEL plus a spill branch in the two-level layout
-    this replaces. Row-gather cost on v5e is pure gathered bytes
-    (micro-measured), so the packed i32 row costs ~half the old
-    primary level alone: run-bounds fell 2.03 → ~0.9 ms at 16K queries
-    against 1M rows.
+    ``tbl`` is [B, 3E] i32: each bucket row holds E first-key TAGS
+    (top-32 bits; pad 0), E second-family verify tags (top-32 bits of
+    key2), and E run-start indices into the sorted segment (pad -1).
+    A query resolves its run with ONE [M, 3E] i32 row gather plus one
+    [M] i32 run-remainder element gather — the second-family
+    verification rides the same row, so no separate i64 exactness
+    gather runs (measured −0.3 ms at 16K queries, −14 ms at the 1M
+    batch on v5e; row-gather cost is pure bytes).
 
-    Exactness contract: a probe hit proves tag (32 bits) + bucket
-    (log2 B bits of an independent mix of the same key) agreement, and
-    the caller's second-key gather proves 64 independent bits more. A
-    cube whose (bucket, tag) collides with a DIFFERENT cube — the one
-    case where the tag alone could mis-route a query to a wrong run —
-    is detected here at build time and routes the segment to the
-    binary-search fallback via ``oflow``, exactly like bucket
-    overflow: slower, never wrong.
+    Exactness contract: a probe hit proves bucket (log2 B bits of an
+    independent mix of key1) + key1 tag (32 bits) + key2 tag (32
+    independent bits) agreement — ~2^-85 odds of mis-routing a query
+    to a wrong run at B = 2^21 (the binary-search fallback verifies
+    the full key pair; both families are already hashes of the same
+    (world, cube), hashing.py). A cube whose (bucket, key1-tag)
+    collides with a DIFFERENT cube — the case where the row alone
+    could pick the wrong lane — is detected here at build time and
+    routes the segment to the binary-search fallback via ``oflow``,
+    exactly like bucket overflow: slower, never wrong.
 
-    Returns ``(tbl [B, 2E] i32, oflow [1] i32)`` — ``oflow[0]`` counts
+    Returns ``(tbl [B, 3E] i32, oflow [1] i32)`` — ``oflow[0]`` counts
     cubes that overflowed their bucket's E slots or tag-collided
     in-bucket (~never at load factor <= 0.5).
 
-    Cost: one [S] i64 argsort + two scatters — amortized into the
+    Cost: one [S] i64 argsort + three scatters — amortized into the
     flush / compaction launch that sorted the segment anyway.
     """
     s = sorted_keys.shape[0]
@@ -160,6 +160,7 @@ def probe_tables(sorted_keys, *, n_buckets: int):
         jnp.int64
     )
     tag = (sorted_keys >> jnp.int64(32)).astype(jnp.int32)
+    tag2 = (sorted_keys2 >> jnp.int64(32)).astype(jnp.int32)
     # order run starts by (bucket, tag): bucket runs give slot ranks,
     # and duplicate (bucket, tag) pairs land adjacent for detection
     sentinel = jnp.int64(1) << jnp.int64(62)
@@ -183,43 +184,48 @@ def probe_tables(sorted_keys, *, n_buckets: int):
 
     # skipped lanes get DISTINCT out-of-bounds slots, keeping the
     # unique_indices promise honest (mode="drop" ignores them)
-    total = n_buckets * 2 * e
-    row0 = sb * (2 * e)
+    total = n_buckets * 3 * e
+    row0 = sb * (3 * e)
     tag_slot = jnp.where(fit, row0 + rank, total + idx)
-    lo_slot = jnp.where(fit, row0 + e + rank, total + s + idx)
-    # init pattern per bucket: E tag lanes of 0, E lo lanes of -1 — a
-    # pad-tag false hit carries lo -1 and can never win the per-query
-    # max in _probe_run_bounds
+    tag2_slot = jnp.where(fit, row0 + e + rank, total + s + idx)
+    lo_slot = jnp.where(fit, row0 + 2 * e + rank, total + 2 * s + idx)
+    # init pattern per bucket: E+E tag lanes of 0, E lo lanes of -1 —
+    # a pad-tag false hit carries lo -1 and can never win the
+    # per-query max in _probe_run_bounds
     init = jnp.tile(
         jnp.concatenate([
-            jnp.zeros(e, jnp.int32), jnp.full(e, -1, jnp.int32)
+            jnp.zeros(2 * e, jnp.int32), jnp.full(e, -1, jnp.int32)
         ]),
         n_buckets,
     )
     tbl = (
         init
         .at[tag_slot].set(tag[order], mode="drop", unique_indices=True)
+        .at[tag2_slot].set(tag2[order], mode="drop", unique_indices=True)
         .at[lo_slot].set(order, mode="drop", unique_indices=True)
     )
-    return tbl.reshape(n_buckets, 2 * e), oflow
+    return tbl.reshape(n_buckets, 3 * e), oflow
 
 
-def _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2):
+def _probe_run_bounds(tbl, sub_rem, q_key, q_key2):
     """Per-query (run start, run length) via ONE packed bucket-row
-    gather + the run-remainder and second-key element gathers. See
-    probe_tables for the exactness contract."""
-    s = sub_key2.shape[0]
+    gather + the run-remainder element gather. See probe_tables for
+    the exactness contract."""
+    s = sub_rem.shape[0]
     nb = tbl.shape[0]
-    e = tbl.shape[1] // 2
+    e = tbl.shape[1] // 3
     b = (_bucket_hash(q_key) & jnp.uint64(nb - 1)).astype(jnp.int32)
-    rows = jnp.take(tbl, b, axis=0)     # [M, 2E] i32 — one row gather
+    rows = jnp.take(tbl, b, axis=0)     # [M, 3E] i32 — one row gather
     q_tag = (q_key >> jnp.int64(32)).astype(jnp.int32)
-    hit = rows[:, :e] == q_tag[:, None]
-    # <= 1 real lane can hit (build rejects in-bucket tag dups); pad
-    # lanes carry lo -1 and lose the max to any real run start
-    lo = jnp.where(hit, rows[:, e:], jnp.int32(-1)).max(axis=1)
+    q_tag2 = (q_key2 >> jnp.int64(32)).astype(jnp.int32)
+    # <= 1 real lane can match on the key1 tag (build rejects in-bucket
+    # dups); the key2 tag rides the same row as the verify family. Pad
+    # lanes carry lo -1 and lose the max to any real run start.
+    hit = (rows[:, :e] == q_tag[:, None]) \
+        & (rows[:, e:2 * e] == q_tag2[:, None])
+    lo = jnp.where(hit, rows[:, 2 * e:], jnp.int32(-1)).max(axis=1)
     li = jnp.clip(lo, 0, s - 1)
-    found = (lo >= 0) & (sub_key2[li] == q_key2)
+    found = lo >= 0
     return li, jnp.where(found, sub_rem[li], 0)
 
 
@@ -232,7 +238,7 @@ def _seg_run_bounds(seg, q_key, q_key2):
     return jax.lax.cond(
         oflow[0] > 0,
         lambda: _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2),
-        lambda: _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2),
+        lambda: _probe_run_bounds(tbl, sub_rem, q_key, q_key2),
     )
 
 
@@ -439,10 +445,10 @@ def _repl_mask(vals, sender_col, repl_col):
 
 
 def zone_b_cnts(cnts):
-    """Zone-B raw lengths from per-segment raw lengths: segment 0's
-    first CSR row ships in zone A, the remainder (and every other
-    segment's full run) owner-maps into zone B."""
-    return [jnp.maximum(cnts[0] - CSR_ROW, 0)] + list(cnts[1:])
+    """Zone-B raw lengths from per-segment raw lengths: every
+    segment's first CSR row ships in a zone-A identity row, only the
+    remainders past lane 8 owner-map into zone B."""
+    return [jnp.maximum(c - CSR_ROW, 0) for c in cnts]
 
 
 def run_csr_assemble(segs, los, cnts, cnts_local, queries, t_cap):
@@ -455,34 +461,42 @@ def run_csr_assemble(segs, los, cnts, cnts_local, queries, t_cap):
 
     Two zones (the cost split that makes both crowd regimes cheap):
 
-    * **zone A** — rows [0, M): row q is query q's IDENTITY row,
-      holding the first ``min(cnt0, 8)`` lanes of its segment-0 run.
-      No owner map, no per-row metadata gathers — one window gather
-      plus elementwise masks. For a uniform crowd (runs almost always
-      <= 8) this zone is ~the whole result.
-    * **zone B** — rows [M, total): owner-mapped rows for segment 0
-      remainders past lane 8 and every other segment's runs. Pays the
-      per-row metadata gathers, but only hot rows exist here — under
-      a Zipf crowd this zone is ~the whole result and amortizes its
-      metadata over full 8-lane rows.
+    * **zone A** — one IDENTITY row per (query, segment): rows
+      [0, M*nseg), query-major, holding the first ``min(cnt, 8)``
+      lanes of that segment's run. No owner map, no per-row metadata
+      gathers — one window gather per segment plus elementwise masks.
+      Typical runs (uniform crowds, delta-segment churn) fit here
+      entirely.
+    * **zone B** — rows after zone A: owner-mapped CSR_ROW_B-lane
+      rows for remainders past lane 8. Pays two packed per-row
+      metadata gathers, but only hot rows exist here — under a Zipf
+      crowd this zone is ~the whole result and the wide rows amortize
+      the metadata.
     """
     nseg = len(segs)
     q_sender, q_repl = queries[2], queries[3]
     m = q_sender.shape[0]
-    rows_cap_b = (t_cap - m * CSR_ROW) // CSR_ROW_B
+    rows_cap_b = (t_cap - m * CSR_ROW * nseg) // CSR_ROW_B
     assert rows_cap_b >= 1, "t_cap must cover the zone-A identity rows"
     counts = jnp.stack(cnts, axis=1)               # [M, nseg] raw
 
-    # --- zone A: one identity row per query, segment 0 ---
+    # --- zone A: one identity row per (query, segment) ---
     offs8 = jnp.arange(CSR_ROW, dtype=jnp.int32)[None, :]
-    vals_a = _window_gather(segs[0][2], los[0], CSR_ROW)
-    valid_a = (
-        (offs8 < jnp.minimum(cnts[0], CSR_ROW)[:, None])
-        & (cnts_local[0] > 0)[:, None]
-        & (vals_a >= 0)
-        & _repl_mask(vals_a, q_sender[:, None], q_repl[:, None])
+    zone_a_parts = []
+    for s, seg in enumerate(segs):
+        vals_a = _window_gather(seg[2], los[s], CSR_ROW)
+        valid_a = (
+            (offs8 < jnp.minimum(cnts[s], CSR_ROW)[:, None])
+            & (cnts_local[s] > 0)[:, None]
+            & (vals_a >= 0)
+            & _repl_mask(vals_a, q_sender[:, None], q_repl[:, None])
+        )
+        zone_a_parts.append(jnp.where(valid_a, vals_a, -1))
+    # interleave query-major: row q*nseg + s
+    zone_a = (
+        zone_a_parts[0] if nseg == 1
+        else jnp.stack(zone_a_parts, axis=1).reshape(-1, CSR_ROW)
     )
-    zone_a = jnp.where(valid_a, vals_a, -1)
 
     # --- zone B: owner-mapped hot rows (CSR_ROW_B lanes each) ---
     # All per-row metadata packs into TWO i64 slot columns, so a row
@@ -496,7 +510,8 @@ def run_csr_assemble(segs, los, cnts, cnts_local, queries, t_cap):
     def slotify(per_seg):
         return jnp.stack(per_seg, axis=1).reshape(-1)
 
-    los_eff = [los[0] + CSR_ROW] + list(los[1:])  # seg-0 row 0 → zone A
+    # every segment's first row lives in zone A
+    los_eff = [lo + CSR_ROW for lo in los]
     own = [(cl > 0).astype(jnp.int64) for cl in cnts_local]
     meta_a = (
         slotify(los_eff).astype(jnp.int64)
@@ -546,8 +561,10 @@ def run_csr_assemble(segs, los, cnts, cnts_local, queries, t_cap):
     flat = jnp.concatenate([
         zone_a.reshape(-1),
         zone_b.reshape(-1),
-        jnp.full(t_cap - m * CSR_ROW - rows_cap_b * CSR_ROW_B, -1,
-                 jnp.int32),
+        jnp.full(
+            t_cap - m * CSR_ROW * nseg - rows_cap_b * CSR_ROW_B, -1,
+            jnp.int32,
+        ),
     ])
     total = counts.sum(dtype=jnp.int32)
     total = jnp.where(total_rows_b > rows_cap_b, t_cap + 1, total)
@@ -561,19 +578,12 @@ def _match_run_csr_kernel(*flat_args, nseg, t_cap):
 
 def padded_slots(counts: np.ndarray) -> int:
     """Host mirror of the zoned layout's flat-slot footprint for RAW
-    [M, nseg] counts: zone A is CSR_ROW per query, zone B rounds each
-    remainder/extra-segment run up to whole CSR_ROW_B rows."""
-    m = counts.shape[0]
-    rows = int(
-        ((np.maximum(counts[:, 0].astype(np.int64) - CSR_ROW, 0)
-          + CSR_ROW_B - 1) // CSR_ROW_B).sum()
-    )
-    for s in range(1, counts.shape[1]):
-        rows += int(
-            ((counts[:, s].astype(np.int64) + CSR_ROW_B - 1)
-             // CSR_ROW_B).sum()
-        )
-    return m * CSR_ROW + rows * CSR_ROW_B
+    [M, nseg] counts: zone A is CSR_ROW per (query, segment), zone B
+    rounds each past-lane-8 remainder up to whole CSR_ROW_B rows."""
+    m, nseg = counts.shape
+    rem = np.maximum(counts.astype(np.int64) - CSR_ROW, 0)
+    rows = int(((rem + CSR_ROW_B - 1) // CSR_ROW_B).sum())
+    return m * CSR_ROW * nseg + rows * CSR_ROW_B
 
 
 @partial(jax.jit, static_argnames=("ks",))
@@ -638,9 +648,10 @@ def _sort_segment_dev(keys, keys2, peers, n_buckets):
     mirror."""
     order = jnp.argsort(keys, stable=True)
     sk = keys[order]
+    sk2 = keys2[order]
     rem = run_remainders(sk)
-    tbl, oflow = probe_tables(sk, n_buckets=n_buckets)
-    return sk, keys2[order], peers[order], rem, tbl, oflow
+    tbl, oflow = probe_tables(sk, sk2, n_buckets=n_buckets)
+    return sk, sk2, peers[order], rem, tbl, oflow
 
 
 @partial(jax.jit, static_argnames=("cap2", "n_buckets"))
@@ -662,15 +673,16 @@ def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2, n_buckets):
     keys = jnp.where(peers < 0, PAD_KEY, keys)
     order = jnp.argsort(keys, stable=True)[:cap2]
     sk = keys[order]
+    sk2 = keys2[order]
     rem = run_remainders(sk)
-    tbl, oflow = probe_tables(sk, n_buckets=n_buckets)
-    return sk, keys2[order], peers[order], rem, tbl, oflow
+    tbl, oflow = probe_tables(sk, sk2, n_buckets=n_buckets)
+    return sk, sk2, peers[order], rem, tbl, oflow
 
 
 @partial(jax.jit, static_argnames=("n_buckets",))
-def _probe_only_dev(sk, n_buckets):
+def _probe_only_dev(sk, sk2, n_buckets):
     """Probe table for an already-sorted uploaded segment."""
-    return probe_tables(sk, n_buckets=n_buckets)
+    return probe_tables(sk, sk2, n_buckets=n_buckets)
 
 
 class _CollisionError(Exception):
@@ -1850,14 +1862,15 @@ class TpuSpatialBackend(SpatialBackend):
         cap = next_pow2(keys.size)
         padded_keys = pad_to(keys, cap, PAD_KEY)
         sk = jnp.asarray(padded_keys)
+        sk2 = jnp.asarray(pad_to(keys2, cap, np.int64(0)))
         rem = jnp.asarray(run_remainders_np(padded_keys))
         tbl, oflow = _probe_only_dev(
-            sk, n_buckets=probe_buckets_for(n_distinct(keys))
+            sk, sk2, n_buckets=probe_buckets_for(n_distinct(keys))
         )
         return {
             "dev": (
                 sk,
-                jnp.asarray(pad_to(keys2, cap, np.int64(0))),
+                sk2,
                 jnp.asarray(pad_to(pids.astype(np.int32), cap, np.int32(-1))),
                 rem, tbl, oflow,
             ),
@@ -1958,9 +1971,9 @@ class TpuSpatialBackend(SpatialBackend):
         device arrays. Shared by the array API and the server delivery
         path so the dispatch pipeline cannot drift between them."""
         if csr_cap is not None:
-            # zone A needs one identity row per (padded) query
+            # zone A needs one identity row per (padded query, segment)
             csr_cap = max(
-                csr_cap, CSR_ROW * queries[0].shape[0] + 64
+                csr_cap, CSR_ROW * queries[0].shape[0] * len(segs) + 64
             )
             result = self._dispatch_csr(
                 queries, segs, ks, kinds, next_pow2(csr_cap)
@@ -2065,8 +2078,8 @@ class TpuSpatialBackend(SpatialBackend):
         ceiling = next_pow2(m * sum(ks))
         t_cap = next_pow2(max(
             self._delivery_cap,
-            # zone-A floor: one identity row per padded query
-            CSR_ROW * self._query_cap(m) + 64,
+            # zone-A floor: one identity row per (padded query, segment)
+            CSR_ROW * self._query_cap(m) * len(segs) + 64,
         ))
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
@@ -2139,10 +2152,12 @@ class TpuSpatialBackend(SpatialBackend):
 
         Two layouts share the walk:
         * ``counts.ndim == 2`` — match_run_csr's ZONED layout: RAW
-          [M, nseg] run lengths; query q's first up-to-8 segment-0
-          lanes sit in its zone-A identity row (``q * 8``), remainders
-          and other segments in q-major zone-B regions after
-          ``M * 8``. The device left ``-1`` holes for filtered lanes.
+          [M, nseg] run lengths; each (query, segment)'s first
+          up-to-8 lanes sit in its zone-A identity row at
+          ``(q * nseg + s) * 8``, remainders past lane 8 in q-major
+          seg-minor zone-B regions (CSR_ROW_B-lane rows) after
+          ``M * 8 * nseg``. The device left ``-1`` holes for
+          filtered lanes.
         * ``counts.ndim == 1`` — exact counts from the dense fallback
           (_dense_to_csr): hole-free, plain ``ceil(c/8)*8`` blocks.
         """
@@ -2155,30 +2170,27 @@ class TpuSpatialBackend(SpatialBackend):
                 pos += (c + CSR_ROW - 1) // CSR_ROW * CSR_ROW
             return out
         mq, nseg = counts.shape
-        base = mq * CSR_ROW
+        base = mq * CSR_ROW * nseg
         pos_b = 0
         for q in range(min(m, mq)):
-            c0 = int(counts[q, 0])
-            lst = [
-                peer_list[i]
-                for i in flat[q * CSR_ROW:q * CSR_ROW + min(c0, CSR_ROW)]
-                if i >= 0
-            ]
-            if c0 > CSR_ROW:
-                r = c0 - CSR_ROW
-                at = base + pos_b * CSR_ROW_B
-                lst.extend(
-                    peer_list[i] for i in flat[at:at + r] if i >= 0
-                )
-                pos_b += (r + CSR_ROW_B - 1) // CSR_ROW_B
-            for s in range(1, nseg):
+            lst: list[uuid_mod.UUID] = []
+            for s in range(nseg):
                 cs = int(counts[q, s])
-                if cs:
+                if not cs:
+                    continue
+                at = (q * nseg + s) * CSR_ROW
+                lst.extend(
+                    peer_list[i]
+                    for i in flat[at:at + min(cs, CSR_ROW)]
+                    if i >= 0
+                )
+                if cs > CSR_ROW:
+                    r = cs - CSR_ROW
                     at = base + pos_b * CSR_ROW_B
                     lst.extend(
-                        peer_list[i] for i in flat[at:at + cs] if i >= 0
+                        peer_list[i] for i in flat[at:at + r] if i >= 0
                     )
-                    pos_b += (cs + CSR_ROW_B - 1) // CSR_ROW_B
+                    pos_b += (r + CSR_ROW_B - 1) // CSR_ROW_B
             out.append(lst)
         return out
 
