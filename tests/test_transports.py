@@ -86,6 +86,57 @@ def test_ws_handshake_and_local_message():
     assert run(scenario())
 
 
+def test_ws_hard_limit_evicts_saturated_peer():
+    """A peer whose transport write buffer exceeds the hard limit is
+    EVICTED on the next fast-path write (failed-send semantics,
+    outgoing.rs:66-76): removed from the PeerMap, socket aborted.
+    Driven deterministically by dropping the limit below zero so the
+    first delivery attempt registers as saturation — loopback kernel
+    buffers otherwise absorb tens of MB before the condition is real."""
+    import worldql_server_tpu.transports.websocket as ws_mod
+
+    async def scenario():
+        server = make_server(zmq_enabled=False, http_enabled=False)
+        await server.start()
+        old_limit = ws_mod._WRITE_HARD_LIMIT
+        try:
+            victim = await WsClient.connect(server.config.ws_port)
+            sender = await WsClient.connect(server.config.ws_port)
+            # connect() returns after SENDING the handshake echo; the
+            # server-side insert lands on a later loop turn
+            for _ in range(100):
+                if server.peer_map.size() == 2:
+                    break
+                await asyncio.sleep(0.01)
+            assert server.peer_map.size() == 2
+            pos = Vector3(5, 5, 5)
+            for c in (victim, sender):
+                await c.send(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    world_name="world", position=pos,
+                ))
+            await asyncio.sleep(0.05)
+            ws_mod._WRITE_HARD_LIMIT = -1  # every write = saturated
+            await sender.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="world", position=pos, parameter="boom",
+            ))
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if victim.uuid not in server.peer_map:
+                    break
+            assert victim.uuid not in server.peer_map, \
+                "saturated peer must be evicted"
+            # and its socket was aborted, not left half-open
+            await asyncio.wait_for(victim.connection.wait_closed(), timeout=5)
+        finally:
+            ws_mod._WRITE_HARD_LIMIT = old_limit
+            await server.stop()
+        return True
+
+    assert run(scenario())
+
+
 def test_ws_wrong_sender_uuid_disconnects():
     async def scenario():
         server = make_server(zmq_enabled=False, http_enabled=False)
