@@ -62,48 +62,68 @@ def pctl(samples_ms, q: float) -> float:
     return float(np.percentile(np.asarray(samples_ms), q))
 
 
-def chained_slope_ms(chained, args: tuple, reps_pair: tuple,
-                     *, max_reps: int = 4096) -> float:
-    """Per-iteration DEVICE time of a jitted chained loop: best-of-3
-    wall at two rep counts (first call per count excluded — compile),
-    then the slope. The fixed per-call overhead — link round trip,
-    dispatch, D2H of the scalar result — cancels in the difference;
-    only the per-iteration device work scales with reps. Single timing
-    discipline for EVERY device probe in this file.
+def chained_slopes_ms(chains: dict, args: tuple, reps_pair: tuple,
+                      *, max_reps: int = 4096) -> dict:
+    """Per-iteration DEVICE time of one or more jitted chained loops:
+    best-of-3 wall at two rep counts (first call per count excluded —
+    compile), then the slope. The fixed per-call overhead — link round
+    trip, dispatch, D2H of the scalar result — cancels in the
+    difference; only the per-iteration device work scales with reps.
+    Single timing discipline for EVERY device probe in this file.
+
+    When several chains are passed (the stage-attribution prefixes),
+    every sampling sweep times ALL of them round-robin at the same rep
+    count, so a link-congestion epoch inflates each chain's sample
+    alike and cancels in the stage DIFFERENCES. Timing the chains in
+    separate passes put them in different congestion epochs and made
+    the per-stage splits swing run to run — up to a zero-by-difference
+    artifact on the largest stage (VERDICT r4 weak #2).
 
     Three hard-won rules on this tunneled backend (all observed):
-    * ``chained`` takes a SALT as its first argument, folded into the
+    * each chain takes a SALT as its first argument, folded into the
       loop-carried state — identical dispatches are served from a
       relay cache in microseconds, so every timed call must differ;
     * the result is FETCHED (``int()``), never just
       ``block_until_ready`` — the axon client's block returns before
       the device finishes; only a D2H read truly synchronizes;
-    * if the hi-lo wall delta doesn't clear link jitter, the rep pair
-      escalates (×4) until it does or hits ``max_reps`` — a slope
-      inside the noise floor would otherwise clamp to a fake 0.
+    * if the hi-lo wall delta of the CHEAPEST chain doesn't clear link
+      jitter, the rep pair escalates (×4) until it does or hits
+      ``max_reps`` — a slope inside the noise floor would otherwise
+      clamp to a fake 0.
     """
     import jax.numpy as jnp
 
     salt_rng = np.random.default_rng(0xC0FFEE)
     jitter_floor_s = 0.08
 
-    def timed(reps: int) -> float:
-        int(chained(jnp.int32(1), *args, reps))  # compile
-        best = float("inf")
+    def timed_all(reps: int) -> dict:
+        for fn in chains.values():
+            int(fn(jnp.int32(1), *args, reps))  # compile
+        best = {name: float("inf") for name in chains}
         for _ in range(3):
-            salt = jnp.int32(salt_rng.integers(1, 1 << 20))
-            t0 = time.perf_counter()
-            int(chained(salt, *args, reps))
-            best = min(best, time.perf_counter() - t0)
+            for name, fn in chains.items():
+                salt = jnp.int32(salt_rng.integers(1, 1 << 20))
+                t0 = time.perf_counter()
+                int(fn(salt, *args, reps))
+                best[name] = min(best[name], time.perf_counter() - t0)
         return best
 
     lo, hi = reps_pair
-    t_lo, t_hi = timed(lo), timed(hi)
-    while t_hi - t_lo < jitter_floor_s and hi * 4 <= max_reps:
+    t_lo, t_hi = timed_all(lo), timed_all(hi)
+    while (min(t_hi[n] - t_lo[n] for n in chains) < jitter_floor_s
+           and hi * 4 <= max_reps):
         lo, t_lo = hi, t_hi
         hi *= 4
-        t_hi = timed(hi)
-    return (t_hi - t_lo) / (hi - lo) * 1e3
+        t_hi = timed_all(hi)
+    return {n: (t_hi[n] - t_lo[n]) / (hi - lo) * 1e3 for n in chains}
+
+
+def chained_slope_ms(chained, args: tuple, reps_pair: tuple,
+                     *, max_reps: int = 4096) -> float:
+    """Single-chain convenience wrapper over :func:`chained_slopes_ms`."""
+    return chained_slopes_ms(
+        {"_": chained}, args, reps_pair, max_reps=max_reps
+    )["_"]
 
 
 # --------------------------------------------------------------------
@@ -489,9 +509,16 @@ def _delivery_client_main(port, n_conns, group_base, group, rounds,
             pace = t0 + (r + 1) * round_interval - time.perf_counter()
             if pace > 0:
                 await asyncio.sleep(pace)
-        # wait for the delivery tail: done when the count stops moving
+        # wait for the delivery tail: done the moment the full expected
+        # count lands (groups never span processes, so this process
+        # knows its own total), else when the count stops moving for
+        # 2 s — a warm server can pause >0.5 s mid-flush (GC, tick
+        # stalls), and a short settle window mistook that pause for
+        # the end of the tail (observed: 85% delivery on a re-run in
+        # the same interpreter vs 100% fresh)
+        expected_here = len(clients) * (group - 1) * rounds
         settled = 0
-        while settled < 5:
+        while settled < 20 and state["count"] < expected_here:
             before = state["count"]
             await asyncio.sleep(0.1)
             settled = settled + 1 if state["count"] == before else 0
@@ -1029,24 +1056,33 @@ def _device_probes(tpu, batch, csr_cap: int, *, stages: bool = True,
             return acc
         return chained
 
-    def slope_ms(chained) -> float:
-        return chained_slope_ms(chained, (queries, flat_segs), reps_pair)
-
     # monotone clamp chain (0 <= bounds <= layout <= full): a
     # sub-jitter kernel (tiny quick-mode shapes) can produce
     # meaningless negative slopes, and the emitted stages must never
-    # sum past the total they attribute
-    full_ms = max(slope_ms(make_chained("full")), 0.0)
+    # sum past the total they attribute. All prefixes are timed
+    # INTERLEAVED (see chained_slopes_ms) so link drift cancels in the
+    # differences instead of masquerading as a stage.
     stage_ms = {}
     if stages:
-        bounds_ms = max(slope_ms(make_chained("bounds")), 0.0)
-        layout_ms = max(slope_ms(make_chained("layout")), bounds_ms)
-        full_ms = max(full_ms, layout_ms)
+        slopes = chained_slopes_ms(
+            {s: make_chained(s) for s in ("bounds", "layout", "full")},
+            (queries, flat_segs), reps_pair,
+        )
+        bounds_ms = max(slopes["bounds"], 0.0)
+        layout_ms = max(slopes["layout"], bounds_ms)
+        full_ms = max(slopes["full"], layout_ms)
         stage_ms = {
             "run_bounds_ms": round(bounds_ms, 4),
             "csr_layout_ms": round(layout_ms - bounds_ms, 4),
             "window_gather_ms": round(full_ms - layout_ms, 4),
         }
+    else:
+        full_ms = max(
+            chained_slope_ms(
+                make_chained("full"), (queries, flat_segs), reps_pair
+            ),
+            0.0,
+        )
     return pctl(rtts, 50), full_ms, stage_ms
 
 
