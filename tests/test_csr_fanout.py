@@ -166,6 +166,41 @@ def test_capacity_overflow_signals_retry():
     assert csr_lists(b, counts, flat, m) == dense_lists(dense)
 
 
+def test_chunked_assembly_boundaries():
+    """The zone-B assembly maps over fixed-size row blocks (a full
+    2^17 tier + a 2^14 tail tier). Shrink both tiers so tiny indexes
+    exercise every split shape — full-only, tail-only, both tiers,
+    and a partial final tail block — and pin CSR ≡ dense at each."""
+    import jax
+
+    import worldql_server_tpu.spatial.tpu_backend as tb
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=5, hot_occupancy=28)
+    rng = np.random.default_rng(23)
+    qidx = rng.integers(0, len(sub_pos), 140)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
+    want = dense_lists(b.match_arrays(*batch))
+
+    old = tb._ZONE_B_CHUNK, tb._ZONE_B_TAIL_CHUNK
+    try:
+        tb._ZONE_B_CHUNK, tb._ZONE_B_TAIL_CHUNK = 16, 4
+        # the jit kernel caches on (nseg, t_cap) and would replay
+        # traces made with the full-size tiers
+        jax.clear_caches()
+        # csr_cap hints sweep rows_cap_b across chunk boundaries:
+        # below one tail block, exact full blocks, full+tail, and a
+        # ragged final tail block
+        for cap in (2048, 3072, 4096, 6144, 8192):
+            m, res = b.match_arrays_async(*batch, csr_cap=cap)
+            counts, flat, total = res
+            if int(total) > cap:
+                continue          # undersized hint — retry contract
+            assert csr_lists(b, counts, flat, m) == want, cap
+    finally:
+        tb._ZONE_B_CHUNK, tb._ZONE_B_TAIL_CHUNK = old
+        jax.clear_caches()
+
+
 def test_raw_counts_exceed_filtered_lists():
     """counts are RAW run lengths: a sender inside a hot cube still
     counts itself in counts (its lane ships as a -1 hole under
