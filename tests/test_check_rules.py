@@ -1,0 +1,420 @@
+"""Unit tests for the project lint pass (tools/check).
+
+Each rule must (a) fire on a minimal repro of the hazard it encodes,
+(b) stay quiet on the idiom the codebase actually uses, and (c) honor
+the ``# wql: allow(<rule>)`` pragma. The repro snippets double as the
+rule catalog's executable documentation.
+"""
+
+import textwrap
+
+from tools.check import check_source
+
+
+def violations(src, relpath="worldql_server_tpu/some/module.py", select=None):
+    out = check_source(
+        textwrap.dedent(src), relpath, relpath,
+        select={select} if select else None,
+    )
+    return [(v.rule, v.line) for v in out]
+
+
+def rules_fired(src, **kw):
+    return {r for r, _ in violations(src, **kw)}
+
+
+# region: async-dangling-task
+
+
+def test_dangling_task_fires_on_discarded_create_task():
+    src = """
+    import asyncio
+
+    async def boot():
+        asyncio.create_task(sweeper())
+    """
+    assert rules_fired(src) == {"async-dangling-task"}
+
+
+def test_dangling_task_fires_on_loop_create_task_and_ensure_future():
+    src = """
+    import asyncio
+
+    async def boot(loop):
+        loop.create_task(sweeper())
+        asyncio.ensure_future(sweeper())
+    """
+    assert [r for r, _ in violations(src)] == [
+        "async-dangling-task", "async-dangling-task"
+    ]
+
+
+def test_dangling_task_quiet_when_retained_awaited_or_appended():
+    src = """
+    import asyncio
+
+    async def boot(self):
+        self._task = asyncio.create_task(sweeper())
+        self._tasks.append(asyncio.create_task(sweeper()))
+        task = asyncio.get_running_loop().create_task(evict())
+        self._evictions.add(task)
+        task.add_done_callback(self._evictions.discard)
+        await asyncio.create_task(sweeper())
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: async-suppress-await
+
+
+def test_suppress_await_fires():
+    src = """
+    import asyncio
+    import contextlib
+
+    async def drain(task):
+        with contextlib.suppress(Exception):
+            await task
+    """
+    assert rules_fired(src) == {"async-suppress-await"}
+
+
+def test_suppress_await_fires_on_bare_suppress_and_base_exception():
+    src = """
+    import asyncio
+    from contextlib import suppress
+
+    async def drain(task):
+        with suppress(BaseException):
+            await task
+    """
+    assert rules_fired(src) == {"async-suppress-await"}
+
+
+def test_suppress_quiet_without_await_or_with_shield_loop():
+    src = """
+    import asyncio
+    import contextlib
+
+    async def drain(task):
+        with contextlib.suppress(KeyError):
+            del CACHE["x"]
+        # the ticker's idiom: shield + re-await rides out repeated
+        # cancellation without ever suppressing it
+        while not task.done():
+            try:
+                await asyncio.shield(task)
+            except asyncio.CancelledError:
+                continue
+            except Exception:
+                break
+    """
+    assert rules_fired(src) == set()
+
+
+def test_suppress_await_ignores_nested_function_bodies():
+    src = """
+    import contextlib
+
+    async def outer(task):
+        with contextlib.suppress(Exception):
+            async def helper():
+                await task
+            register(helper)
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: async-blocking-call
+
+
+def test_blocking_call_fires_on_sleep_sqlite_subprocess():
+    src = """
+    import sqlite3
+    import subprocess
+    import time
+
+    async def handler():
+        time.sleep(1)
+        conn = sqlite3.connect("x.db")
+        subprocess.run(["ls"])
+    """
+    assert [r for r, _ in violations(src)] == ["async-blocking-call"] * 3
+
+
+def test_blocking_call_quiet_in_sync_fn_and_to_thread_worker():
+    src = """
+    import asyncio
+    import sqlite3
+    import time
+
+    def warm():
+        time.sleep(1)
+
+    async def init(self):
+        def _open():
+            return sqlite3.connect(self._path)
+
+        self._conn = await asyncio.to_thread(_open)
+        await asyncio.sleep(1)
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: jax-host-sync
+
+TICK_MODULE = "worldql_server_tpu/spatial/tpu_backend.py"
+OPS_MODULE = "worldql_server_tpu/ops/fused.py"
+
+
+def test_host_sync_fires_in_hot_function_of_tick_module():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            counts, flat, total = handle
+            total = int(total)
+            return np.asarray(flat)[:total]
+    """
+    assert violations(src, relpath=TICK_MODULE) == [
+        ("jax-host-sync", 7), ("jax-host-sync", 8)
+    ]
+
+
+def test_host_sync_fires_on_item_tolist_anywhere_in_ops():
+    src = """
+    def integrate(state):
+        energy = state.energy.item()
+        return state.rows.tolist(), energy
+    """
+    assert rules_fired(src, relpath=OPS_MODULE) == {"jax-host-sync"}
+
+
+def test_host_sync_quiet_outside_tick_modules_and_hot_functions():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            return np.asarray(handle)
+
+        def export_rows(self):
+            # maintenance path, not the tick path
+            return np.asarray(self._rows).tolist()
+    """
+    # same code: hot in the tick module, free elsewhere
+    assert rules_fired(src, relpath="worldql_server_tpu/storage/x.py") == set()
+    assert rules_fired(src, relpath=TICK_MODULE) == {"jax-host-sync"}
+    assert not any(
+        line > 6 for _, line in violations(src, relpath=TICK_MODULE)
+    ), "export_rows is not a hot-path function"
+
+
+def test_host_sync_pragma_allows_designated_collect_point():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            return np.asarray(handle)  # wql: allow(jax-host-sync)
+    """
+    assert rules_fired(src, relpath=TICK_MODULE) == set()
+
+
+# endregion
+
+# region: jax-jit-in-loop
+
+
+def test_jit_in_loop_fires_on_call_and_partial_and_decorator():
+    src = """
+    import jax
+    from functools import partial
+
+    def build(shapes):
+        kernels = []
+        for shape in shapes:
+            kernels.append(jax.jit(lambda x: x + shape))
+            slow = partial(jax.jit, static_argnames=("k",))(body)
+
+            @jax.jit
+            def per_iter(x):
+                return x * 2
+
+            kernels.append(per_iter)
+        return kernels
+    """
+    assert [r for r, _ in violations(src)] == ["jax-jit-in-loop"] * 3
+
+
+def test_jit_quiet_when_cached_by_static_config():
+    src = """
+    import jax
+
+    def _kernel(self, key):
+        kernel = self._kernels.get(key)
+        if kernel is None:
+            kernel = self._kernels[key] = jax.jit(self._make(key))
+        return kernel
+
+    def drive(self, batches):
+        for b in batches:
+            self._kernel(b.shape)(b)
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: jax-traced-branch
+
+
+def test_traced_branch_fires_on_if_over_traced_arg():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("k",))
+    def match(queries, k):
+        if queries.sum() > 0:
+            return queries * k
+        return queries
+    """
+    assert rules_fired(src) == {"jax-traced-branch"}
+
+
+def test_traced_branch_quiet_on_static_args_and_jnp_where():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("nseg", "t_cap"))
+    def assemble(counts, nseg, t_cap):
+        if nseg == 1:
+            return counts
+        total = jnp.where(counts > t_cap, t_cap + 1, counts)
+        return total
+
+    def plain(queries):
+        if queries:
+            return []
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: wire-mutable-buffer
+
+
+def test_mutable_wire_fires_on_unnormalized_buffer():
+    src = """
+    def decode(buf):
+        return Message(world_name="w", wire=buf)
+    """
+    assert rules_fired(src) == {"wire-mutable-buffer"}
+
+
+def test_mutable_wire_fires_on_attribute_assignment():
+    src = """
+    def attach(msg, view):
+        msg.wire = view
+    """
+    assert rules_fired(src) == {"wire-mutable-buffer"}
+
+
+def test_mutable_wire_quiet_on_bytes_normalization_paths():
+    src = """
+    def decode(buf):
+        buf = bytes(buf)
+        return Message(world_name="w", wire=buf)
+
+    def decode_native(data: bytes):
+        return Message(world_name="w", wire=data)
+
+    def reserialize(msg):
+        return Message(world_name="w", wire=serialize_message(msg))
+
+    def forward(msg, other):
+        msg.wire = other.wire
+    """
+    assert rules_fired(src) == set()
+
+
+# endregion
+
+# region: pragma + runner contract
+
+
+def test_pragma_suppresses_named_rule_only():
+    src = """
+    import asyncio
+
+    async def boot():
+        asyncio.create_task(a())  # wql: allow(async-dangling-task)
+        asyncio.create_task(b())  # wql: allow(jax-host-sync)
+    """
+    assert violations(src) == [("async-dangling-task", 6)]
+
+
+def test_pragma_applies_across_wrapped_call_lines():
+    src = """
+    import asyncio
+
+    async def boot():
+        asyncio.create_task(  # wql: allow(async-dangling-task)
+            sweeper()
+        )
+    """
+    assert violations(src) == []
+
+
+def test_select_runs_only_requested_rules():
+    src = """
+    import asyncio
+    import time
+
+    async def boot():
+        time.sleep(1)
+        asyncio.create_task(a())
+    """
+    assert rules_fired(src, select="async-blocking-call") == {
+        "async-blocking-call"
+    }
+
+
+def test_rule_catalog_has_at_least_seven_distinct_rules():
+    from tools.check import all_rules
+
+    names = {r.name for r in all_rules()}
+    assert len(names) >= 7
+    assert names == {
+        "async-dangling-task",
+        "async-suppress-await",
+        "async-blocking-call",
+        "jax-host-sync",
+        "jax-jit-in-loop",
+        "jax-traced-branch",
+        "wire-mutable-buffer",
+    }
+
+
+def test_cli_exit_codes(tmp_path):
+    from tools.check.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    assert main(["--select", "no-such-rule", str(good)]) == 2
+    assert main(["--list-rules"]) == 0

@@ -435,3 +435,71 @@ def test_key1_collision_rejected_by_second_key():
                   queries[2], queries[3])
     dense_ok = np.asarray(b._dispatch(queries_ok, segs, ks, kinds))[:m]
     assert (dense_ok >= 0).any()
+
+
+def test_sharded_between_caps_total_decodes_without_dense_reresolve():
+    """ADVICE r5 (parallel/sharded_backend.py): the sharded dispatch
+    used to raise t_cap above the value recorded in the payload, so a
+    tick whose total landed between the recorded cap and the kernel's
+    raised cap failed collect_local_batch's sentinel test and took a
+    spurious dense re-resolve EVERY tick. The per-shard floor now runs
+    through ``_csr_effective_cap`` before the payload records it: a
+    between-caps total must decode directly — no dense fallback — and
+    still match the dense result exactly."""
+    _require_devices(8)
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+    from worldql_server_tpu.spatial.hashing import next_pow2
+    from worldql_server_tpu.spatial.tpu_backend import CSR_ROW
+    from worldql_server_tpu.parallel import make_fanout_mesh
+
+    mesh = make_fanout_mesh(8, 1)  # batch-heavy: big per-shard floor
+    b, sub_pos, peers = build_hot_cold_sharded(
+        mesh, hot_cubes=16, hot_occupancy=40, cold=40
+    )
+    # hot delta segment in an UNQUERIED cube: nseg=2 and a fan-out
+    # ceiling high enough that the CSR path stays selected
+    for p in _peers(30, base=70_000):
+        b.add_subscription(W, p, (16 * 1, 16 * 50, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+
+    b._delivery_cap = 1  # decayed hint: the floors decide the cap
+    m = 16
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[h * 40]), uuid.uuid4(),
+                   Replication.EXCEPT_SELF)
+        for h in range(m)
+    ]
+
+    handle = b.dispatch_local_batch(queries)
+    _, payload = handle
+    assert payload[0] == "csr", "floors must not reach the dense ceiling"
+    recorded_cap = payload[1]
+    total = int(payload[2][2])
+    # the tick really sits in the between-caps band the bug covered:
+    # above the UNSHARDED floor the payload used to record ...
+    segs, ks, _ = b._segments()
+    base_floor = next_pow2(max(
+        b._delivery_cap, CSR_ROW * b._query_cap(m) * len(segs) + 64
+    ))
+    assert base_floor < total <= recorded_cap
+
+    calls: list[int] = []
+    real_dispatch = b._dispatch
+
+    def counting_dispatch(*args, **kwargs):
+        calls.append(1)
+        return real_dispatch(*args, **kwargs)
+
+    b._dispatch = counting_dispatch
+    got = b.collect_local_batch(handle)
+    b._dispatch = real_dispatch
+    assert calls == [], "between-caps total must not dense re-resolve"
+
+    # and the decoded fan-out is exactly the dense result
+    batch = query_batch(
+        b, [sub_pos[h * 40] for h in range(m)], [uuid.uuid4()] * m
+    )
+    want = dense_lists(b.match_arrays(*batch))
+    assert [sorted(b._peer_ids[u] for u in lst) for lst in got] == want

@@ -5,6 +5,10 @@ import json
 import urllib.request
 import uuid
 
+import pytest
+
+pytest.importorskip("websockets")  # the e2e flows drive a WS client
+
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.engine.metrics import Histogram, Metrics
 from worldql_server_tpu.engine.server import WorldQLServer

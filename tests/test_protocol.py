@@ -285,3 +285,45 @@ def test_serialize_is_reentrant():
     blobs = [serialize_message(m) for m in msgs]
     for m, blob in zip(msgs, blobs):
         assert deserialize_message(blob).world_name == m.world_name
+
+
+def test_decode_from_reused_bytearray_keeps_wire_immutable():
+    """ADVICE r5 (protocol/codec.py): a transport may hand the decoder
+    its reusable receive buffer. ``Message.wire`` is the serialize-once
+    broadcast cache — it must be snapshotted to immutable ``bytes`` so
+    reusing the buffer cannot corrupt frames already queued for other
+    peers, and frame concat (as ``ws_binary_frame`` does) cannot
+    TypeError on a memoryview."""
+    msg = Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        sender_uuid=uuid.uuid4(),
+        world_name="world",
+        position=Vector3(1.0, 2.0, 3.0),
+        parameter="payload",
+    )
+    wire = serialize_message(msg)
+
+    buf = bytearray(wire)
+    decoded = deserialize_message(buf)
+    assert type(decoded.wire) is bytes
+    frame = b"\x82" + decoded.wire  # ws-style concat must not TypeError
+
+    # transport reuses its receive buffer for the next inbound frame
+    for i in range(len(buf)):
+        buf[i] = 0xAA
+
+    # the decoded message re-broadcasts byte-identically
+    assert decoded.wire == wire
+    assert frame == b"\x82" + wire
+    again = deserialize_message(decoded.wire)
+    assert again.parameter == "payload"
+    assert again.world_name == "world"
+
+
+def test_decode_from_memoryview_is_snapshotted():
+    wire = serialize_message(Message(world_name="mv"))
+    backing = bytearray(wire)
+    decoded = deserialize_message(memoryview(backing))
+    assert type(decoded.wire) is bytes
+    backing[:] = b"\x00" * len(backing)
+    assert decoded.wire == wire

@@ -9,6 +9,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("websockets")  # driven by real WS clients
+
 from tests.client_util import WsClient, free_port
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.engine.server import WorldQLServer, build_backend

@@ -106,6 +106,7 @@ def test_server_restart_keeps_subscriptions(tmp_path):
     """e2e: subscribe over a real WebSocket, stop the server, boot a
     NEW server on the same snapshot path — fan-out works without
     re-subscribing."""
+    pytest.importorskip("websockets")
     from tests.client_util import WsClient, free_port
     from worldql_server_tpu.engine.config import Config
     from worldql_server_tpu.engine.server import WorldQLServer

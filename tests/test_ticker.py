@@ -207,3 +207,53 @@ def test_sender_disconnect_before_flush_is_safe():
         assert h.locals_for(a) == []
 
     run(scenario())
+
+
+def test_second_cancel_still_completes_inflight_delivery():
+    """ADVICE r5 (engine/ticker.py:130): the protective wait used
+    ``suppress(Exception)``, which does not cover CancelledError — a
+    SECOND cancellation during the protective await abandoned the wait
+    (and a bare ``await deliver_task`` would have cancelled the
+    delivery itself). The shield-and-re-await loop must ride out
+    repeated cancellations until the in-flight delivery lands."""
+
+    async def scenario():
+        h = Harness(CpuSpatialBackend, interval=60.0)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "m0")
+
+        started = asyncio.Event()
+        release = asyncio.Event()
+        real_deliver = h.peer_map.deliver_batch
+        delivered: list[int] = []
+
+        async def slow_deliver(pairs):
+            started.set()
+            await release.wait()
+            await real_deliver(pairs)
+            delivered.append(len(pairs))
+
+        h.peer_map.deliver_batch = slow_deliver
+        flush_task = asyncio.create_task(h.ticker.flush())
+        await started.wait()
+
+        flush_task.cancel()       # 1st: enters the protective wait
+        for _ in range(3):
+            await asyncio.sleep(0)
+        flush_task.cancel()       # 2nd: lands inside the protective wait
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert not flush_task.done()  # still guarding the delivery
+        release.set()
+
+        with pytest.raises(asyncio.CancelledError):
+            await flush_task
+        # the in-flight delivery completed exactly once, frames intact
+        assert delivered == [1]
+        assert [m.parameter for m in h.locals_for(b)] == ["m0"]
+
+    run(scenario())

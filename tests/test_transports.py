@@ -12,6 +12,8 @@ import uuid
 import aiohttp
 import pytest
 
+pytest.importorskip("websockets")  # WS transport is half this module
+
 from tests.client_util import WsClient, ZmqClient, free_port
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.engine.server import WorldQLServer

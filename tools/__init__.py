@@ -1,0 +1,1 @@
+"""Developer tooling for worldql-server-tpu (not shipped in the wheel)."""

@@ -1,0 +1,16 @@
+"""worldql-server-tpu project lint: codebase-aware static analysis.
+
+Run as ``python -m tools.check [paths...]``. See ``core.py`` for the
+rule registry and the ``# wql: allow(<rule>)`` pragma contract; the
+rule catalog is documented in README.md ("Static analysis &
+sanitizers").
+"""
+
+from .core import (  # noqa: F401
+    FileContext,
+    Rule,
+    Violation,
+    all_rules,
+    check_paths,
+    check_source,
+)

@@ -1,0 +1,63 @@
+"""CLI for the project lint pass.
+
+    python -m tools.check                    # lint the package
+    python -m tools.check worldql_server_tpu tests
+    python -m tools.check --list-rules
+    python -m tools.check --select jax-host-sync,async-dangling-task
+
+Exit status: 0 clean, 1 violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_rules, check_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="Project-specific static analysis for worldql-server-tpu.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["worldql_server_tpu"],
+        help="files or directories to lint (default: worldql_server_tpu)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule names to run (default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    rules = {r.name: r for r in all_rules()}
+    if args.list_rules:
+        for name in sorted(rules):
+            print(f"{name:24s} {rules[name].summary}")
+        return 0
+
+    select = {s.strip() for s in args.select.split(",") if s.strip()}
+    unknown = select - rules.keys()
+    if unknown:
+        print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+        return 2
+
+    violations = check_paths(args.paths, select=select or None)
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(
+            f"\n{len(violations)} violation(s). Intentional cases need an "
+            "auditable `# wql: allow(<rule>)` pragma on the flagged line.",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,189 @@
+"""Core of the project lint pass: rule registry, pragma handling, runner.
+
+Generic linters can't know that ``collect_local_batch`` is THE device
+sync point, that ``Message.wire`` frames are shared across transports,
+or that a ``contextlib.suppress(Exception)`` around an ``await`` is a
+cancellation trap — every rule here encodes one such project invariant
+(ADVICE rounds 1-5 are the provenance). Rules live in ``rules_*.py``
+modules; each is a pure function over one parsed file.
+
+Suppression is per-line and auditable: ``# wql: allow(<rule>[, <rule>])``
+on any line the flagged node spans. ``allow(*)`` silences every rule on
+that line — reserve it for generated code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+PRAGMA_RE = re.compile(r"#\s*wql:\s*allow\(([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    check: Callable[["FileContext"], Iterable[Violation]]
+
+
+@dataclass
+class FileContext:
+    """One parsed file plus everything a rule needs to judge it."""
+
+    path: str          # as reported in violations
+    relpath: str       # posix path used for module-scoped rules
+    tree: ast.Module
+    source: str
+    allow: dict[int, set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def from_source(cls, source: str, path: str, relpath: str | None = None):
+        tree = ast.parse(source, filename=path)
+        allow: dict[int, set[str]] = {}
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                allow[lineno] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+        return cls(
+            path=path,
+            relpath=(relpath if relpath is not None else path).replace("\\", "/"),
+            tree=tree,
+            source=source,
+            allow=allow,
+        )
+
+    def allowed(self, rule: str, node: ast.AST) -> bool:
+        """Pragma on any line the flagged node spans suppresses it."""
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for line in range(node.lineno, end + 1):
+            rules = self.allow.get(line)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+    def flag(self, rule: Rule, node: ast.AST, message: str) -> Iterator[Violation]:
+        if not self.allowed(rule.name, node):
+            yield Violation(
+                rule.name, self.path, node.lineno, node.col_offset, message
+            )
+
+
+# region: AST helpers shared by rule modules
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_shallow(nodes: Iterable[ast.AST]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    bodies — their code runs in a different (a)sync context."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def enclosing_functions(tree: ast.Module):
+    """Yield (func_node, parent_stack) for every function in the file."""
+    out = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, tuple(stack)))
+                visit(child, stack + [child])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+# endregion
+
+
+def all_rules() -> list[Rule]:
+    from . import rules_async, rules_jax, rules_wire
+
+    return [*rules_async.RULES, *rules_jax.RULES, *rules_wire.RULES]
+
+
+def check_source(
+    source: str, path: str, relpath: str | None = None,
+    select: set[str] | None = None,
+) -> list[Violation]:
+    ctx = FileContext.from_source(source, path, relpath=relpath)
+    out: list[Violation] = []
+    for rule in all_rules():
+        if select and rule.name not in select:
+            continue
+        out.extend(rule.check(ctx))
+    return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def iter_py_files(paths: list[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            yield from sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            yield p
+
+
+def check_paths(
+    paths: list[str], select: set[str] | None = None,
+) -> list[Violation]:
+    root = Path.cwd()
+    out: list[Violation] = []
+    for file in iter_py_files(paths):
+        try:
+            rel = file.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = file.as_posix()
+        try:
+            source = file.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as exc:
+            out.append(Violation("read-error", str(file), 1, 0, str(exc)))
+            continue
+        try:
+            out.extend(check_source(source, str(file), rel, select=select))
+        except SyntaxError as exc:
+            out.append(
+                Violation("syntax-error", str(file), exc.lineno or 1, 0, exc.msg)
+            )
+    return out

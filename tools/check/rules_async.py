@@ -1,0 +1,135 @@
+"""Asyncio hazard rules.
+
+The whole server runs on one event loop (engine/server.py), so each of
+these is a liveness bug, not a style nit: a GC'd fire-and-forget task
+silently stops sweeping peers, a blocking call stalls every transport
+at once, and a ``suppress`` around an ``await`` turns cancellation —
+the shutdown mechanism — into either a swallowed signal or an
+abandoned in-flight delivery (ADVICE r5, engine/ticker.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name, walk_shallow
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+#: blocking calls that must never run on the event loop thread —
+#: dotted-prefix match, so ``subprocess.run`` also catches
+#: ``subprocess.run(...).stdout`` call chains
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.getoutput": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.Popen": "use `await asyncio.create_subprocess_exec(...)`",
+    "sqlite3.connect": "open in a worker via `asyncio.to_thread(...)`",
+    "socket.create_connection": "use `loop.sock_connect`/`asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo(...)`",
+    "urllib.request.urlopen": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.get": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.post": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.request": "use an async HTTP client or `asyncio.to_thread`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.popen": "use `await asyncio.create_subprocess_shell(...)`",
+}
+
+
+def _is_task_spawn(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _TASK_SPAWNERS:
+        return True
+    return isinstance(func, ast.Name) and func.id in _TASK_SPAWNERS
+
+
+def _check_dangling_task(ctx: FileContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and _is_task_spawn(node.value)
+        ):
+            yield from ctx.flag(
+                DANGLING_TASK,
+                node.value,
+                "task reference discarded — the event loop holds only a "
+                "weak reference, so the task can be garbage-collected "
+                "mid-flight; retain it (e.g. add to a set and discard in "
+                "a done-callback) or await it",
+            )
+
+
+def _check_suppress_await(ctx: FileContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(
+            isinstance(item.context_expr, ast.Call)
+            and (
+                dotted_name(item.context_expr.func) in
+                ("contextlib.suppress", "suppress")
+            )
+            for item in node.items
+        ):
+            continue
+        for inner in walk_shallow(node.body):
+            if isinstance(inner, ast.Await):
+                yield from ctx.flag(
+                    SUPPRESS_AWAIT,
+                    node,
+                    "await inside contextlib.suppress(...) — a "
+                    "CancelledError raised at the await either escapes "
+                    "(suppress(Exception): the protective wait is "
+                    "abandoned) or is silently swallowed "
+                    "(suppress(BaseException): shutdown stalls); handle "
+                    "cancellation explicitly, e.g. re-await an "
+                    "asyncio.shield(...) in a loop",
+                )
+                break
+
+
+def _check_blocking_call(ctx: FileContext) -> Iterator[Violation]:
+    # collect every async function, then shallow-walk its body so calls
+    # in nested sync defs (to_thread workers) stay legal
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for inner in walk_shallow(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            name = dotted_name(inner.func)
+            if name is None:
+                continue
+            hint = _BLOCKING_CALLS.get(name)
+            if hint is not None:
+                yield from ctx.flag(
+                    BLOCKING_CALL,
+                    inner,
+                    f"blocking call `{name}` inside `async def "
+                    f"{node.name}` stalls the event loop (every "
+                    f"transport shares it); {hint}",
+                )
+
+
+DANGLING_TASK = Rule(
+    "async-dangling-task",
+    "fire-and-forget create_task/ensure_future whose handle is discarded",
+    _check_dangling_task,
+)
+SUPPRESS_AWAIT = Rule(
+    "async-suppress-await",
+    "await inside contextlib.suppress — cancellation trap",
+    _check_suppress_await,
+)
+BLOCKING_CALL = Rule(
+    "async-blocking-call",
+    "blocking call (time.sleep, sync sqlite, subprocess, ...) in async def",
+    _check_blocking_call,
+)
+
+RULES = [DANGLING_TASK, SUPPRESS_AWAIT, BLOCKING_CALL]

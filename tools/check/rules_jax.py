@@ -1,0 +1,241 @@
+"""JAX/TPU hazard rules for the tick path.
+
+ASH (arXiv:2110.00511) and TPU-KNN (arXiv:2206.14286) both make the
+same point about accelerator spatial indexes: the kernel is never the
+bottleneck — silent host syncs and recompilation storms are. These
+rules enforce that mechanically for this repo's hot modules:
+
+* ``spatial/tpu_backend.py`` and ``parallel/sharded_backend.py`` — the
+  per-tick dispatch/collect pipeline. Host syncs are legal only at the
+  designated collect points, which carry ``# wql: allow(jax-host-sync)``
+  pragmas so every device→host transfer on the tick path is auditable.
+* ``ops/*`` — pure device kernels; a host sync anywhere is a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name, walk_shallow
+
+#: modules whose hot-path FUNCTIONS are checked for host syncs
+_TICK_MODULES = ("spatial/tpu_backend.py", "parallel/sharded_backend.py")
+
+#: the per-tick dispatch/collect pipeline — the functions a LocalMessage
+#: batch flows through between the event loop and the device
+_HOT_FUNCTIONS = {
+    "dispatch_local_batch",
+    "collect_local_batch",
+    "match_local_batch",
+    "match_arrays",
+    "match_arrays_async",
+    "_launch",
+    "_dispatch",
+    "_dispatch_sparse",
+    "_dispatch_csr",
+    "_csr_effective_cap",
+    "_prepare_queries",
+    "_decode_csr",
+}
+
+_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_tick_module(relpath: str) -> bool:
+    return relpath.endswith(_TICK_MODULES)
+
+
+def _is_ops_module(relpath: str) -> bool:
+    return "/ops/" in relpath or relpath.startswith("ops/")
+
+
+def _host_sync_reason(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _SYNC_CALLS:
+        return f"`{name}(...)`"
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _SYNC_METHODS
+        and not call.args
+        and not call.keywords
+    ):
+        return f"`.{call.func.attr}()`"
+    if (
+        isinstance(call.func, ast.Name)
+        and call.func.id in ("int", "float", "bool")
+        and len(call.args) == 1
+        and not call.keywords
+        and isinstance(call.args[0], ast.Name)
+    ):
+        return f"`{call.func.id}({call.args[0].id})`"
+    return None
+
+
+def _check_host_sync(ctx: FileContext) -> Iterator[Violation]:
+    ops = _is_ops_module(ctx.relpath)
+    if not ops and not _is_tick_module(ctx.relpath):
+        return
+    if ops:
+        scopes: list[ast.AST] = [ctx.tree]
+    else:
+        scopes = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in _HOT_FUNCTIONS
+        ]
+    seen: set[ast.AST] = set()
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or node in seen:
+                continue
+            seen.add(node)
+            reason = _host_sync_reason(node)
+            if reason is not None:
+                where = (
+                    "a device kernel module" if ops
+                    else f"tick-path function `{getattr(scope, 'name', '?')}`"
+                )
+                yield from ctx.flag(
+                    HOST_SYNC,
+                    node,
+                    f"{reason} in {where} forces an implicit device→host "
+                    "sync, serializing the dispatch pipeline; keep the "
+                    "value on device, or mark the designated collect "
+                    "point with `# wql: allow(jax-host-sync)`",
+                )
+
+
+def _is_jax_jit_ref(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    if _is_jax_jit_ref(call.func):
+        return True
+    # functools.partial(jax.jit, ...)
+    return (
+        dotted_name(call.func) in ("partial", "functools.partial")
+        and bool(call.args)
+        and _is_jax_jit_ref(call.args[0])
+    )
+
+
+def _check_jit_in_loop(ctx: FileContext) -> Iterator[Violation]:
+    def visit(node: ast.AST, loop_depth: int) -> Iterator[Violation]:
+        in_loop = loop_depth > 0
+        if in_loop and isinstance(node, ast.Call) and _is_jit_call(node):
+            yield from ctx.flag(
+                JIT_IN_LOOP,
+                node,
+                "jax.jit called inside a loop — each iteration builds a "
+                "fresh jitted callable with an empty compile cache (a "
+                "retrace/recompile storm); hoist the jit out of the loop "
+                "or cache the kernel by its static config, as the "
+                "backends' `_kernels` dicts do",
+            )
+        if in_loop and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            for dec in node.decorator_list:
+                if (
+                    _is_jax_jit_ref(dec)
+                    or (isinstance(dec, ast.Call) and _is_jit_call(dec))
+                ):
+                    yield from ctx.flag(
+                        JIT_IN_LOOP,
+                        dec,
+                        "@jax.jit on a function defined inside a loop — "
+                        "the closure (and its compile cache) is rebuilt "
+                        "every iteration; define and jit it once outside",
+                    )
+        for child in ast.iter_child_nodes(node):
+            yield from visit(
+                child,
+                loop_depth
+                + isinstance(node, (ast.For, ast.AsyncFor, ast.While)),
+            )
+
+    yield from visit(ctx.tree, 0)
+
+
+def _jit_static_names(dec: ast.AST) -> set[str] | None:
+    """Static argnames if ``dec`` is a jit decorator, else None."""
+    if _is_jax_jit_ref(dec):
+        return set()
+    if not isinstance(dec, ast.Call) or not _is_jit_call(dec):
+        return None
+    out: set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            value = kw.value
+            elts = value.elts if isinstance(value, (ast.Tuple, ast.List)) else [value]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _check_traced_branch(ctx: FileContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        static: set[str] | None = None
+        for dec in node.decorator_list:
+            static = _jit_static_names(dec)
+            if static is not None:
+                break
+        if static is None:
+            continue
+        args = node.args
+        traced = {
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        } - static
+        if args.vararg is not None:
+            traced.add(args.vararg.arg)
+        for inner in walk_shallow(node.body):
+            if not isinstance(inner, (ast.If, ast.While)):
+                continue
+            names = {
+                n.id for n in ast.walk(inner.test) if isinstance(n, ast.Name)
+            }
+            hot = sorted(names & traced)
+            if hot:
+                yield from ctx.flag(
+                    TRACED_BRANCH,
+                    inner,
+                    f"Python `{'if' if isinstance(inner, ast.If) else 'while'}` "
+                    f"on traced argument(s) {', '.join(hot)} inside a "
+                    "@jax.jit function — this raises TracerBoolConversionError "
+                    "at trace time or silently bakes one branch into the "
+                    "compiled kernel; use jnp.where/lax.cond, or move the "
+                    "argument to static_argnames",
+                )
+
+    # jax.jit(fn) where fn's local def branches on a traced param is
+    # covered at runtime by tracing itself; the decorator form is the
+    # one that hides until the first odd-shaped tick.
+
+
+HOST_SYNC = Rule(
+    "jax-host-sync",
+    "implicit device→host sync (np.asarray/.item()/int(x)) on the tick path",
+    _check_host_sync,
+)
+JIT_IN_LOOP = Rule(
+    "jax-jit-in-loop",
+    "jax.jit built inside a loop — per-iteration recompile storm",
+    _check_jit_in_loop,
+)
+TRACED_BRANCH = Rule(
+    "jax-traced-branch",
+    "Python if/while on a traced value inside a jitted function",
+    _check_traced_branch,
+)
+
+RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH]

@@ -1,0 +1,132 @@
+"""Wire/buffer hazard rules.
+
+``Message.wire`` is the serialize-once cache the broadcast hub shares
+across every transport: frames built from it are concatenated
+(``ws_binary_frame``) and handed to transport buffers that outlive the
+receive callback. A ``bytearray`` or ``memoryview`` stored there is a
+latent corruption: reusing the receive buffer rewrites frames already
+queued for other peers, and a memoryview raises on concat (ADVICE r5,
+protocol/codec.py). The rule makes "wire is immutable bytes" a checked
+invariant instead of a convention.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name, walk_shallow
+
+#: calls whose result is always immutable ``bytes``
+_BYTES_PRODUCERS = {
+    "bytes",
+    "serialize_message",
+    "py_serialize_message",
+    "ws_binary_frame",
+}
+
+
+def _returns_bytes(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    return name.rsplit(".", 1)[-1] in _BYTES_PRODUCERS
+
+
+def _annotation_is_bytes(ann: ast.AST | None) -> bool:
+    return isinstance(ann, ast.Name) and ann.id == "bytes"
+
+
+def _enclosing_function(tree: ast.Module, node: ast.AST):
+    found = None
+
+    def visit(parent, inside):
+        nonlocal found
+        for child in ast.iter_child_nodes(parent):
+            here = inside
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                here = child
+            if child is node:
+                found = inside
+            visit(child, here)
+
+    visit(tree, None)
+    return found
+
+
+def _name_is_bytes(ctx: FileContext, name: str, use: ast.AST) -> bool:
+    """True when ``name`` is provably immutable bytes at ``use``: either
+    a parameter annotated exactly ``bytes``, or its last assignment
+    before the use line is a bytes-producing call."""
+    func = _enclosing_function(ctx.tree, use)
+    if func is None:
+        return False
+    args = func.args
+    for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+        if a.arg == name and _annotation_is_bytes(a.annotation):
+            return True
+    last: ast.AST | None = None
+    last_line = -1
+    for stmt in walk_shallow(func.body):
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+        ):
+            value = stmt.value
+        elif (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and stmt.target.id == name
+            and stmt.value is not None
+        ):
+            value = stmt.value
+        else:
+            continue
+        if stmt.lineno < use.lineno and stmt.lineno > last_line:
+            last, last_line = value, stmt.lineno
+    return isinstance(last, ast.Call) and _returns_bytes(last)
+
+
+def _wire_value_safe(ctx: FileContext, value: ast.AST, use: ast.AST) -> bool:
+    if isinstance(value, ast.Constant) and isinstance(value.value, (bytes, type(None))):
+        return True
+    if isinstance(value, ast.Call) and _returns_bytes(value):
+        return True
+    if isinstance(value, ast.Name):
+        return _name_is_bytes(ctx, value.id, use)
+    # msg.wire propagation: already-normalized messages stay safe
+    if isinstance(value, ast.Attribute) and value.attr == "wire":
+        return True
+    return False
+
+
+def _check_mutable_wire(ctx: FileContext) -> Iterator[Violation]:
+    message = (
+        "possibly-mutable buffer stored as Message.wire — the frame "
+        "cache is shared across transports and concatenated into "
+        "outgoing frames, so a reused bytearray corrupts re-broadcasts "
+        "and a memoryview raises on concat; normalize with `bytes(buf)` "
+        "before storing"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None or name.rsplit(".", 1)[-1] != "Message":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "wire" and not _wire_value_safe(ctx, kw.value, node):
+                    yield from ctx.flag(MUTABLE_WIRE, kw.value, message)
+        elif isinstance(node, ast.Assign):
+            if any(
+                isinstance(t, ast.Attribute) and t.attr == "wire"
+                for t in node.targets
+            ) and not _wire_value_safe(ctx, node.value, node):
+                yield from ctx.flag(MUTABLE_WIRE, node, message)
+
+
+MUTABLE_WIRE = Rule(
+    "wire-mutable-buffer",
+    "bytearray/memoryview stored where immutable Message.wire bytes are assumed",
+    _check_mutable_wire,
+)
+
+RULES = [MUTABLE_WIRE]

@@ -19,7 +19,6 @@ reference-equivalent immediate path and never constructs this class.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import logging
 import time
 
@@ -126,9 +125,18 @@ class TickBatcher:
                     # delivery already in flight: let it finish (peers
                     # without a sync fast path — e.g. ZMQ — are only
                     # served by this awaited tail; abandoning it would
-                    # silently drop their frames).
-                    with contextlib.suppress(Exception):
-                        await deliver_task
+                    # silently drop their frames). Shield and re-await
+                    # in a loop: a bare `await deliver_task` here would
+                    # let a SECOND cancellation cancel the delivery
+                    # itself, and suppress(Exception) would abandon the
+                    # wait this branch exists to guarantee (ADVICE r5).
+                    while not deliver_task.done():
+                        try:
+                            await asyncio.shield(deliver_task)
+                        except asyncio.CancelledError:
+                            continue  # repeated cancel — keep waiting
+                        except Exception:
+                            break  # delivery errors handled by _run
                 raise
 
             self.ticks += 1

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..spatial.hashing import PAD_KEY, n_distinct, next_pow2, pad_to
+from ..utils import retrace
 from ..spatial.tpu_backend import (
     CSR_ROW,
     CSR_ROW_B,
@@ -56,6 +57,10 @@ from ..spatial.tpu_backend import (
     run_remainders,
     run_remainders_np,
 )
+
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pre-0.4.38 releases: not yet graduated
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 def split_at_run_boundaries(keys: np.ndarray, n_shards: int) -> list[int]:
@@ -175,6 +180,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                     self._sharding("space", None),
                 ),
             )
+            retrace.GUARD.register("sharded.probe_stack", kernel)
         return kernel(sk_stack, sk2_stack)
 
     #: re-shard (full re-upload) only when the largest shard exceeds
@@ -305,6 +311,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 in_shardings=(sub, sub, sub, vec, vec, rep, rep, rep),
                 out_shardings=(sub, sub, sub, sub, tbl, vec),
             )
+            retrace.GUARD.register("sharded.fold_shards", kernel)
         return kernel(bk, bk2, bp, lo, hi, *delta)
 
     # -- delta seams: the delta segment is replicated across the mesh,
@@ -319,6 +326,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 fn, static_argnames=static,
                 out_shardings=self._sharding(*spec),
             )
+            retrace.GUARD.register(f"sharded.{name}", kernel)
         return kernel
 
     def _alloc_delta_buffer(self, cap: int) -> tuple:
@@ -350,6 +358,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 _sort_segment_dev, static_argnames=("n_buckets",),
                 out_shardings=(v, v, v, v, t, v),
             )
+            retrace.GUARD.register("sharded.sort_delta", kernel)
         return kernel(*bufs, n_buckets=n_buckets)
 
     def _scatter_base_dead(self, bundle: dict, rows: np.ndarray) -> dict:
@@ -445,7 +454,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 total = jax.lax.pmax(total, "space")
                 return counts, flat, total.reshape(1)
 
-            matched_csr = jax.shard_map(
+            matched_csr = _shard_map(
                 local_csr, mesh=mesh, in_specs=in_specs,
                 out_specs=(
                     P("batch", None), P("batch"), P("batch"),
@@ -463,7 +472,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
                 )
                 return counts, flat, total
         else:
-            matched = jax.shard_map(
+            matched = _shard_map(
                 local, mesh=mesh, in_specs=in_specs,
                 out_specs=P("batch", None),
             )
@@ -485,6 +494,7 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
             kernel = self._kernels[key] = self._make_kernel(
                 variant, kinds, ks, extra
             )
+            retrace.GUARD.register(f"sharded.match_{variant}", kernel)
         return kernel
 
     def _dispatch(self, queries: tuple, segs, ks, kinds):
@@ -497,15 +507,21 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
 
     def _dispatch_csr(self, queries: tuple, segs, ks, kinds, t_cap: int):
         flat = [a for seg in segs for a in seg]
+        return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
+
+    def _csr_effective_cap(self, t_cap: int, queries: tuple, segs) -> int:
         # every batch shard's local region must cover its own zone-A
         # identity rows PLUS at least one zone-B row — the base
         # class's global floor divided by n_batch can land exactly on
-        # the zone-A size for small multi-segment ticks
+        # the zone-A size for small multi-segment ticks. Raised HERE
+        # (not silently inside the dispatch) so dispatch_local_batch
+        # records the same cap the kernel's overflow sentinel uses
+        # (ADVICE r5: totals between the two caps used to take a
+        # spurious dense re-resolve).
         m_local = queries[0].shape[0] // self.n_batch
         need_local = (CSR_ROW * m_local * len(segs)
                       + 2 * CSR_ROW_B)
-        t_cap = max(t_cap, next_pow2(self.n_batch * need_local))
-        return self._kernel("csr", kinds, ks, t_cap)(*flat, *queries)
+        return max(t_cap, next_pow2(self.n_batch * need_local))
 
     def _decode_csr(self, counts, flat, m: int):
         """The mesh flat result is per-batch-shard regions of

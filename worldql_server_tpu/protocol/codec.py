@@ -225,6 +225,11 @@ def deserialize_message(buf: bytes | bytearray | memoryview) -> Message:
     default ``EXCEPT_SELF``.
     """
     try:
+        # Snapshot mutable receive buffers FIRST: ``Message.wire`` is
+        # the serialize-once broadcast cache, shared and concatenated
+        # into frames that outlive this call — a reused bytearray would
+        # corrupt re-broadcasts and a memoryview breaks frame concat
+        # (ADVICE r5). ``bytes(bytes)`` is a no-copy identity.
         buf = bytes(buf)
         if len(buf) < 8:
             raise DeserializeError("buffer too small")

@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from ..protocol.types import Replication, Vector3
+from ..utils import retrace
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
 from .hashing import (
     MIX_M1, MIX_M2, NO_WORLD, PAD_KEY, QUERY_PAD_KEY2, n_distinct,
@@ -717,6 +718,25 @@ def _device_compact(bk, bk2, bp, dk, dk2, dp, cap2, n_buckets):
 def _probe_only_dev(sk, sk2, n_buckets):
     """Probe table for an already-sorted uploaded segment."""
     return probe_tables(sk, sk2, n_buckets=n_buckets)
+
+
+# Retrace tripwire: every jitted hot-path kernel is tracked so the test
+# suite can fail a change that re-traces per tick instead of per
+# capacity tier (utils/retrace.py; tests/test_retrace_budget.py).
+for _family, _kernel_fn in {
+    "match_dense": _match_dense_kernel,
+    "match_sparse": _match_sparse_kernel,
+    "match_run_csr": _match_run_csr_kernel,
+    "scatter_dead": _scatter_dead,
+    "write_chunk": _write_chunk,
+    "grow_buffers": _grow_buffers,
+    "alloc_buffers": _alloc_buffers,
+    "sort_segment": _sort_segment_dev,
+    "device_compact": _device_compact,
+    "probe_only": _probe_only_dev,
+}.items():
+    retrace.GUARD.register(f"tpu_backend.{_family}", _kernel_fn)
+del _family, _kernel_fn
 
 
 class _CollisionError(Exception):
@@ -1962,8 +1982,9 @@ class TpuSpatialBackend(SpatialBackend):
         if result is None:
             return np.full((m, 1), -1, dtype=np.int32)
         # Convert the whole (prefetched) array, trim on host — a device
-        # slice would dispatch again and re-transfer.
-        return np.asarray(result)[:m]
+        # slice would dispatch again and re-transfer. This sync IS the
+        # synchronous API's contract.
+        return np.asarray(result)[:m]  # wql: allow(jax-host-sync)
 
     def match_arrays_async(
         self,
@@ -2010,7 +2031,8 @@ class TpuSpatialBackend(SpatialBackend):
                 csr_cap, CSR_ROW * queries[0].shape[0] * len(segs) + 64
             )
             result = self._dispatch_csr(
-                queries, segs, ks, kinds, next_pow2(csr_cap)
+                queries, segs, ks, kinds,
+                self._csr_effective_cap(next_pow2(csr_cap), queries, segs),
             )
         elif max_hits is not None:
             result = self._dispatch_sparse(
@@ -2064,6 +2086,16 @@ class TpuSpatialBackend(SpatialBackend):
             *flat, *queries, nseg=len(segs), t_cap=t_cap
         )
 
+    def _csr_effective_cap(self, t_cap: int, queries: tuple, segs) -> int:
+        """The slot capacity the CSR kernel will REALLY run with at a
+        requested ``t_cap``. Subclasses raise it (per-shard region
+        floors); idempotent. Every caller that records a cap for the
+        overflow-sentinel test (collect_local_batch) must record this
+        value: if the kernel's true cap were higher than the recorded
+        one, totals between the two would look like overflow and take
+        a spurious dense re-resolve every tick (ADVICE r5)."""
+        return t_cap
+
     def match_local_batch(
         self, queries: Sequence[LocalQuery]
     ) -> list[list[uuid_mod.UUID]]:
@@ -2110,11 +2142,11 @@ class TpuSpatialBackend(SpatialBackend):
         # clamped t_cap) always escapes instead of re-dispatching
         # forever.
         ceiling = next_pow2(m * sum(ks))
-        t_cap = next_pow2(max(
+        t_cap = self._csr_effective_cap(next_pow2(max(
             self._delivery_cap,
             # zone-A floor: one identity row per (padded query, segment)
             CSR_ROW * self._query_cap(m) * len(segs) + 64,
-        ))
+        )), qtuple, segs)
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
             return (m, ("dense", tgt))
@@ -2131,7 +2163,10 @@ class TpuSpatialBackend(SpatialBackend):
         if payload is None:
             return [[] for _ in range(m)]
         if payload[0] == "dense":
-            tgt = np.asarray(payload[1])[:m]
+            # collect_local_batch IS the tick's designated sync point:
+            # it runs on the worker thread while the loop keeps serving
+            # transports, so these converts block nothing but the tick.
+            tgt = np.asarray(payload[1])[:m]  # wql: allow(jax-host-sync)
             counts, flat = _dense_to_csr(tgt)
             # the hint must keep adapting here too, or a flash-crowd
             # inflation would park every batch on the dense ceiling
@@ -2139,7 +2174,7 @@ class TpuSpatialBackend(SpatialBackend):
             self._adapt_delivery_cap(counts, grow=False)
             return self._decode_csr(counts, flat, m)
         _, t_cap, (counts, flat, total), ctx = payload
-        total = int(total)
+        total = int(total)  # wql: allow(jax-host-sync) — collect point
         if total > t_cap:
             # Rare: the tick's fan-out outgrew the hint — re-resolve
             # dense against the same index snapshot and raise the hint
@@ -2152,14 +2187,20 @@ class TpuSpatialBackend(SpatialBackend):
                 self._delivery_cap,
             )
             qtuple, segs, ks, kinds = ctx
-            tgt = np.asarray(self._dispatch(qtuple, segs, ks, kinds))[:m]
+            tgt = np.asarray(  # wql: allow(jax-host-sync) — collect point
+                self._dispatch(qtuple, segs, ks, kinds)
+            )[:m]
             return self._decode_csr(*_dense_to_csr(tgt), m)
         # counts stays UNTRIMMED: padding queries resolve 0 rows, and
         # the sharded decode needs the full padded layout to locate
         # its per-batch-shard flat regions
-        counts = np.asarray(counts)
+        counts = np.asarray(counts)  # wql: allow(jax-host-sync) — collect
         self._adapt_delivery_cap(counts, grow=True)
-        return self._decode_csr(counts, np.asarray(flat), m)
+        return self._decode_csr(
+            counts,
+            np.asarray(flat),  # wql: allow(jax-host-sync) — collect point
+            m,
+        )
 
     def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool) -> None:
         """Track the capacity the observed tick actually needed. Grows

@@ -63,6 +63,10 @@ class ZmqTransport:
         self._pull: zmq.asyncio.Socket | None = None
         self._push_sockets: dict[uuid_mod.UUID, zmq.asyncio.Socket] = {}
         self._recv_task: asyncio.Task | None = None
+        # Failed-send evictions run as tasks; the loop only weak-refs
+        # running tasks, so retain them or a GC pass could drop an
+        # eviction mid-flight and leak the dead peer from the map.
+        self._evictions: set[asyncio.Task] = set()
 
     async def start(self) -> None:
         config = self.server.config
@@ -169,9 +173,11 @@ class ZmqTransport:
             except Exception:
                 # Failed send ⇒ evict peer (outgoing.rs:66-76).
                 self._drop_socket(peer_uuid)
-                asyncio.get_running_loop().create_task(
+                task = asyncio.get_running_loop().create_task(
                     self.server.peer_map.remove(peer_uuid)
                 )
+                self._evictions.add(task)
+                task.add_done_callback(self._evictions.discard)
                 raise
 
         peer = Peer(

@@ -1,0 +1,136 @@
+"""Jit-retrace tripwire for the batched fan-out engine.
+
+A tick that retraces is a tick that recompiles — tens of milliseconds
+to seconds inside a 5 ms budget (the unexplained 207-second depth-2
+outlier in BENCH_r05 is the failure mode at its worst). The engine's
+defense is capacity tiers: every dynamic dimension (query batch, CSR
+slot budget, delta rows) is padded to a power-of-two tier so steady
+traffic reuses a handful of compiled variants. This module makes that
+property *testable*: every jitted hot-path kernel registers here, the
+guard reads each callable's compile-cache size, and the suite fails if
+a workload that should stay inside one tier grows the cache past its
+budget (``tests/test_retrace_budget.py``; knob: ``WQL_RETRACE_BUDGET``).
+
+Registration is passive — a dict of references, no wrapping, no
+overhead on the call path — so it is always on; *counting* only happens
+when a test (or an operator, via ``GUARD.counts()``) asks.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "DEFAULT_BUDGET",
+    "GUARD",
+    "RetraceBudgetExceeded",
+    "RetraceGuard",
+]
+
+
+def _default_budget() -> int:
+    """Max NEW compiled variants a steady-state workload may add per
+    kernel family (``WQL_RETRACE_BUDGET`` overrides)."""
+    try:
+        return int(os.environ.get("WQL_RETRACE_BUDGET", "2"))
+    except ValueError:
+        return 2
+
+
+DEFAULT_BUDGET = _default_budget()
+
+
+class RetraceBudgetExceeded(AssertionError):
+    """A jitted hot-path kernel family exceeded its retrace budget."""
+
+
+class RetraceGuard:
+    """Counts compiled variants per named kernel family.
+
+    A *family* is one logical kernel (e.g. ``tpu_backend.match_run_csr``)
+    that may be realized by several jit objects (the sharded backend
+    builds one per static config); the family count is the sum of their
+    compile-cache sizes, so both "same jit retraced" and "yet another
+    jit object built" show up as growth.
+    """
+
+    def __init__(self) -> None:
+        self._families: dict[str, list] = {}
+
+    def register(self, family: str, fn):
+        """Track a jitted callable under ``family``. Idempotent by
+        identity; returns ``fn`` so it can wrap a definition."""
+        fns = self._families.setdefault(family, [])
+        if not any(f is fn for f in fns):
+            fns.append(fn)
+        return fn
+
+    @staticmethod
+    def _traces(fn) -> int:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return 0
+        try:
+            return int(probe())
+        except Exception:  # backend without a cache probe: count 0
+            return 0
+
+    def counts(self) -> dict[str, int]:
+        """Compiled-variant count per family, right now."""
+        return {
+            family: sum(self._traces(f) for f in fns)
+            for family, fns in self._families.items()
+        }
+
+    def snapshot(self) -> dict[str, int]:
+        return self.counts()
+
+    def delta(self, since: dict[str, int]) -> dict[str, int]:
+        """Families that gained compiled variants since ``since``."""
+        return {
+            family: grown
+            for family, count in self.counts().items()
+            if (grown := count - since.get(family, 0)) > 0
+        }
+
+    def check(
+        self,
+        budget: int | dict[str, int] | None = None,
+        *,
+        since: dict[str, int] | None = None,
+    ) -> dict[str, int]:
+        """Fail if any family grew past its budget.
+
+        ``budget`` is a per-family cap (int for all, or dict overrides;
+        default ``DEFAULT_BUDGET``). With ``since`` the cap applies to
+        growth after that snapshot — the steady-state tripwire; without
+        it, to the absolute count — a warmup-wide ceiling. Returns the
+        measured (delta) counts on success.
+        """
+        counts = self.delta(since) if since is not None else self.counts()
+
+        def cap(family: str) -> int:
+            if isinstance(budget, dict):
+                return budget.get(family, DEFAULT_BUDGET)
+            return DEFAULT_BUDGET if budget is None else budget
+
+        over = {
+            family: (n, cap(family))
+            for family, n in counts.items()
+            if n > cap(family)
+        }
+        if over:
+            lines = ", ".join(
+                f"{family}: {n} > budget {c}" for family, (n, c) in over.items()
+            )
+            raise RetraceBudgetExceeded(
+                f"jit retrace budget exceeded — {lines}. A hot-path "
+                "kernel is being re-traced (shape churn outside the "
+                "padded capacity tiers, or a jit rebuilt per tick); "
+                "see utils/retrace.py"
+            )
+        return counts
+
+
+#: process-wide guard the backends register their kernels with
+GUARD = RetraceGuard()
