@@ -31,6 +31,9 @@ it is budget/actual, so > 1.0 means the budget is met.
   3  100k entities, fully-on-device kNN (k=32) tick, single chip
   4  64 worlds x 10k clients on the mesh-sharded backend
   5  1M-entity Zipf-hotspot fan-out (default)
+  6  record-op durability workload: RecordCreate handler latency on
+     the SQLite store with durability off / wal / sync (metric:
+     wal-mode handler p99; vs_baseline = inline-commit p99 over it)
 `--all` runs every config, one JSON line per config, config order.
 
 Diagnostics go to stderr. --quick shrinks every shape for smoke runs.
@@ -1732,13 +1735,127 @@ def bench_config4(args) -> dict:
     }
 
 
+def bench_config6(args) -> dict:
+    """Record-op durability workload (ISSUE 2): RecordCreate handler
+    latency through the REAL Router against the SQLite store, once per
+    durability mode. 'off' awaits the store commit inline (the
+    reference's synchronous-persist shape), 'wal' acks after the
+    group-commit fsync + enqueue, 'sync' pays WAL fsync AND the inline
+    commit. The headline is wal-mode p99 — what a record write costs
+    the event loop with durability ON."""
+    import shutil
+    import tempfile
+
+    from worldql_server_tpu.durability import (
+        DurabilityPipeline, WriteAheadLog,
+    )
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.peers import PeerMap
+    from worldql_server_tpu.engine.router import Router
+    from worldql_server_tpu.protocol import Instruction, Message, Record
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+    from worldql_server_tpu.storage.store import open_store
+
+    ops = 300 if args.quick else 2_000
+    recs_per_op = 4
+    rng = np.random.default_rng(17)
+    sender = uuid_mod.uuid4()
+
+    def make_messages():
+        msgs = []
+        for i in range(ops):
+            records = [
+                Record(
+                    uuid=uuid_mod.UUID(int=i * recs_per_op + j + 1),
+                    position=Vector3(*rng.uniform(-500, 500, 3)),
+                    world_name="bench",
+                    data="x" * 64,
+                )
+                for j in range(recs_per_op)
+            ]
+            msgs.append(Message(
+                instruction=Instruction.RECORD_CREATE,
+                sender_uuid=sender, world_name="bench", records=records,
+            ))
+        return msgs
+
+    results = {}
+    for mode in ("off", "wal", "sync"):
+        tmp = tempfile.mkdtemp(prefix=f"wql-bench6-{mode}-")
+
+        async def scenario(mode=mode, tmp=tmp):
+            config = Config(
+                store_url=f"sqlite://{tmp}/records.db",
+                durability=mode, wal_dir=f"{tmp}/wal",
+            )
+            store = open_store(config.store_url, config)
+            await store.init()
+            wal = None
+            durability = None
+            if mode != "off":
+                wal = WriteAheadLog(
+                    config.wal_dir,
+                    fsync_ms=0.0 if mode == "sync" else config.wal_fsync_ms,
+                    segment_bytes=config.wal_segment_bytes,
+                )
+                wal.start()
+                durability = DurabilityPipeline(
+                    store, mode=mode, wal=wal, config=config,
+                )
+                durability.start()
+            router = Router(
+                PeerMap(), CpuSpatialBackend(config.sub_region_size),
+                store, durability=durability,
+            )
+            lat = []
+            for msg in make_messages():
+                t0 = time.perf_counter()
+                await router.handle_message(msg)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            if durability is not None:
+                drained = await durability.stop()
+                assert drained, "write-behind queue failed to drain"
+                await wal.close()
+            await store.close()
+            return lat
+
+        lat = asyncio.run(scenario())
+        shutil.rmtree(tmp, ignore_errors=True)
+        results[mode] = (pctl(lat, 50), pctl(lat, 99))
+        log(f"durability={mode}: handler p50 {results[mode][0]:.3f} ms "
+            f"p99 {results[mode][1]:.3f} ms  ({ops} ops x "
+            f"{recs_per_op} records)")
+
+    return {
+        "metric": "record_op_handler_p99_ms",
+        "value": round(results["wal"][1], 4),
+        "unit": "ms",
+        # speedup of the write-behind handler over the reference's
+        # inline-commit shape (> 1.0 = durability off the hot path)
+        "vs_baseline": round(
+            results["off"][1] / max(results["wal"][1], 1e-9), 2
+        ),
+        "off_p50_ms": round(results["off"][0], 4),
+        "off_p99_ms": round(results["off"][1], 4),
+        "wal_p50_ms": round(results["wal"][0], 4),
+        "wal_p99_ms": round(results["wal"][1], 4),
+        "sync_p50_ms": round(results["sync"][0], 4),
+        "sync_p99_ms": round(results["sync"][1], 4),
+        "ops": ops,
+        "records_per_op": recs_per_op,
+        "config": 6,
+    }
+
+
 # --------------------------------------------------------------------
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5],
-                    help="BASELINE config to run (default: 5)")
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6],
+                    help="BASELINE config to run (default: 5); 6 = "
+                         "record-op durability workload")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -1761,10 +1878,10 @@ def main() -> None:
 
     benches = {
         1: bench_config1, 2: bench_config2, 3: bench_config3,
-        4: bench_config4, 5: bench_config5,
+        4: bench_config4, 5: bench_config5, 6: bench_config6,
     }
     if args.all:
-        selected = [1, 2, 3, 4, 5]
+        selected = [1, 2, 3, 4, 5, 6]
     else:
         selected = [args.config or 5]
     for n in selected:

@@ -102,11 +102,27 @@ def test_bench_config4_sharded():
     assert rec["mesh"] == {"batch": 1, "space": 1}
 
 
+def test_bench_config6_record_op_durability():
+    """Config 6: RecordCreate handler latency per durability mode —
+    the BENCH-trajectory fields that track handler p99 with
+    durability on (ISSUE 2)."""
+    records, stderr = run_bench("--config", "6", "--quick")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "record_op_handler_p99_ms"
+    for mode in ("off", "wal", "sync"):
+        assert rec[f"{mode}_p99_ms"] > 0
+        assert rec[f"{mode}_p50_ms"] <= rec[f"{mode}_p99_ms"]
+    assert rec["value"] == rec["wal_p99_ms"]
+    assert rec["ops"] == 300
+    assert "durability=wal" in stderr
+
+
 def test_bench_all_emits_one_line_per_config():
-    """--all: five configs, five JSON lines, in config order."""
+    """--all: six configs, six JSON lines, in config order."""
     records, _ = run_bench(
         "--all", "--quick", "--subs", "4000", "--queries", "256",
         "--ticks", "6", "--cpu-ticks", "2",
     )
-    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5]
-    assert len({rec["metric"] for rec in records}) == 5
+    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6]
+    assert len({rec["metric"] for rec in records}) == 6

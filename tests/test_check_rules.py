@@ -391,11 +391,75 @@ def test_select_runs_only_requested_rules():
     }
 
 
+# region: store-on-loop
+
+
+ROUTER_PATH = "worldql_server_tpu/engine/router.py"
+TICKER_PATH = "worldql_server_tpu/engine/ticker.py"
+
+
+def test_store_on_loop_fires_in_router():
+    src = """
+    class Router:
+        async def _record_create(self, message):
+            await self.store.insert_records(message.records)
+    """
+    assert violations(src, relpath=ROUTER_PATH, select="store-on-loop") == [
+        ("store-on-loop", 4)
+    ]
+
+
+def test_store_on_loop_fires_in_ticker_and_on_nested_chains():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            rows = await self.server.store.get_records_in_region(w, p)
+    """
+    assert rules_fired(src, relpath=TICKER_PATH) == {"store-on-loop"}
+
+
+def test_store_on_loop_quiet_outside_scoped_modules():
+    """The pipeline/recovery/tests legitimately await the store."""
+    src = """
+    class DurabilityPipeline:
+        async def _apply(self, batch):
+            await self.store.insert_records(batch)
+    """
+    assert rules_fired(
+        src, relpath="worldql_server_tpu/durability/pipeline.py"
+    ) == set()
+
+
+def test_store_on_loop_quiet_on_durability_calls():
+    src = """
+    class Router:
+        async def _record_create(self, message):
+            await self.durability.insert_records(message.records)
+        async def _record_read(self, message):
+            rows = await self.durability.get_records_in_region(w, p)
+    """
+    assert rules_fired(src, relpath=ROUTER_PATH) == set()
+
+
+def test_store_on_loop_pragma_suppresses():
+    src = """
+    class Router:
+        async def _record_create(self, message):
+            await self.store.insert_records(  # wql: allow(store-on-loop)
+                message.records
+            )
+    """
+    assert rules_fired(src, relpath=ROUTER_PATH) == set()
+
+
+# endregion
+
+
 def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 7
+    assert len(names) >= 8
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -403,6 +467,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-host-sync",
         "jax-jit-in-loop",
         "jax-traced-branch",
+        "store-on-loop",
         "wire-mutable-buffer",
     }
 

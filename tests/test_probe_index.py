@@ -57,11 +57,11 @@ def build_segment(rng, n_cubes=200, s_cap=1024, dead_frac=0.1):
 
 def make_queries(rng, keys, keys2, m=64, cap=128):
     """Mix of hits, misses, and key2-corrupt probes. Corruption flips
-    TOP key2 bits: the probe's verify tag is key2's top-32 (the
-    binary fallback compares the full key2), so only top-bit
-    corruption is rejected by BOTH branches — which is what real
-    collisions look like (both families are independent hashes; a
-    wrong cube differs in all 64 bits with overwhelming odds)."""
+    TOP key2 bits so both the row tag AND the full-key2 backstop see
+    it — which is what real collisions look like (both families are
+    independent hashes; a wrong cube differs in all 64 bits with
+    overwhelming odds). Low-bit-only corruption is covered separately
+    by test_probe_key2_low_bit_collision_rejected."""
     hit = rng.integers(0, len(keys), m)
     qk = keys[hit].copy()
     qk2 = keys2[hit].copy()
@@ -91,11 +91,34 @@ def test_probe_matches_binary_search(n_cubes):
     assert int(oflow[0]) == 0, "healthy load factor must never overflow"
 
     lo_ref, cnt_ref = jax.jit(_run_bounds)(d_sk, d_sk2, rem, qk, qk2)
-    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tbl, rem, qk, qk2)
+    lo_p, cnt_p = jax.jit(_probe_run_bounds)(tbl, d_sk2, rem, qk, qk2)
     cnt_ref = np.asarray(cnt_ref)
     found = cnt_ref > 0
     assert (np.asarray(cnt_p) == cnt_ref).all()
     assert (np.asarray(lo_p)[found] == np.asarray(lo_ref)[found]).all()
+
+
+def test_probe_key2_low_bit_collision_rejected():
+    """Full-key2 exactness backstop (ADVICE r5): a query whose key2
+    matches the stored run's TOP 32 bits but differs in the low bits —
+    the tag1+tag2 double-collision shape the packed row tags alone
+    would accept — must miss through the probe branch, exactly as it
+    does through the binary-search fallback."""
+    rng = np.random.default_rng(5)
+    d_sk, d_sk2, _, rem, keys, keys2 = build_segment(rng, 50)
+    nb = probe_buckets_for(50)
+    tbl, oflow = build_table(d_sk, d_sk2, nb)
+    assert int(oflow[0]) == 0
+    qk = keys[:8].copy()
+    qk2 = keys2[:8] ^ np.int64(0x5A5A)  # low 32 bits only: tags agree
+    qk_p = jnp.asarray(pad_to(qk, 16, PAD_KEY))
+    qk2_p = jnp.asarray(pad_to(qk2, 16, QUERY_PAD_KEY2))
+    _, cnt_p = jax.jit(_probe_run_bounds)(tbl, d_sk2, rem, qk_p, qk2_p)
+    assert (np.asarray(cnt_p)[:8] == 0).all()
+    # the untouched originals still hit
+    qk2_ok = jnp.asarray(pad_to(keys2[:8], 16, QUERY_PAD_KEY2))
+    _, cnt_ok = jax.jit(_probe_run_bounds)(tbl, d_sk2, rem, qk_p, qk2_ok)
+    assert (np.asarray(cnt_ok)[:8] > 0).all()
 
 
 def test_table_stores_every_cube_once():

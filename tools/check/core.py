@@ -136,9 +136,12 @@ def enclosing_functions(tree: ast.Module):
 
 
 def all_rules() -> list[Rule]:
-    from . import rules_async, rules_jax, rules_wire
+    from . import rules_async, rules_jax, rules_store, rules_wire
 
-    return [*rules_async.RULES, *rules_jax.RULES, *rules_wire.RULES]
+    return [
+        *rules_async.RULES, *rules_jax.RULES, *rules_store.RULES,
+        *rules_wire.RULES,
+    ]
 
 
 def check_source(

@@ -1,0 +1,66 @@
+"""Store-on-loop hazard rule.
+
+The record store's default backend commits SQLite transactions on the
+event loop's thread pool under a store-wide lock — awaiting it from
+the message-handling loop puts a disk commit on the same loop the
+20 Hz ticker and every transport share (ISSUE 2). Record ops in the
+router/ticker must therefore go through the durability frontend
+(``worldql_server_tpu/durability``), which batches, WALs and
+backpressures them; a direct ``await self.store.…`` there is a
+regression to the reference's synchronous-persist shape, not a style
+choice.
+
+Scoped to ``engine/router.py`` and ``engine/ticker.py`` — the pipeline
+itself (and recovery, tests, benches) legitimately awaits the store.
+Suppress a deliberate inline call with ``# wql: allow(store-on-loop)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name
+
+#: modules where record ops must ride the durability pipeline
+_SCOPED = ("engine/router.py", "engine/ticker.py")
+
+
+def _is_store_call(call: ast.Call) -> bool:
+    """True for ``<chain>.store.<method>(…)`` — e.g. ``self.store.x()``
+    or ``self.server.store.x()``."""
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    return len(parts) >= 3 and "store" in parts[:-1]
+
+
+def _check_store_on_loop(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_SCOPED):
+        return
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Await)
+            and isinstance(node.value, ast.Call)
+            and _is_store_call(node.value)
+        ):
+            yield from ctx.flag(
+                STORE_ON_LOOP,
+                node,
+                "direct await on the record store from the message-"
+                "handling loop — record ops must go through the "
+                "durability pipeline (self.durability.…, "
+                "worldql_server_tpu/durability), which batches, WALs "
+                "and backpressures them off the hot path",
+            )
+
+
+STORE_ON_LOOP = Rule(
+    "store-on-loop",
+    "router/ticker awaits the record store directly instead of the "
+    "durability pipeline",
+    _check_store_on_loop,
+)
+
+RULES = [STORE_ON_LOOP]

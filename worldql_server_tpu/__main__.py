@@ -70,6 +70,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--index-snapshot",
                    help="subscription-index snapshot file: loaded at "
                         "boot if present, saved at shutdown")
+    p.add_argument("--durability", choices=["off", "wal", "sync"],
+                   help="record durability: off = inline store "
+                        "(reference-equivalent), wal = group-committed "
+                        "WAL + write-behind store, sync = WAL + inline "
+                        "store (default off)")
+    p.add_argument("--wal-dir",
+                   help="WAL segment directory (default ./wal)")
+    p.add_argument("--wal-fsync-ms", type=float,
+                   help="group-commit batching window in ms; 0 (the "
+                        "default) adds no wait — batches still form "
+                        "naturally while an fsync is in flight")
+    p.add_argument("--wal-segment-bytes", type=int,
+                   help="WAL segment rotation threshold (default 64 MiB)")
+    p.add_argument("--checkpoint-interval", type=float,
+                   help="seconds between store-flush/snapshot/WAL-"
+                        "truncate checkpoints; 0 = shutdown-only "
+                        "(default 60)")
     p.add_argument("--max-message-size", type=int,
                    help="inbound wire-message byte cap, both transports "
                         "(default 8 MiB)")
@@ -83,6 +100,8 @@ _OVERRIDES = [
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
     "mesh_batch", "mesh_space", "index_snapshot", "max_message_size",
+    "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
+    "checkpoint_interval",
 ]
 
 

@@ -1,0 +1,51 @@
+"""Durability engine: WAL + write-behind pipeline + crash recovery.
+
+The reference persists every record op synchronously inside the message
+handler — one DB round-trip per RecordCreate on the very event loop the
+ticker and transports share (SURVEY §3, processing/record_create.rs).
+This package takes record persistence off that hot path the same way
+the spatial index batches device mutations into ticks:
+
+* :mod:`.wal` — segmented append-only write-ahead log, length+CRC32
+  framed entries (payload = the codec's Record serialization), group
+  commit on a worker thread that coalesces fsyncs.
+* :mod:`.pipeline` — write-behind applier: a bounded queue drains
+  insert/delete/dedupe ops into ``executemany``-sized store batches off
+  the event loop, applies backpressure when full, and gives region
+  reads read-your-writes by waiting out pending ops for the queried
+  region.
+* :mod:`.recovery` — boot-time WAL scan + replay tolerating a torn
+  tail, leaning on the store's append-with-dedupe-on-read contract so
+  re-replaying an already-applied entry is harmless.
+
+Three durability modes (engine/config.py ``durability=``):
+
+* ``off`` — reference-equivalent: handlers await the store directly,
+  no WAL, byte-for-byte identical wire behavior.
+* ``wal`` — handlers return after the WAL group-commit fsync ack +
+  enqueue; the store commit happens behind the handler.
+* ``sync`` — WAL append with immediate fsync AND a synchronous store
+  commit before the handler returns (strongest, slowest).
+"""
+
+from .pipeline import DurabilityPipeline
+from .recovery import RecoveryStats, recover, scan_wal
+from .wal import (
+    WalCorruption,
+    WriteAheadLog,
+    decode_entry,
+    encode_delete,
+    encode_insert,
+)
+
+__all__ = [
+    "DurabilityPipeline",
+    "RecoveryStats",
+    "WalCorruption",
+    "WriteAheadLog",
+    "decode_entry",
+    "encode_delete",
+    "encode_insert",
+    "recover",
+    "scan_wal",
+]

@@ -1,0 +1,290 @@
+"""Write-behind record persistence pipeline (the durability frontend).
+
+One object answers every record op the router used to await on the
+store directly, in one of three modes:
+
+* ``off`` — pass-through: every call awaits the store inline, exactly
+  the pre-durability behavior (reference semantics, byte-for-byte).
+* ``sync`` — WAL first (immediate fsync), then the store inline.
+* ``wal`` — WAL group-commit ack, then enqueue onto a BOUNDED queue; a
+  background applier drains ops into ``executemany``-sized store
+  batches off the handler path. A full queue backpressures the
+  handler (``await queue.put``), which in turn backpressures the
+  transport read loop — memory stays bounded under any burst.
+
+Read-your-writes: region reads in ``wal`` mode first wait out every
+pending op that touches the queried DB region (a per-region high-water
+sequence map; ops that can't be keyed conservatively mark ALL regions).
+Reads of untouched regions never wait.
+
+Dedupe (read-repair) ops ride the queue but are NOT WAL-logged: they
+are derivable — any lost dedupe is redone by the next read of that
+region, per the store's append-with-dedupe-on-read contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..spatial.quantize import region_coords
+from ..storage.store import DedupeOp, RecordStore, StoredRecord
+from ..protocol.types import Record, Vector3
+from .wal import WriteAheadLog, encode_delete, encode_insert
+
+logger = logging.getLogger(__name__)
+
+#: conservative region key for ops whose position can't be quantized
+#: (hostile NaN coords): every subsequent read waits for them
+_ALL_REGIONS = ("*",)
+
+MODES = ("off", "wal", "sync")
+
+
+class DurabilityPipeline:
+    def __init__(
+        self,
+        store: RecordStore,
+        *,
+        mode: str = "off",
+        wal: WriteAheadLog | None = None,
+        config=None,
+        metrics=None,
+        max_queue: int = 1024,
+        max_batch_records: int = 512,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"durability mode must be one of {MODES}")
+        if mode != "off" and wal is None:
+            raise ValueError(f"durability={mode} requires a WriteAheadLog")
+        self.store = store
+        self.mode = mode
+        self.wal = wal
+        self.metrics = metrics
+        self._max_batch = max_batch_records
+        self._rx = getattr(config, "db_region_x_size", 16)
+        self._ry = getattr(config, "db_region_y_size", 256)
+        self._rz = getattr(config, "db_region_z_size", 16)
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: asyncio.Task | None = None
+        # sequence bookkeeping for barriers: _seq stamps every enqueued
+        # op, _applied trails it as the applier finishes store calls
+        self._seq = 0
+        self._applied = 0
+        self._region_seq: dict[tuple, int] = {}
+        self._waiters: list[tuple[int, asyncio.Future]] = []
+        self.apply_errors = 0
+
+    # region: lifecycle
+
+    def start(self) -> None:
+        if self.mode == "wal" and self._task is None:
+            self._task = asyncio.create_task(
+                self._applier(), name="durability-applier"
+            )
+
+    async def stop(self, drain_timeout: float = 30.0) -> bool:
+        """Drain then stop the applier. Returns True when everything
+        pending reached the store. On a wedged store the drain times
+        out and pending ops are abandoned — they are already in the
+        WAL, so the next boot's recovery replays them (dedupe ops are
+        the exception and are derivable)."""
+        drained = True
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self.drain(), drain_timeout)
+            except asyncio.TimeoutError:
+                drained = False
+                logger.error(
+                    "durability drain timed out with %d ops pending — "
+                    "they remain in the WAL for boot-time replay",
+                    self._seq - self._applied,
+                )
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        return drained
+
+    def stats(self) -> dict:
+        out = {
+            "mode": self.mode,
+            "queue_depth": self._queue.qsize(),
+            "enqueued": self._seq,
+            "applied": self._applied,
+            "apply_errors": self.apply_errors,
+        }
+        if self.wal is not None:
+            out.update(self.wal.stats())
+        return out
+
+    # endregion
+
+    # region: record ops (the router's surface)
+
+    async def insert_records(self, records: list[Record]) -> int:
+        if self.mode == "off" or not records:
+            return await self.store.insert_records(records)
+        await self.wal.append(encode_insert(records))
+        if self.mode == "sync":
+            return await self.store.insert_records(records)
+        await self._enqueue("insert", records)
+        return len(records)
+
+    async def delete_records(self, records: list[Record]) -> int:
+        if self.mode == "off" or not records:
+            return await self.store.delete_records(records)
+        await self.wal.append(encode_delete(records))
+        if self.mode == "sync":
+            return await self.store.delete_records(records)
+        await self._enqueue("delete", records)
+        return 0
+
+    async def dedupe_records(self, ops: list[DedupeOp]) -> int:
+        if self.mode != "wal" or not ops:
+            return await self.store.dedupe_records(ops)
+        await self._enqueue("dedupe", ops)
+        return 0
+
+    async def get_records_in_region(
+        self, world_name: str, position: Vector3, after=None
+    ) -> list[StoredRecord]:
+        if self.mode == "wal":
+            await self.read_barrier(world_name, position)
+        return await self.store.get_records_in_region(
+            world_name, position, after
+        )
+
+    # endregion
+
+    # region: queue + barriers
+
+    def _region_of(self, world: str, position) -> tuple:
+        try:
+            return (
+                world,
+                region_coords(
+                    position.x, position.y, position.z,
+                    self._rx, self._ry, self._rz,
+                ),
+            )
+        except Exception:
+            return _ALL_REGIONS
+
+    def _regions_touched(self, kind: str, payload) -> set[tuple]:
+        regions: set[tuple] = set()
+        if kind == "dedupe":
+            for _uuid, _ts, world, position in payload:
+                regions.add(self._region_of(world, position))
+        else:
+            for record in payload:
+                if record.position is None:
+                    continue  # the store skips position-less records
+                regions.add(self._region_of(record.world_name, record.position))
+        return regions
+
+    async def _enqueue(self, kind: str, payload) -> None:
+        self._seq += 1
+        seq = self._seq
+        for region in self._regions_touched(kind, payload):
+            self._region_seq[region] = seq
+        if self._queue.full() and self.metrics is not None:
+            self.metrics.inc("durability.backpressure_waits")
+        await self._queue.put((seq, kind, payload))
+
+    async def read_barrier(self, world: str, position) -> None:
+        """Wait until every pending op touching (world, position)'s DB
+        region has been applied to the store."""
+        region = self._region_of(world, position)
+        target = self._region_seq.get(_ALL_REGIONS, 0)
+        if region == _ALL_REGIONS:
+            # unquantizable read position: the store read will likely
+            # fail anyway, but stay conservative and wait for everything
+            target = self._seq
+        else:
+            target = max(target, self._region_seq.get(region, 0))
+        await self._wait_applied(target)
+
+    async def drain(self) -> None:
+        """Wait until every op enqueued so far has been applied."""
+        await self._wait_applied(self._seq)
+
+    async def _wait_applied(self, target: int) -> None:
+        if self._applied >= target:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((target, fut))
+        await fut
+
+    def _wake_waiters(self) -> None:
+        if not self._waiters:
+            return
+        still = []
+        for target, fut in self._waiters:
+            if self._applied >= target:
+                if not fut.done():
+                    fut.set_result(None)
+            else:
+                still.append((target, fut))
+        self._waiters = still
+
+    # endregion
+
+    # region: applier
+
+    async def _applier(self) -> None:
+        """Drain the queue into batched store calls. Adjacent ops of the
+        same kind coalesce into one ``executemany``-sized batch (order
+        between kinds is preserved — an insert→delete pair for the same
+        record can never invert). A store error drops that batch with a
+        log line but still advances the applied watermark: barriers
+        must never deadlock on a failing store, and the WAL retains the
+        ops for recovery."""
+        pending: tuple | None = None
+        while True:
+            item = pending if pending is not None else await self._queue.get()
+            pending = None
+            seq, kind, payload = item
+            batch = list(payload)
+            while len(batch) < self._max_batch:
+                try:
+                    nxt = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if nxt[1] != kind:
+                    pending = nxt
+                    break
+                seq = nxt[0]
+                batch.extend(nxt[2])
+            if self.metrics is not None:
+                with self.metrics.time_ms("durability.apply_ms"):
+                    await self._apply(kind, batch)
+                self.metrics.inc("durability.applied_ops")
+            else:
+                await self._apply(kind, batch)
+            self._applied = seq
+            self._wake_waiters()
+
+    async def _apply(self, kind: str, batch: list) -> None:
+        try:
+            if kind == "insert":
+                await self.store.insert_records(batch)
+            elif kind == "delete":
+                await self.store.delete_records(batch)
+            else:
+                await self.store.dedupe_records(batch)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.apply_errors += 1
+            if self.metrics is not None:
+                self.metrics.inc("durability.apply_errors")
+            logger.exception(
+                "write-behind %s batch of %d failed — dropped from "
+                "the queue (WAL retains it for recovery)",
+                kind, len(batch),
+            )
+
+    # endregion

@@ -1,0 +1,177 @@
+"""Boot-time WAL scan + replay.
+
+Crash model: the server dies at an arbitrary instant. The only
+in-flight write is the tail of the NEWEST segment (segments are sealed
+before rotation, and the group-commit worker is the single writer), so
+recovery must tolerate exactly one torn entry: a frame whose header,
+payload, or CRC is incomplete at end-of-log. Everything before it was
+fsynced and acked; everything after it was never acked to any client.
+
+Replay leans on the store's append-with-dedupe-on-read contract
+(storage/store.py): re-applying an entry that already reached the
+store before the crash just appends a duplicate row that the next read
+collapses — so recovery needs no exactly-once bookkeeping, only
+prefix-ordered replay. Deletes are naturally idempotent.
+
+After a successful replay the replayed segments are purged (the store
+committed every batch), bounding both WAL disk usage and the NEXT
+recovery's work — the same role the periodic checkpoint plays while
+serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from .wal import (
+    HEADER,
+    MAGIC,
+    MAX_ENTRY_BYTES,
+    WalCorruption,
+    decode_entry,
+    list_segments,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RecoveryStats:
+    segments: int = 0
+    entries: int = 0
+    records: int = 0
+    torn_entries: int = 0
+    torn_bytes: int = 0
+    purged_segments: int = 0
+    errors: list[str] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {
+            "segments": self.segments,
+            "entries": self.entries,
+            "records": self.records,
+            "torn_entries": self.torn_entries,
+            "torn_bytes": self.torn_bytes,
+            "purged_segments": self.purged_segments,
+            "errors": list(self.errors),
+        }
+
+
+def iter_segment_entries(path: str) -> Iterator[tuple[int, bytes]]:
+    """Yield ``(entry_start_offset, payload)`` for every COMPLETE entry
+    in one segment; raises :class:`WalCorruption` (carrying the torn
+    offset in ``args[1]``) at the first incomplete/invalid frame."""
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise WalCorruption(
+                f"bad segment magic in {path!r}", 0
+            )
+        offset = len(MAGIC)
+        while True:
+            header = f.read(HEADER.size)
+            if not header:
+                return  # clean end of segment
+            if len(header) < HEADER.size:
+                raise WalCorruption("torn entry header", offset)
+            length, crc = HEADER.unpack(header)
+            if length > MAX_ENTRY_BYTES:
+                raise WalCorruption(
+                    f"implausible entry length {length}", offset
+                )
+            payload = f.read(length)
+            if len(payload) < length:
+                raise WalCorruption("torn entry payload", offset)
+            if zlib.crc32(payload) != crc:
+                raise WalCorruption("entry CRC mismatch", offset)
+            yield offset, payload
+            offset += HEADER.size + length
+
+
+def scan_wal(wal_dir: str) -> tuple[list[tuple[str, list]], RecoveryStats]:
+    """Scan every segment in order → (ops, stats). ``ops`` is the
+    replayable prefix: ``("insert"|"delete", records)`` tuples.
+
+    A bad frame in the NEWEST segment is the expected torn tail: scan
+    stops there. A bad frame in an older (sealed) segment means real
+    corruption — that segment's remaining entries are skipped with a
+    loud error, but later segments still replay: every entry is
+    self-contained, inserts are append-with-dedupe, and serving from a
+    partially-recovered store beats refusing to boot."""
+    stats = RecoveryStats()
+    ops: list[tuple[str, list]] = []
+    segments = list_segments(wal_dir)
+    stats.segments = len(segments)
+    for i, (seq, path) in enumerate(segments):
+        is_last = i == len(segments) - 1
+        try:
+            for offset, payload in iter_segment_entries(path):
+                op, records = decode_entry(payload)
+                ops.append((op, records))
+                stats.entries += 1
+                stats.records += len(records)
+        except WalCorruption as exc:
+            torn_at = exc.args[1] if len(exc.args) > 1 else 0
+            stats.torn_entries += 1
+            stats.torn_bytes += max(os.path.getsize(path) - torn_at, 0)
+            if is_last:
+                logger.warning(
+                    "WAL %s: torn tail at byte %d (%s) — replaying the "
+                    "acked prefix", path, torn_at, exc.args[0],
+                )
+            else:
+                msg = (
+                    f"WAL {path}: corruption at byte {torn_at} in a "
+                    f"SEALED segment ({exc.args[0]}) — its remaining "
+                    "entries are lost"
+                )
+                stats.errors.append(msg)
+                logger.error(msg)
+    return ops, stats
+
+
+async def recover(
+    store, wal_dir: str, *, purge: bool = True, metrics=None
+) -> RecoveryStats:
+    """Replay the WAL into ``store`` (which must be initialized).
+    With ``purge`` (default), fully-replayed segments are deleted —
+    every batch was committed by the store call, so the log's job is
+    done. Store errors during replay leave the WAL intact for the next
+    attempt and are recorded in ``stats.errors``."""
+    ops, stats = scan_wal(wal_dir)
+    failed = False
+    for op, records in ops:
+        try:
+            if op == "insert":
+                await store.insert_records(records)
+            else:
+                await store.delete_records(records)
+        except Exception as exc:
+            failed = True
+            msg = f"WAL replay {op} of {len(records)} records failed: {exc}"
+            stats.errors.append(msg)
+            logger.exception(msg)
+            break  # keep ordering: don't apply past a failed batch
+    if purge and not failed:
+        for _seq, path in list_segments(wal_dir):
+            try:
+                os.unlink(path)
+                stats.purged_segments += 1
+            except OSError:
+                logger.exception("could not purge WAL segment %s", path)
+    if metrics is not None:
+        metrics.inc("durability.recovered_entries", stats.entries)
+        metrics.inc("durability.recovered_records", stats.records)
+        metrics.inc("durability.recovery_torn_entries", stats.torn_entries)
+    if stats.entries or stats.torn_entries:
+        logger.info(
+            "WAL recovery: %d entries (%d records) replayed from %d "
+            "segments, %d torn, %d purged",
+            stats.entries, stats.records, stats.segments,
+            stats.torn_entries, stats.purged_segments,
+        )
+    return stats

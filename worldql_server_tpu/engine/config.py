@@ -124,6 +124,40 @@ class Config:
     index_snapshot: str | None = field(
         default_factory=lambda: os.environ.get("WQL_INDEX_SNAPSHOT")
     )
+    # Record durability engine (worldql_server_tpu/durability):
+    # 'off'  = reference-equivalent — handlers await the store inline,
+    #          no WAL (the default, so tier-1 behavior is unchanged);
+    # 'wal'  = handlers ack after the WAL group-commit fsync, store
+    #          commits happen write-behind off the event loop;
+    # 'sync' = WAL with immediate fsync + inline store commit.
+    durability: str = field(
+        default_factory=lambda: _env("WQL_DURABILITY", "off")
+    )
+    # WAL segment directory (created on demand; only used when
+    # durability != 'off').
+    wal_dir: str = field(default_factory=lambda: _env("WQL_WAL_DIR", "wal"))
+    # Group-commit window: appends arriving within this many ms of the
+    # first in a batch share one fsync. The default 0 adds NO wait —
+    # each drained batch fsyncs immediately, and concurrent appends
+    # still coalesce naturally while a sync is in flight (same
+    # rationale as Postgres commit_delay=0). Raise it to trade handler
+    # latency for fewer syncs under sustained load.
+    wal_fsync_ms: float = field(
+        default_factory=lambda: float(_env("WQL_WAL_FSYNC_MS", "0"))
+    )
+    # Segment rotation threshold; sealed segments are deleted at each
+    # checkpoint once their entries reached the store.
+    wal_segment_bytes: int = field(
+        default_factory=lambda: int(
+            _env("WQL_WAL_SEGMENT_BYTES", str(64 * 1024 * 1024))
+        )
+    )
+    # Seconds between checkpoints (queue drain → index snapshot → WAL
+    # truncation); 0 disables the timer (still checkpoints at
+    # shutdown). Bounds crash-recovery time.
+    checkpoint_interval: float = field(
+        default_factory=lambda: float(_env("WQL_CHECKPOINT_INTERVAL", "60"))
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation
@@ -189,6 +223,16 @@ class Config:
             )
         if self.tick_interval < 0:
             errors.append("tick_interval must be >= 0")
+        if self.durability not in ("off", "wal", "sync"):
+            errors.append("durability must be 'off', 'wal' or 'sync'")
+        elif self.durability != "off" and not self.wal_dir:
+            errors.append(f"durability='{self.durability}' requires wal_dir")
+        if self.wal_fsync_ms < 0:
+            errors.append("wal_fsync_ms must be >= 0")
+        if self.wal_segment_bytes <= 0:
+            errors.append("wal_segment_bytes must be greater than 0")
+        if self.checkpoint_interval < 0:
+            errors.append("checkpoint_interval must be >= 0 (0 = no timer)")
         if self.mesh_batch <= 0:
             errors.append("mesh_batch must be greater than 0")
         if self.mesh_space < 0:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import time
 from collections import defaultdict
+from contextlib import contextmanager
 from typing import Callable
 
 # Bucket upper bounds in milliseconds (log-spaced), +inf implicit.
@@ -87,6 +88,17 @@ class Metrics:
         if hist is None:
             hist = self.histograms[name] = Histogram()
         hist.observe_ms(value_ms)
+
+    @contextmanager
+    def time_ms(self, name: str):
+        """Histogram-timed block: ``with metrics.time_ms("x_ms"): ...``
+        observes the block's wall time (including the error path — a
+        failing store call still cost that latency)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_ms(name, (time.perf_counter() - t0) * 1e3)
 
     def gauge(self, name: str, fn: Callable[[], object]) -> None:
         """Register a pull-style gauge; evaluated at snapshot time."""

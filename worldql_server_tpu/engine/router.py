@@ -3,7 +3,9 @@
 Python rebuild of the reference's processing thread + handlers
 (worldql_server/src/processing/). Dispatch table follows
 thread.rs:72-108: heartbeats are handled inline; subscription ops and
-pub/sub messages hit the spatial backend; record ops hit the store.
+pub/sub messages hit the spatial backend; record ops go through the
+durability frontend (worldql_server_tpu/durability) — inline store
+awaits in off mode, WAL + write-behind in wal/sync modes.
 Client-bound instructions (Handshake, PeerConnect/Disconnect,
 RecordReply) arriving inbound are dropped with a warning — the
 reference panics (thread.rs:74-79), but a client must never be able to
@@ -19,6 +21,7 @@ from __future__ import annotations
 import logging
 import uuid as uuid_mod
 
+from ..durability.pipeline import DurabilityPipeline
 from ..protocol import Instruction, Message, Replication
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..storage.store import RecordStore
@@ -43,6 +46,7 @@ class Router:
         store: RecordStore,
         ticker=None,
         metrics=None,
+        durability: DurabilityPipeline | None = None,
     ):
         self.peer_map = peer_map
         self.backend = backend
@@ -51,6 +55,14 @@ class Router:
         # batch instead of resolving immediately (engine/ticker.py).
         self.ticker = ticker
         self.metrics = metrics
+        # Every record op goes through the durability frontend — never
+        # `await self.store.…` directly (tools/check: store-on-loop).
+        # Without an injected pipeline, an off-mode pass-through keeps
+        # the reference-equivalent inline-store behavior.
+        self.durability = (
+            durability if durability is not None
+            else DurabilityPipeline(store, mode="off")
+        )
 
     async def handle_message(self, message: Message) -> None:
         """Route one inbound message (thread.rs:72-108). Never raises."""
@@ -237,7 +249,7 @@ class Router:
         if message.world_name == GLOBAL_WORLD:
             return
         try:
-            await self.store.insert_records(message.records)
+            await self.durability.insert_records(message.records)
         except Exception as exc:
             logger.warning(
                 "error inserting records for %s: %s", message.sender_uuid, exc
@@ -247,7 +259,7 @@ class Router:
         if message.world_name == GLOBAL_WORLD:
             return
         try:
-            await self.store.delete_records(message.records)
+            await self.durability.delete_records(message.records)
         except Exception as exc:
             logger.warning(
                 "error deleting records for %s: %s", message.sender_uuid, exc
@@ -276,7 +288,9 @@ class Router:
                 return
 
         try:
-            rows = await self.store.get_records_in_region(
+            # The durability frontend gives read-your-writes: in wal
+            # mode it flushes pending ops for this region first.
+            rows = await self.durability.get_records_in_region(
                 message.world_name, message.position, after
             )
         except Exception as exc:
@@ -315,7 +329,7 @@ class Router:
 
         # Read-repair in the background path (record_read.rs:126-130).
         try:
-            await self.store.dedupe_records(dedupe_ops)
+            await self.durability.dedupe_records(dedupe_ops)
         except Exception as exc:
             logger.warning("error deduping records for %s: %s", sender, exc)
 

@@ -73,9 +73,34 @@ class WorldQLServer:
                 self.backend, self.peer_map, config.tick_interval,
                 metrics=self.metrics,
             )
+        # Durability engine: WAL + write-behind pipeline. With
+        # durability='off' (default) both stay None and the Router's
+        # internal pass-through keeps reference-equivalent inline-store
+        # behavior.
+        self.wal = None
+        self.durability = None
+        self.last_recovery = None
+        if config.durability != "off":
+            from ..durability import DurabilityPipeline, WriteAheadLog
+
+            self.wal = WriteAheadLog(
+                config.wal_dir,
+                # sync mode = fsync per batch, no coalescing wait
+                fsync_ms=(
+                    0.0 if config.durability == "sync"
+                    else config.wal_fsync_ms
+                ),
+                segment_bytes=config.wal_segment_bytes,
+                metrics=self.metrics,
+            )
+            self.durability = DurabilityPipeline(
+                self.store, mode=config.durability, wal=self.wal,
+                config=config, metrics=self.metrics,
+            )
         self.router = Router(
             self.peer_map, self.backend, self.store,
             ticker=self.ticker, metrics=self.metrics,
+            durability=self.durability,
         )
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
@@ -101,6 +126,18 @@ class WorldQLServer:
                     "last_tick_ms": round(self.ticker.last_tick_ms, 3),
                 },
             )
+        if self.durability is not None:
+            self.metrics.gauge("durability", self.durability_status)
+
+    def durability_status(self) -> dict | None:
+        """Queue depth, WAL state, and last recovery for /healthz and
+        the ``durability`` gauge; None when durability is off."""
+        if self.durability is None:
+            return None
+        status = self.durability.stats()
+        if self.last_recovery is not None:
+            status["recovery"] = self.last_recovery.as_dict()
+        return status
 
     def _on_peer_remove(self, uuid) -> None:
         """Disconnect cleanup: purge the spatial index (the remove_rx
@@ -114,6 +151,20 @@ class WorldQLServer:
     async def start(self) -> None:
         """Bring up the store and all enabled transports (main.rs:106-207)."""
         await self.store.init()
+        if self.wal is not None:
+            # Replay whatever the last process acked but never applied,
+            # THEN open a fresh segment for this process's appends.
+            from ..durability.recovery import recover
+
+            self.last_recovery = await recover(
+                self.store, self.config.wal_dir, metrics=self.metrics
+            )
+            self.wal.start()
+            self.durability.start()
+            if self.config.checkpoint_interval > 0:
+                self._tasks.append(asyncio.create_task(
+                    self._checkpoint_loop(), name="checkpoint"
+                ))
         self._restore_index_snapshot()
 
         if self.config.ws_enabled:
@@ -194,17 +245,20 @@ class WorldQLServer:
             )
             self._snapshot_save_disabled = True
 
-    def _save_index_snapshot(self) -> None:
+    def _save_index_snapshot(self, sweep_restored: bool = True) -> None:
         path = self.config.index_snapshot
         if not path:
             return
         # Complete any pending restored-peer sweep synchronously first:
         # a restart shorter than the staleness window must not
-        # re-persist ghost rows forever.
-        for peer in self._restored_peers:
-            if self.peer_map.get(peer) is None:
-                self.backend.remove_peer(peer)
-        self._restored_peers = []
+        # re-persist ghost rows forever. Periodic checkpoints pass
+        # sweep_restored=False — mid-serving, restored peers may still
+        # be inside their reconnect grace window.
+        if sweep_restored:
+            for peer in self._restored_peers:
+                if self.peer_map.get(peer) is None:
+                    self.backend.remove_peer(peer)
+            self._restored_peers = []
         if self._snapshot_save_disabled:
             logger.warning(
                 "index snapshot %s NOT saved: the boot-time load failed "
@@ -235,6 +289,30 @@ class WorldQLServer:
                 "reconnect", swept,
             )
 
+    async def _checkpoint_loop(self) -> None:
+        """Periodic checkpoint timer — bounds the WAL (and therefore
+        crash-recovery time) while serving."""
+        interval = self.config.checkpoint_interval
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.checkpoint()
+            except Exception:
+                logger.exception("checkpoint failed — will retry")
+
+    async def checkpoint(self) -> bool:
+        """Store flush → index snapshot → WAL segment truncation.
+        Returns True when the WAL was actually truncated (i.e. every
+        pending write-behind op reached the store first)."""
+        if self.wal is None:
+            return False
+        await self.durability.drain()
+        self._save_index_snapshot(sweep_restored=False)
+        purged = await self.wal.checkpoint()
+        self.metrics.inc("durability.checkpoints")
+        logger.debug("checkpoint complete: %d WAL segments purged", purged)
+        return True
+
     async def stop(self) -> None:
         # Snapshot FIRST, synchronously: closing transports evicts the
         # still-connected peers (disconnect cleanup would empty the
@@ -255,6 +333,17 @@ class WorldQLServer:
         for transport in reversed(self._transports):
             await transport.stop()
         self._transports.clear()
+        if self.durability is not None:
+            # Drain the write-behind queue, then truncate the WAL only
+            # on a CLEAN drain — a wedged store keeps its segments for
+            # boot-time replay.
+            drained = await self.durability.stop()
+            if drained:
+                try:
+                    await self.wal.checkpoint()
+                except Exception:
+                    logger.exception("shutdown WAL checkpoint failed")
+            await self.wal.close()
         await self.store.close()
 
     async def run_forever(self) -> None:

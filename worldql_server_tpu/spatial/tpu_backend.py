@@ -126,22 +126,23 @@ def probe_tables(sorted_keys, sorted_keys2, *, n_buckets: int):
     ``tbl`` is [B, 3E] i32: each bucket row holds E first-key TAGS
     (top-32 bits; pad 0), E second-family verify tags (top-32 bits of
     key2), and E run-start indices into the sorted segment (pad -1).
-    A query resolves its run with ONE [M, 3E] i32 row gather plus one
-    [M] i32 run-remainder element gather — the second-family
-    verification rides the same row, so no separate i64 exactness
-    gather runs (measured −0.3 ms at 16K queries, −14 ms at the 1M
-    batch on v5e; row-gather cost is pure bytes).
+    A query resolves its run with ONE [M, 3E] i32 row gather plus two
+    element gathers ([M] i32 run remainder, [M] i64 key2 backstop) —
+    the second-family TAG rides the row to reject almost every
+    collision cheaply, and the run-start row's full key2 settles the
+    rest.
 
     Exactness contract: a probe hit proves bucket (log2 B bits of an
-    independent mix of key1) + key1 tag (32 bits) + key2 tag (32
-    independent bits) agreement — ~2^-85 odds of mis-routing a query
-    to a wrong run at B = 2^21 (the binary-search fallback verifies
-    the full key pair; both families are already hashes of the same
-    (world, cube), hashing.py). A cube whose (bucket, key1-tag)
-    collides with a DIFFERENT cube — the case where the row alone
-    could pick the wrong lane — is detected here at build time and
-    routes the segment to the binary-search fallback via ``oflow``,
-    exactly like bucket overflow: slower, never wrong.
+    independent mix of key1) + key1 tag (32 bits) agreement to pick
+    the lane, then FULL 64-bit key2 equality at the run-start row
+    (_probe_run_bounds) — the same exact-match contract as the
+    binary-search fallback, so a cross-cube tag1+tag2 double collision
+    can no longer mis-route silently (ADVICE r5; both families are
+    already hashes of the same (world, cube), hashing.py). A cube
+    whose (bucket, key1-tag) collides with a DIFFERENT cube — the case
+    where the row alone could pick the wrong lane — is detected here
+    at build time and routes the segment to the binary-search fallback
+    via ``oflow``, exactly like bucket overflow: slower, never wrong.
 
     Returns ``(tbl [B, 3E] i32, oflow [1] i32)`` — ``oflow[0]`` counts
     cubes that overflowed their bucket's E slots or tag-collided
@@ -208,10 +209,10 @@ def probe_tables(sorted_keys, sorted_keys2, *, n_buckets: int):
     return tbl.reshape(n_buckets, 3 * e), oflow
 
 
-def _probe_run_bounds(tbl, sub_rem, q_key, q_key2):
+def _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2):
     """Per-query (run start, run length) via ONE packed bucket-row
-    gather + the run-remainder element gather. See probe_tables for
-    the exactness contract."""
+    gather + two element gathers (run remainder, key2 backstop). See
+    probe_tables for the exactness contract."""
     s = sub_rem.shape[0]
     nb = tbl.shape[0]
     e = tbl.shape[1] // 3
@@ -226,7 +227,12 @@ def _probe_run_bounds(tbl, sub_rem, q_key, q_key2):
         & (rows[:, e:2 * e] == q_tag2[:, None])
     lo = jnp.where(hit, rows[:, 2 * e:], jnp.int32(-1)).max(axis=1)
     li = jnp.clip(lo, 0, s - 1)
-    found = lo >= 0
+    # True-equality backstop (ADVICE r5): one [M] i64 element gather
+    # verifies the FULL key2 at the run-start row, closing the
+    # cross-cube tag1+tag2 double-collision hole the 32+32-bit row
+    # tags leave open — the probe branch now enforces the same exact-
+    # match contract as the binary-search fallback.
+    found = (lo >= 0) & (sub_key2[li] == q_key2)
     return li, jnp.where(found, sub_rem[li], 0)
 
 
@@ -239,7 +245,7 @@ def _seg_run_bounds(seg, q_key, q_key2):
     return jax.lax.cond(
         oflow[0] > 0,
         lambda: _run_bounds(sub_key, sub_key2, sub_rem, q_key, q_key2),
-        lambda: _probe_run_bounds(tbl, sub_rem, q_key, q_key2),
+        lambda: _probe_run_bounds(tbl, sub_key2, sub_rem, q_key, q_key2),
     )
 
 

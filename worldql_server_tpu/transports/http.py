@@ -56,7 +56,17 @@ class HttpTransport:
         return auth.startswith("Bearer ") and auth[len("Bearer "):] == token
 
     async def _get_healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        body = {"status": "ok"}
+        # Durability state rides health (queue depth, WAL segments,
+        # last recovery) — an operator probing a draining/replaying
+        # node needs this before scraping full metrics. Omitted when
+        # durability is off so the reference-equivalent body stays
+        # byte-for-byte identical.
+        status_fn = getattr(self.server, "durability_status", None)
+        status = status_fn() if status_fn is not None else None
+        if status is not None:
+            body["durability"] = status
+        return web.json_response(body)
 
     async def _get_metrics(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
