@@ -141,6 +141,26 @@ def test_wal_group_commit_coalesces_fsyncs(tmp_path):
     assert len(ops) == 20
 
 
+def test_wal_rotate_and_purge_upto_respect_boundary(tmp_path):
+    """purge_upto must delete exactly the segments sealed at (or
+    before) the rotate boundary — an entry appended AFTER the rotate
+    lands past the boundary and survives."""
+
+    async def scenario():
+        wal = WriteAheadLog(str(tmp_path), fsync_ms=0)
+        wal.start()
+        await wal.append(encode_insert([make_record(0)]))
+        boundary = await wal.rotate()
+        await wal.append(encode_insert([make_record(1)]))
+        purged = await wal.purge_upto(boundary)
+        assert purged == 1
+        await wal.close()
+
+    run(scenario())
+    ops, _ = scan_wal(str(tmp_path))
+    assert [r.uuid for _, rr in ops for r in rr] == [uuid.UUID(int=2)]
+
+
 def test_wal_checkpoint_truncates_segments(tmp_path):
     async def scenario():
         wal = WriteAheadLog(str(tmp_path), fsync_ms=0, segment_bytes=256)
@@ -310,6 +330,25 @@ def test_recovery_crc_corruption_stops_replay_at_entry(tmp_path):
     assert {sr.record.uuid for sr in rows} == {recs[0].uuid, recs[1].uuid}
 
 
+def test_recovery_tolerates_undecodable_entry(tmp_path):
+    """A CRC-valid entry whose payload no longer decodes (codec drift:
+    deserialize raises ValueError/struct.error, NOT WalCorruption) must
+    be treated like a torn entry — replay the decoded prefix and keep
+    booting, never abort recovery."""
+    from worldql_server_tpu.durability.wal import segment_name
+
+    good = encode_insert([make_record(0)])
+    blob = MAGIC + frame_entry(good) + frame_entry(b"\xff" * 16)
+    (tmp_path / segment_name(0)).write_bytes(blob)
+
+    store = MemoryRecordStore(config())
+    stats = run(recover(store, str(tmp_path)))
+    assert stats.entries == 1
+    assert stats.torn_entries == 1
+    rows = run(store.get_records_in_region("w", Vector3(1, 2, 3)))
+    assert [sr.record.uuid for sr in rows] == [uuid.UUID(int=1)]
+
+
 def test_decode_entry_rejects_foreign_instruction():
     from worldql_server_tpu.durability.wal import WalCorruption
     from worldql_server_tpu.protocol.codec import serialize_message
@@ -402,6 +441,73 @@ def test_pipeline_backpressure_bounds_queue(tmp_path):
         await wal.close()
         rows = await store.get_records_in_region("w", Vector3(1, 2, 3))
         assert len(rows) == 4
+
+    run(scenario())
+
+
+def test_pipeline_enqueues_before_wal_ack(tmp_path):
+    """The op must be sequenced (covered by drain/read barriers and by
+    a checkpoint's drain) BEFORE its WAL append resolves — this closes
+    the append→enqueue window through which a concurrent checkpoint
+    could truncate an acked-but-unapplied entry's segment."""
+
+    class BlockedWal(WriteAheadLog):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.release = asyncio.Event()
+
+        async def append(self, payload):
+            await self.release.wait()
+            await super().append(payload)
+
+    async def scenario():
+        store = MemoryRecordStore(config())
+        wal = BlockedWal(str(tmp_path), fsync_ms=0)
+        wal.start()
+        pipe = DurabilityPipeline(store, mode="wal", wal=wal, config=config())
+        pipe.start()
+        task = asyncio.create_task(pipe.insert_records([make_record(0)]))
+        await asyncio.sleep(0.05)
+        assert not task.done(), "append should still be blocked"
+        assert pipe.stats()["enqueued"] == 1, (
+            "op not sequenced before its WAL ack"
+        )
+        wal.release.set()
+        await asyncio.wait_for(task, 5)
+        assert await pipe.stop()
+        await wal.close()
+
+    run(scenario())
+
+
+def test_pipeline_prunes_region_seq_map(tmp_path):
+    """The per-region high-water map must not grow one entry per
+    region ever written: applied entries are pruned as the watermark
+    advances (amortized via a doubling threshold)."""
+
+    async def scenario():
+        store = MemoryRecordStore(config())
+        wal = WriteAheadLog(str(tmp_path), fsync_ms=0)
+        wal.start()
+        pipe = DurabilityPipeline(
+            store, mode="wal", wal=wal, config=config(),
+            prune_regions_above=4,
+        )
+        pipe.start()
+        for i in range(64):
+            # x stride far exceeds the DB region x size: 64 distinct regions
+            await pipe.insert_records([make_record(i, x=float(i * 1000))])
+        await pipe.drain()
+        assert len(pipe._region_seq) <= 4, (
+            f"region map not pruned: {len(pipe._region_seq)} entries"
+        )
+        # barriers still correct after pruning: applied regions don't wait
+        rows = await asyncio.wait_for(
+            pipe.get_records_in_region("w", Vector3(0.0, 2, 3)), 2
+        )
+        assert [sr.record.uuid for sr in rows] == [uuid.UUID(int=1)]
+        assert await pipe.stop()
+        await wal.close()
 
     run(scenario())
 
@@ -573,6 +679,107 @@ def test_server_graceful_cycle_checkpoints_wal(tmp_path):
         await server.stop()
 
     run(second_boot())
+
+
+class FlakyStore(MemoryRecordStore):
+    """Fails the first ``fail_inserts`` insert batches (transient store
+    error: disk full, lock timeout), then behaves normally."""
+
+    def __init__(self, cfg, fail_inserts=1):
+        super().__init__(cfg)
+        self.fail_inserts = fail_inserts
+
+    async def insert_records(self, records):
+        if self.fail_inserts > 0:
+            self.fail_inserts -= 1
+            raise RuntimeError("transient store error")
+        return await super().insert_records(records)
+
+
+def test_dropped_batch_blocks_wal_truncation(tmp_path):
+    """A write-behind batch dropped on a store error must survive in
+    the WAL: neither the periodic checkpoint nor shutdown may truncate
+    it, and the NEXT boot's replay re-applies it — no crash required to
+    hit this path, just a transient store failure."""
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    wal_dir = str(tmp_path / "wal")
+    cfg = Config(
+        store_url="memory://", durability="wal", wal_dir=wal_dir,
+        checkpoint_interval=0,
+        http_enabled=False, ws_enabled=False, zmq_enabled=False,
+    )
+    rec = make_record(0)
+
+    async def scenario():
+        server = WorldQLServer(cfg, store=FlakyStore(cfg))
+        await server.start()
+        await server.router.handle_message(Message(
+            instruction=Instruction.RECORD_CREATE,
+            sender_uuid=uuid.uuid4(), world_name="w", records=[rec],
+        ))
+        await server.durability.drain()  # batch dropped, drain still completes
+        assert server.durability.dropped_batches == 1
+        assert await server.checkpoint() is False
+        ops, _ = scan_wal(wal_dir)
+        assert [op for op, _ in ops] == ["insert"], (
+            "checkpoint truncated a WAL entry whose batch was dropped"
+        )
+        await server.stop()
+
+    run(scenario())
+    # shutdown must not have truncated either
+    ops, _ = scan_wal(wal_dir)
+    assert [op for op, _ in ops] == ["insert"]
+
+    async def next_boot():
+        store = MemoryRecordStore(cfg)
+        stats = await recover(store, wal_dir)
+        assert stats.entries == 1
+        rows = await store.get_records_in_region("w", Vector3(1, 2, 3))
+        assert [sr.record.uuid for sr in rows] == [rec.uuid]
+
+    run(next_boot())
+
+
+def test_checkpoint_waits_for_pending_applies(tmp_path):
+    """checkpoint() must not purge a segment while its ops are still in
+    the write-behind queue: the drain between rotate and purge holds
+    the truncation until the store really has everything."""
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    wal_dir = str(tmp_path / "wal")
+    cfg = Config(
+        store_url="memory://", durability="wal", wal_dir=wal_dir,
+        checkpoint_interval=0,
+        http_enabled=False, ws_enabled=False, zmq_enabled=False,
+    )
+    rec = make_record(0)
+
+    async def scenario():
+        store = GatedStore(cfg)
+        server = WorldQLServer(cfg, store=store)
+        await server.start()
+        await server.router.handle_message(Message(
+            instruction=Instruction.RECORD_CREATE,
+            sender_uuid=uuid.uuid4(), world_name="w", records=[rec],
+        ))
+        ckpt = asyncio.create_task(server.checkpoint())
+        await asyncio.sleep(0.05)
+        assert not ckpt.done(), "checkpoint returned before the apply"
+        ops, _ = scan_wal(wal_dir)
+        assert [op for op, _ in ops] == ["insert"], (
+            "checkpoint purged an unapplied entry's segment"
+        )
+        store.gate.set()
+        assert await asyncio.wait_for(ckpt, 5) is True
+        ops, _ = scan_wal(wal_dir)
+        assert ops == []
+        rows = await store.get_records_in_region("w", Vector3(1, 2, 3))
+        assert [sr.record.uuid for sr in rows] == [rec.uuid]
+        await server.stop()
+
+    run(scenario())
 
 
 def test_config_validates_durability_knobs():

@@ -20,6 +20,21 @@ Reads of untouched regions never wait.
 Dedupe (read-repair) ops ride the queue but are NOT WAL-logged: they
 are derivable — any lost dedupe is redone by the next read of that
 region, per the store's append-with-dedupe-on-read contract.
+
+Ordering invariant: in ``wal`` mode an op is ENQUEUED (sequence
+stamped, region map updated) before its WAL append is awaited. Any
+entry that reaches the log therefore belongs to an already-sequenced
+op, so a checkpoint that rotates the WAL and then drains provably
+covers every entry in the segments it purges — there is no
+append→enqueue window for a truncation to slip through.
+
+Failed batches: a store error drops the batch from the queue (barriers
+must never deadlock on a wedged store) but bumps ``dropped_batches``,
+which the server reads to SKIP WAL truncation — both the periodic
+checkpoint and shutdown keep every segment, so the dropped entries are
+re-applied by boot-time replay. Replay re-runs the whole retained
+prefix in WAL order, so already-applied neighbors are harmless
+(append-with-dedupe-on-read; deletes are idempotent).
 """
 
 from __future__ import annotations
@@ -52,6 +67,7 @@ class DurabilityPipeline:
         metrics=None,
         max_queue: int = 1024,
         max_batch_records: int = 512,
+        prune_regions_above: int = 1024,
     ):
         if mode not in MODES:
             raise ValueError(f"durability mode must be one of {MODES}")
@@ -72,8 +88,17 @@ class DurabilityPipeline:
         self._seq = 0
         self._applied = 0
         self._region_seq: dict[tuple, int] = {}
+        # amortized O(1) pruning: rebuild the map once it outgrows the
+        # threshold, then set the next threshold to twice the survivors
+        self._prune_min = prune_regions_above
+        self._prune_at = prune_regions_above
         self._waiters: list[tuple[int, asyncio.Future]] = []
         self.apply_errors = 0
+        #: insert/delete batches dropped on store errors — while > 0
+        #: the server must NOT truncate the WAL (the dropped entries
+        #: exist only there, awaiting boot-time replay). Dedupe drops
+        #: don't count: they are derivable and never WAL-logged.
+        self.dropped_batches = 0
 
     # region: lifecycle
 
@@ -86,9 +111,10 @@ class DurabilityPipeline:
     async def stop(self, drain_timeout: float = 30.0) -> bool:
         """Drain then stop the applier. Returns True when everything
         pending reached the store. On a wedged store the drain times
-        out and pending ops are abandoned — they are already in the
-        WAL, so the next boot's recovery replays them (dedupe ops are
-        the exception and are derivable)."""
+        out and pending ops are abandoned — every op acked to a client
+        is in the WAL (the append resolves before the handler returns),
+        so the next boot's recovery replays them (dedupe ops are the
+        exception and are derivable)."""
         drained = True
         if self._task is not None:
             try:
@@ -115,6 +141,7 @@ class DurabilityPipeline:
             "enqueued": self._seq,
             "applied": self._applied,
             "apply_errors": self.apply_errors,
+            "dropped_batches": self.dropped_batches,
         }
         if self.wal is not None:
             out.update(self.wal.stats())
@@ -127,19 +154,25 @@ class DurabilityPipeline:
     async def insert_records(self, records: list[Record]) -> int:
         if self.mode == "off" or not records:
             return await self.store.insert_records(records)
-        await self.wal.append(encode_insert(records))
         if self.mode == "sync":
+            await self.wal.append(encode_insert(records))
             return await self.store.insert_records(records)
+        # enqueue BEFORE the WAL ack (module docstring: the ordering
+        # invariant checkpoints rely on). If the append then fails the
+        # op still reaches the store through the queue while the
+        # handler raises — at-least-once, never an acked-but-lost write.
         await self._enqueue("insert", records)
+        await self.wal.append(encode_insert(records))
         return len(records)
 
     async def delete_records(self, records: list[Record]) -> int:
         if self.mode == "off" or not records:
             return await self.store.delete_records(records)
-        await self.wal.append(encode_delete(records))
         if self.mode == "sync":
+            await self.wal.append(encode_delete(records))
             return await self.store.delete_records(records)
         await self._enqueue("delete", records)
+        await self.wal.append(encode_delete(records))
         return 0
 
     async def dedupe_records(self, ops: list[DedupeOp]) -> int:
@@ -239,9 +272,10 @@ class DurabilityPipeline:
         same kind coalesce into one ``executemany``-sized batch (order
         between kinds is preserved — an insert→delete pair for the same
         record can never invert). A store error drops that batch with a
-        log line but still advances the applied watermark: barriers
-        must never deadlock on a failing store, and the WAL retains the
-        ops for recovery."""
+        log line but still advances the applied watermark (barriers
+        must never deadlock on a failing store); the drop is counted in
+        ``dropped_batches``, which blocks WAL truncation so boot-time
+        replay re-applies the entries (module docstring)."""
         pending: tuple | None = None
         while True:
             item = pending if pending is not None else await self._queue.get()
@@ -265,6 +299,20 @@ class DurabilityPipeline:
             else:
                 await self._apply(kind, batch)
             self._applied = seq
+            # prune applied regions: at quiesce (empty queue) always,
+            # under load once the map outgrows the doubling threshold —
+            # amortized O(1) per batch either way
+            if len(self._region_seq) > self._prune_min and (
+                self._queue.qsize() == 0
+                or len(self._region_seq) > self._prune_at
+            ):
+                applied = self._applied
+                self._region_seq = {
+                    r: s for r, s in self._region_seq.items() if s > applied
+                }
+                self._prune_at = max(
+                    self._prune_min, 2 * len(self._region_seq)
+                )
             self._wake_waiters()
 
     async def _apply(self, kind: str, batch: list) -> None:
@@ -281,10 +329,19 @@ class DurabilityPipeline:
             self.apply_errors += 1
             if self.metrics is not None:
                 self.metrics.inc("durability.apply_errors")
-            logger.exception(
-                "write-behind %s batch of %d failed — dropped from "
-                "the queue (WAL retains it for recovery)",
-                kind, len(batch),
-            )
+            if kind == "dedupe":
+                logger.exception(
+                    "write-behind dedupe batch of %d failed — dropped "
+                    "(derivable: the next read of the region redoes it)",
+                    len(batch),
+                )
+            else:
+                self.dropped_batches += 1
+                logger.exception(
+                    "write-behind %s batch of %d failed — dropped from "
+                    "the queue; WAL truncation is now disabled so "
+                    "boot-time replay re-applies it",
+                    kind, len(batch),
+                )
 
     # endregion

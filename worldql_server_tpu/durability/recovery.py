@@ -110,7 +110,18 @@ def scan_wal(wal_dir: str) -> tuple[list[tuple[str, list]], RecoveryStats]:
         is_last = i == len(segments) - 1
         try:
             for offset, payload in iter_segment_entries(path):
-                op, records = decode_entry(payload)
+                try:
+                    op, records = decode_entry(payload)
+                except WalCorruption as exc:
+                    raise WalCorruption(exc.args[0], offset) from exc
+                except Exception as exc:
+                    # CRC-valid but undecodable (codec drift, e.g. a
+                    # version change): same policy as bit rot — keep
+                    # the decoded prefix, keep booting. Must never
+                    # escape scan_wal and abort recovery.
+                    raise WalCorruption(
+                        f"entry decode failed: {exc!r}", offset
+                    ) from exc
                 ops.append((op, records))
                 stats.entries += 1
                 stats.records += len(records)

@@ -167,11 +167,36 @@ class WriteAheadLog:
         self._q.put(("write", frame_entry(payload), fut))
         await fut
 
+    async def rotate(self) -> int:
+        """Seal the current segment (flush + fsync + close) and open a
+        fresh one; returns the sealed segment's seq. New appends land
+        strictly past the returned boundary — the first half of a
+        checkpoint: rotate, THEN drain the pipeline, THEN
+        :meth:`purge_upto` the boundary, so a handler mid-append can
+        never slip an entry into a segment the checkpoint purges."""
+        if self._thread is None:
+            return -1  # never started (failed boot): nothing to seal
+        fut = self._loop.create_future()
+        self._q.put(("rotate", None, fut))
+        return await fut
+
+    async def purge_upto(self, boundary: int) -> int:
+        """Delete every sealed segment with seq <= ``boundary``. Only
+        call once every entry in those segments has provably reached
+        the store: a completed pipeline drain AFTER the :meth:`rotate`
+        that returned ``boundary``. Returns segments deleted."""
+        if self._thread is None or boundary < 0:
+            return 0
+        fut = self._loop.create_future()
+        self._q.put(("purge", boundary, fut))
+        return await fut
+
     async def checkpoint(self) -> int:
-        """Seal the current segment and delete every older one. Only
-        call after the write-behind queue fully drained — a checkpoint
-        declares "everything before this point is in the store".
-        Returns the number of segments deleted."""
+        """Seal the current segment and delete every older one — the
+        SHUTDOWN-time truncation: only safe when no concurrent append
+        can arrive (transports stopped, applier drained); while serving
+        use rotate → drain → purge_upto instead. Returns the number of
+        segments deleted."""
         if self._thread is None:
             return 0  # never started (failed boot): nothing to truncate
         fut = self._loop.create_future()
@@ -242,7 +267,7 @@ class WriteAheadLog:
 
     def _process_batch(self, batch: list) -> bool:
         writes = [(frame, fut) for op, frame, fut in batch if op == "write"]
-        controls = [(op, fut) for op, _, fut in batch if op != "write"]
+        controls = [(op, arg, fut) for op, arg, fut in batch if op != "write"]
 
         if writes:
             t0 = time.perf_counter()
@@ -262,8 +287,26 @@ class WriteAheadLog:
                     [fut for _, fut in writes], None, fsync_ms, len(writes)
                 )
 
-        for op, fut in controls:
-            if op == "checkpoint":
+        for op, arg, fut in controls:
+            if op == "rotate":
+                try:
+                    self._rotate()
+                    self._resolve([fut], None, result=self._seq - 1)
+                except Exception as exc:
+                    logger.exception("WAL rotate failed")
+                    self._resolve([fut], exc)
+            elif op == "purge":
+                try:
+                    purged = 0
+                    for seq, path in list_segments(self.dir):
+                        if seq <= arg and seq < self._seq:
+                            os.unlink(path)
+                            purged += 1
+                    self._resolve([fut], None, result=purged)
+                except Exception as exc:
+                    logger.exception("WAL purge failed")
+                    self._resolve([fut], exc)
+            elif op == "checkpoint":
                 try:
                     self._rotate()
                     purged = 0

@@ -303,13 +303,31 @@ class WorldQLServer:
     async def checkpoint(self) -> bool:
         """Store flush → index snapshot → WAL segment truncation.
         Returns True when the WAL was actually truncated (i.e. every
-        pending write-behind op reached the store first)."""
+        pending write-behind op reached the store first).
+
+        Rotates FIRST: ops enqueue before their WAL append (pipeline
+        ordering invariant), so once the rotate returns, every entry in
+        the sealed segments belongs to an op the drain below covers — a
+        handler mid-append can never slip an entry into a segment this
+        checkpoint purges. Truncation is skipped entirely once any
+        write-behind batch was dropped on a store error: those entries
+        exist ONLY in the WAL, and boot-time replay (of the whole
+        retained prefix, in order) is what re-applies them."""
         if self.wal is None:
             return False
+        boundary = await self.wal.rotate()
         await self.durability.drain()
         self._save_index_snapshot(sweep_restored=False)
-        purged = await self.wal.checkpoint()
         self.metrics.inc("durability.checkpoints")
+        if self.durability.dropped_batches:
+            logger.warning(
+                "checkpoint: %d write-behind batches were dropped on "
+                "store errors — WAL truncation skipped; segments are "
+                "kept for boot-time replay",
+                self.durability.dropped_batches,
+            )
+            return False
+        purged = await self.wal.purge_upto(boundary)
         logger.debug("checkpoint complete: %d WAL segments purged", purged)
         return True
 
@@ -335,14 +353,22 @@ class WorldQLServer:
         self._transports.clear()
         if self.durability is not None:
             # Drain the write-behind queue, then truncate the WAL only
-            # on a CLEAN drain — a wedged store keeps its segments for
-            # boot-time replay.
+            # on a CLEAN drain with no batch ever dropped — a wedged
+            # store (timeout) or a dropped batch (store error) keeps
+            # the segments for boot-time replay.
             drained = await self.durability.stop()
-            if drained:
+            if drained and self.durability.dropped_batches == 0:
                 try:
                     await self.wal.checkpoint()
                 except Exception:
                     logger.exception("shutdown WAL checkpoint failed")
+            else:
+                logger.warning(
+                    "shutdown without WAL truncation (%s) — segments "
+                    "kept for boot-time replay",
+                    "drain timed out" if not drained else
+                    f"{self.durability.dropped_batches} dropped batches",
+                )
             await self.wal.close()
         await self.store.close()
 
