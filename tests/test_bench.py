@@ -6,6 +6,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 ENV = {
@@ -116,6 +118,23 @@ def test_bench_config6_record_op_durability():
     assert rec["value"] == rec["wal_p99_ms"]
     assert rec["ops"] == 300
     assert "durability=wal" in stderr
+
+
+@pytest.mark.slow   # CI's bench-smoke step runs this path directly
+def test_bench_smoke_forces_compacted_collect():
+    """--smoke (the CI regression gate for ISSUE 3): config-5 on tiny
+    CPU shapes with the on-device result compaction forced on and the
+    WS delivery pump skipped. The run itself asserts the compacted
+    collect path fired; the JSON carries the fetch counters and the
+    pipeline-fill tick recorded outside the percentiles."""
+    records, stderr = run_bench("--config", "5", "--smoke")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "local_fanout_engine_tick_ms"
+    assert rec["compact_fetches"] > 0
+    assert rec["server_delivery"] is None
+    assert rec["first_tick_ms_depth2"] > 0
+    assert "smoke:" in stderr and "parity check" in stderr
 
 
 def test_bench_all_emits_one_line_per_config():

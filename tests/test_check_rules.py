@@ -184,7 +184,10 @@ def test_host_sync_fires_in_hot_function_of_tick_module():
             return np.asarray(flat)[:total]
     """
     assert violations(src, relpath=TICK_MODULE) == [
-        ("jax-host-sync", 7), ("jax-host-sync", 8)
+        ("jax-host-sync", 7),
+        # the flat fetch additionally trips the full-fetch rule (it IS
+        # a cap-padded array materialization on the tick path)
+        ("full-fetch-on-tick", 8), ("jax-host-sync", 8),
     ]
 
 
@@ -226,6 +229,100 @@ def test_host_sync_pragma_allows_designated_collect_point():
             return np.asarray(handle)  # wql: allow(jax-host-sync)
     """
     assert rules_fired(src, relpath=TICK_MODULE) == set()
+
+
+# endregion
+
+# region: full-fetch-on-tick
+
+
+def test_full_fetch_fires_on_flat_fetch_in_collect():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            counts, flat, total = handle
+            return np.asarray(flat)
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-fetch-on-tick") == [
+        ("full-fetch-on-tick", 7)
+    ]
+
+
+def test_full_fetch_fires_via_assignment_target_name():
+    """`tgt = np.asarray(payload[1])[:m]` names nothing fat in the
+    argument — the destination identifies the dense target table."""
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            m, payload = handle
+            tgt = np.asarray(payload[1])[:m]
+            return tgt
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-fetch-on-tick") == [
+        ("full-fetch-on-tick", 7)
+    ]
+
+
+def test_full_fetch_fires_on_device_get():
+    src = """
+    import jax
+
+    class Backend:
+        def _dispatch(self, queries, segs, ks, kinds):
+            return jax.device_get(self._flat)
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-fetch-on-tick") == [
+        ("full-fetch-on-tick", 6)
+    ]
+
+
+def test_full_fetch_quiet_on_small_fetches_and_cold_paths():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            counts, flat, total = handle
+            counts_np = np.asarray(counts)     # [M, nseg] — small
+            packed_np = np.asarray(self.packed)  # compacted lanes
+            return counts_np, packed_np
+
+        def export_rows(self):
+            # maintenance path, not the tick path
+            return np.asarray(self._flat)
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-fetch-on-tick") == []
+    # and the same fetches are free outside the tick modules entirely
+    src2 = """
+    import numpy as np
+
+    def collect_local_batch(handle):
+        flat = np.asarray(handle)
+        return flat
+    """
+    assert violations(src2, relpath="worldql_server_tpu/storage/x.py",
+                      select="full-fetch-on-tick") == []
+
+
+def test_full_fetch_pragma_allows_designated_fallback():
+    src = """
+    import numpy as np
+
+    class Backend:
+        def collect_local_batch(self, handle):
+            counts, flat, total = handle
+            return np.asarray(flat)  # wql: allow(full-fetch-on-tick)
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-fetch-on-tick") == []
 
 
 # endregion
@@ -459,7 +556,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 8
+    assert len(names) >= 9
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -467,6 +564,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-host-sync",
         "jax-jit-in-loop",
         "jax-traced-branch",
+        "full-fetch-on-tick",
         "store-on-loop",
         "wire-mutable-buffer",
     }

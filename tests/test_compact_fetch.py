@@ -1,0 +1,322 @@
+"""On-device CSR result compaction (tpu_backend.pack_csr, ISSUE 3).
+
+The compacted fetch must be BIT-IDENTICAL to the full-fetch path: the
+pack kernel emits exactly the lanes `_decode_csr` would read from the
+zoned layout, in the same order, so `_decode_packed` over cumsum
+offsets yields the same UUID lists — including -1 holes, multi-segment
+(delta) indexes, every replication mode, the overflow fallback, and
+the sharded per-batch-shard regions.
+"""
+
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.protocol.types import Replication
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.spatial.tpu_backend import (
+    CSR_ROW, CSR_ROW_B, TpuSpatialBackend,
+)
+
+W = "world"
+
+
+def _peers(n, base=0):
+    return [uuid.UUID(int=base + i + 1) for i in range(n)]
+
+
+def build_hot_cold(backend=None, hot_cubes=6, hot_occupancy=40, cold=200):
+    b = backend if backend is not None else TpuSpatialBackend(
+        16, compact_threshold=32
+    )
+    cubes, peers = [], []
+    pid = 0
+    for h in range(hot_cubes):
+        for _ in range(hot_occupancy):
+            cubes.append([16 * (h + 1), 16, 16])
+            peers.append(uuid.UUID(int=pid + 1))
+            pid += 1
+    for c in range(cold):
+        cubes.append([16 * (c + 1), 16 * 50, 16])
+        peers.append(uuid.UUID(int=pid + 1))
+        pid += 1
+    b.bulk_add_subscriptions(W, peers, np.asarray(cubes, np.int64))
+    b.flush()
+    b.wait_compaction()
+    return b, np.asarray(cubes, np.float64) - 0.5, peers
+
+
+def query_batch(b, positions, senders, repl=Replication.EXCEPT_SELF):
+    m = len(positions)
+    return (
+        np.zeros(m, np.int32),
+        np.asarray(positions, np.float64),
+        np.asarray([b._peer_ids.get(s, -1) for s in senders], np.int32),
+        np.full(m, int(repl), np.int8),
+    )
+
+
+def force_compaction(b):
+    """Make the compact path eligible at test-sized capacity tiers."""
+    b.compact_fetch_min_cap = 0
+    b.compact_min_bucket = 8
+    return b
+
+
+def packed_host_reference(counts, flat):
+    """Numpy mirror of pack_csr over the zoned layout: walk every
+    (q, s) slot's zone-A lanes then its zone-B region, concatenated in
+    q-major seg-minor order — the executable spec the device kernel
+    must match lane for lane."""
+    mq, nseg = counts.shape
+    base = mq * CSR_ROW * nseg
+    out = []
+    pos_b = 0
+    for q in range(mq):
+        for s in range(nseg):
+            c = int(counts[q, s])
+            if not c:
+                continue
+            at = (q * nseg + s) * CSR_ROW
+            out.extend(flat[at:at + min(c, CSR_ROW)])
+            if c > CSR_ROW:
+                r = c - CSR_ROW
+                at = base + pos_b * CSR_ROW_B
+                out.extend(flat[at:at + r])
+                pos_b += -(-r // CSR_ROW_B)
+    return np.asarray(out, np.int32)
+
+
+def test_pack_csr_matches_host_reference_lane_for_lane():
+    from worldql_server_tpu.spatial.hashing import next_pow2
+    from worldql_server_tpu.spatial.tpu_backend import _pack_csr_kernel
+
+    b, sub_pos, peers = build_hot_cold()
+    rng = np.random.default_rng(7)
+    qidx = rng.integers(0, len(sub_pos), 300)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
+    m, res = b.match_arrays_async(*batch, csr_cap=16384)
+    counts, flat, total = res
+    total = int(total)
+    bucket = next_pow2(max(total, 8))
+    packed, total_dev = _pack_csr_kernel(counts, flat, bucket=bucket)
+    packed = np.asarray(packed)
+    assert int(total_dev) == total
+    want = packed_host_reference(np.asarray(counts), np.asarray(flat))
+    assert want.size == total
+    assert (packed[:total] == want).all()
+    assert (packed[total:] == -1).all()
+
+
+@pytest.mark.parametrize("repl", list(Replication))
+def test_compact_decode_identical_across_segments_and_replication(repl):
+    """Multi-segment (base + delta) index, every replication mode: the
+    compacted collect decodes bit-identically to the full fetch."""
+    b, sub_pos, peers = build_hot_cold(hot_cubes=3, hot_occupancy=30)
+    for p in _peers(25, base=10_000):   # hot delta rows
+        b.add_subscription(W, p, (16 * 1, 16, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+    force_compaction(b)
+
+    rng = np.random.default_rng(11)
+    qidx = rng.integers(0, len(sub_pos), 120)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx], repl)
+    m, res = b.match_arrays_async(*batch, csr_cap=8192)
+    counts, flat, total = res
+    total = int(total)
+    counts_np = np.asarray(counts)
+    want = b._decode_csr(counts_np, np.asarray(flat), m)
+
+    packed = b._compact_fetch(counts, flat, total, flat.shape[0])
+    assert packed is not None, "compact path must trigger when forced"
+    assert b._decode_packed(counts_np, packed, m) == want
+    assert b.last_collect_stats["compaction_bucket"] > 0
+
+
+def test_collect_local_batch_uses_compaction_and_matches_oracle():
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=4, hot_occupancy=24)
+    force_compaction(b)
+    cpu = CpuSpatialBackend(16)
+    for p, pos in zip(peers, sub_pos):
+        cpu.add_subscription(W, p, Vector3(*pos))
+
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
+                   Replication.EXCEPT_SELF)
+        for i in range(0, len(sub_pos), 2)
+    ]
+    before = b.compact_fetches
+    got = b.match_local_batch(queries)
+    assert b.compact_fetches == before + 1
+    for g, want in zip(got, cpu.match_local_batch(queries)):
+        assert sorted(g, key=str) == sorted(want, key=str)
+
+
+def test_compact_fallbacks_and_gates():
+    """The full-fetch path stays live: disabled, small-cap, and
+    no-2x-win ticks all return None (and collect still decodes the
+    identical result through the fallback)."""
+    b, sub_pos, peers = build_hot_cold(hot_cubes=2, hot_occupancy=20)
+    rng = np.random.default_rng(17)
+    qidx = rng.integers(0, len(sub_pos), 100)
+    batch = query_batch(b, sub_pos[qidx], [peers[i] for i in qidx])
+    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    counts, flat, total = res
+    total = int(total)
+    t_cap = flat.shape[0]
+
+    # default min_cap (1 << 15) exceeds this tier — gate closed
+    assert b._compact_fetch(counts, flat, total, t_cap) is None
+    # disabled explicitly
+    force_compaction(b)
+    b.compact_fetch = False
+    assert b._compact_fetch(counts, flat, total, t_cap) is None
+    # no 2x win: bucket floored at the cap itself
+    b.compact_fetch = True
+    b.compact_min_bucket = t_cap
+    assert b._compact_fetch(counts, flat, total, t_cap) is None
+    # reopened: fires
+    b.compact_min_bucket = 8
+    assert b._compact_fetch(counts, flat, total, t_cap) is not None
+
+
+def test_overflow_still_falls_back_dense_with_compaction_on():
+    """A tick whose fan-out outgrows the capacity hint re-resolves
+    dense exactly as before — compaction never intercepts the
+    overflow sentinel."""
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+
+    b, sub_pos, peers = build_hot_cold(hot_cubes=4, hot_occupancy=40)
+    force_compaction(b)
+    cpu = CpuSpatialBackend(16)
+    for p, pos in zip(peers, sub_pos):
+        cpu.add_subscription(W, p, Vector3(*pos))
+    queries = [
+        LocalQuery(W, Vector3(*sub_pos[i]), peers[i],
+                   Replication.EXCEPT_SELF)
+        for i in range(0, len(sub_pos), 2)
+    ]
+    want = [sorted(w, key=str) for w in cpu.match_local_batch(queries)]
+
+    b._delivery_cap = 1
+    handle = b.dispatch_local_batch(queries)
+    _, (kind, t_cap, (_, _, total), _) = handle
+    assert kind == "csr" and int(total) > t_cap
+    assert [sorted(g, key=str) for g in b.collect_local_batch(handle)] == want
+
+
+def test_empty_fanout_packs_to_all_pad():
+    b, sub_pos, peers = build_hot_cold(hot_cubes=1, hot_occupancy=4,
+                                       cold=20)
+    force_compaction(b)
+    # positions far from every subscription: zero hits
+    far = np.full((16, 3), 9000.0)
+    batch = query_batch(b, far, [peers[0]] * 16)
+    m, res = b.match_arrays_async(*batch, csr_cap=4096)
+    counts, flat, total = res
+    assert int(total) == 0
+    packed = b._compact_fetch(counts, flat, 0, flat.shape[0])
+    assert packed is not None and (packed == -1).all()
+    assert b._decode_packed(np.asarray(counts), packed, m) == [
+        [] for _ in range(m)
+    ]
+
+
+# region: sharded
+
+
+def _require_devices(n: int):
+    import jax
+
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+
+
+@pytest.mark.parametrize("n_batch,n_space", [(2, 4), (4, 2)])
+def test_sharded_compact_decode_matches_full_fetch(n_batch, n_space):
+    _require_devices(n_batch * n_space)
+    from worldql_server_tpu.parallel import (
+        ShardedTpuSpatialBackend, make_fanout_mesh,
+    )
+
+    mesh = make_fanout_mesh(n_batch, n_space)
+    b, sub_pos, peers = build_hot_cold(
+        ShardedTpuSpatialBackend(16, mesh, compact_threshold=32)
+    )
+    for p in _peers(20, base=50_000):   # delta segment too
+        b.add_subscription(W, p, (16 * 2, 16, 16))
+    b.flush()
+    assert b._delta_bundle is not None
+    force_compaction(b)
+
+    rng = np.random.default_rng(23)
+    for repl in Replication:
+        qidx = rng.integers(0, len(sub_pos), 160)
+        batch = query_batch(
+            b, sub_pos[qidx], [peers[i] for i in qidx], repl
+        )
+        m, res = b.match_arrays_async(*batch, csr_cap=32768)
+        counts, flat, total = res
+        total = int(total)
+        assert total <= 32768
+        counts_np = np.asarray(counts)
+        want = b._decode_csr(counts_np, np.asarray(flat), m)
+        packed = b._compact_fetch(counts, flat, total, flat.shape[0])
+        assert packed is not None
+        assert b._decode_packed(counts_np, packed, m) == want
+
+
+def test_sharded_imbalance_past_headroom_falls_back_full_fetch():
+    """Every hot query in one batch shard: the per-shard bucket (2x
+    headroom over perfect balance) overflows, the fit check catches it
+    and the collect takes the full fetch — identical result."""
+    _require_devices(8)
+    from worldql_server_tpu.protocol.types import Vector3
+    from worldql_server_tpu.spatial.backend import LocalQuery
+    from worldql_server_tpu.parallel import (
+        ShardedTpuSpatialBackend, make_fanout_mesh,
+    )
+
+    mesh = make_fanout_mesh(4, 2)
+    b, sub_pos, peers = build_hot_cold(
+        ShardedTpuSpatialBackend(16, mesh, compact_threshold=32),
+        hot_cubes=2, hot_occupancy=40, cold=60,
+    )
+    force_compaction(b)
+    # 1024 queries, batch-sharded 256 per shard: the 64 hot ones all
+    # land in shard 0 (its local total 64 x 40 = 2560 lanes), the rest
+    # miss. bucket_local = next_pow2(2 * 2560 / 4) = 2048 < 2560: the
+    # fit check must fire and route to the full fetch.
+    b._delivery_cap = 32_768   # keeps the gain gate open at this total
+    hot_idx = [0, 1, 40, 41]
+    qpos = [
+        sub_pos[hot_idx[i % 4]] if i < 64
+        else [9000.0 + i, 9000.0, 9000.0]
+        for i in range(1024)
+    ]
+    queries = [
+        LocalQuery(W, Vector3(*p), uuid.uuid4(), Replication.EXCEPT_SELF)
+        for p in qpos
+    ]
+    handle = b.dispatch_local_batch(queries)
+    _, payload = handle
+    assert payload[0] == "csr"
+    _, t_cap, (counts, flat, total), _ = payload
+    total = int(total)
+    assert total == 64 * 40 <= t_cap
+    counts_np = np.asarray(counts)
+    want = b._decode_csr(counts_np, np.asarray(flat), len(queries))
+    full_before, compact_before = b.full_fetches, b.compact_fetches
+    got = b.collect_local_batch(handle)
+    assert b.full_fetches == full_before + 1, "fit check must fall back"
+    assert b.compact_fetches == compact_before
+    assert got == want
+
+
+# endregion

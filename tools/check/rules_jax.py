@@ -37,6 +37,9 @@ _HOT_FUNCTIONS = {
     "_csr_effective_cap",
     "_prepare_queries",
     "_decode_csr",
+    "_compact_fetch",
+    "_decode_packed",
+    "_dispatch_pack",
 }
 
 _SYNC_CALLS = {
@@ -107,6 +110,76 @@ def _check_host_sync(ctx: FileContext) -> Iterator[Violation]:
                     "sync, serializing the dispatch pipeline; keep the "
                     "value on device, or mark the designated collect "
                     "point with `# wql: allow(jax-host-sync)`",
+                )
+
+
+#: host-fetch calls the full-fetch rule inspects (a subset of the
+#: host-sync set: the ones that materialize a WHOLE array)
+_FETCH_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+
+#: identifiers that name cap-padded tick-path arrays in these modules
+#: (the CSR flat result, dense [M, K] target tables) — fetching one
+#: ships O(capacity) bytes, the exact regression ISSUE 3 removed
+#: (BENCH_r05: fetch_ms.flat ≈ 956 ms of a ~1051 ms tick). The match
+#: is heuristic by name, on either the fetched expression or the
+#: assignment target; the unit repros in tests/test_check_rules.py are
+#: the executable definition.
+_FAT_NAMES = {"flat", "tgt", "targets", "dense", "flat_np", "result"}
+
+
+def _check_full_fetch(ctx: FileContext) -> Iterator[Violation]:
+    """Flag ``np.asarray(...)``/``jax.device_get(...)`` of a cap-padded
+    device array in tick-path hot functions. Legal only at the
+    designated overflow/fallback sites, which carry
+    ``# wql: allow(full-fetch-on-tick)`` — keeping every O(capacity)
+    device→host transfer on the tick path auditable (the compacted
+    collect path ships O(actual fan-out) instead)."""
+    if not _is_tick_module(ctx.relpath):
+        return
+    scopes = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _HOT_FUNCTIONS
+    ]
+    for scope in scopes:
+        # `tgt = np.asarray(payload[1])[:m]` is a full fetch even
+        # though the argument names nothing fat — assignment targets
+        # give fetch calls their destination name
+        assigned: dict[int, set[str]] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                names = {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        assigned[id(sub)] = names
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if dotted_name(node.func) not in _FETCH_CALLS:
+                continue
+            arg_ids = set(assigned.get(id(node), set()))
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Name):
+                    arg_ids.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    arg_ids.add(sub.attr)
+            hot = sorted(
+                {name.lstrip("_") for name in arg_ids} & _FAT_NAMES
+            )
+            if hot:
+                yield from ctx.flag(
+                    FULL_FETCH,
+                    node,
+                    f"fetch of cap-padded device array ({', '.join(hot)}) "
+                    "in a tick-path function ships O(capacity) bytes "
+                    "D2H; pack it on device first (_compact_fetch) or "
+                    "mark the deliberate overflow/fallback site with "
+                    "`# wql: allow(full-fetch-on-tick)`",
                 )
 
 
@@ -237,5 +310,11 @@ TRACED_BRANCH = Rule(
     "Python if/while on a traced value inside a jitted function",
     _check_traced_branch,
 )
+FULL_FETCH = Rule(
+    "full-fetch-on-tick",
+    "D2H fetch of a cap-padded array on the tick path (O(capacity) "
+    "bytes — use the on-device compaction, or pragma the fallback)",
+    _check_full_fetch,
+)
 
-RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH]
+RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH]

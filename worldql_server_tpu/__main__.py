@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-zmq", action="store_true")
     p.add_argument("--spatial-backend", choices=["cpu", "tpu", "sharded"])
     p.add_argument("--tick-interval", type=float)
+    p.add_argument("--tick-pipeline", type=int,
+                   help="max dispatched-but-undelivered ticks: 1 "
+                        "(default) = sequential flush; 2 overlaps tick "
+                        "N's collect+delivery with tick N+1's "
+                        "accumulation and dispatch")
     p.add_argument("--mesh-batch", type=int,
                    help="sharded backend: data-parallel query axis size")
     p.add_argument("--mesh-space", type=int,
@@ -99,7 +104,8 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
-    "mesh_batch", "mesh_space", "index_snapshot", "max_message_size",
+    "tick_pipeline", "mesh_batch", "mesh_space", "index_snapshot",
+    "max_message_size",
     "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
     "checkpoint_interval",
 ]

@@ -109,6 +109,15 @@ class Config:
     tick_interval: float = field(
         default_factory=lambda: float(_env("WQL_TICK_INTERVAL", "0"))
     )
+    # Tick pipeline depth: maximum dispatched-but-undelivered ticks.
+    # 1 (default) keeps the sequential flush — dispatch, collect and
+    # deliver before the next tick starts. 2 overlaps tick N's device
+    # collect + delivery drain with tick N+1's accumulation and
+    # dispatch (engine/ticker.py; arrival order is preserved — the
+    # collect/deliver stages chain).
+    tick_pipeline: int = field(
+        default_factory=lambda: int(_env("WQL_TICK_PIPELINE", "1"))
+    )
     # Device-mesh shape for spatial_backend='sharded': data-parallel
     # query batch axis × space-sharded index axis. mesh_space=0 means
     # "all remaining devices" (parallel/mesh.py).
@@ -223,6 +232,8 @@ class Config:
             )
         if self.tick_interval < 0:
             errors.append("tick_interval must be >= 0")
+        if self.tick_pipeline < 1:
+            errors.append("tick_pipeline must be >= 1 (1 = no overlap)")
         if self.durability not in ("off", "wal", "sync"):
             errors.append("durability must be 'off', 'wal' or 'sync'")
         elif self.durability != "off" and not self.wal_dir:

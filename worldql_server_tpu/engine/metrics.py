@@ -104,6 +104,13 @@ class Metrics:
         """Register a pull-style gauge; evaluated at snapshot time."""
         self._gauges[name] = fn
 
+    def set_gauge(self, name: str, value) -> None:
+        """Push-style gauge: record the latest value directly. For
+        writers with no stable object to pull from — the tick
+        batcher's per-flush pipeline depth and compaction bucket are
+        snapshots of a moment, not a live view."""
+        self._gauges[name] = lambda v=value: v
+
     def _eval_gauges(self) -> dict:
         gauges = {}
         for name, fn in self._gauges.items():

@@ -71,7 +71,7 @@ class WorldQLServer:
 
             self.ticker = TickBatcher(
                 self.backend, self.peer_map, config.tick_interval,
-                metrics=self.metrics,
+                metrics=self.metrics, pipeline=config.tick_pipeline,
             )
         # Durability engine: WAL + write-behind pipeline. With
         # durability='off' (default) both stay None and the Router's
@@ -122,8 +122,16 @@ class WorldQLServer:
                 "tick",
                 lambda: {
                     "interval_s": self.ticker.interval,
+                    "pipeline": self.ticker.pipeline,
+                    "inflight": self.ticker.inflight(),
                     "last_batch": self.ticker.last_batch,
                     "last_tick_ms": round(self.ticker.last_tick_ms, 3),
+                    "last_dispatch_ms":
+                        round(self.ticker.last_dispatch_ms, 3),
+                    "last_collect_ms":
+                        round(self.ticker.last_collect_ms, 3),
+                    "compaction_bucket":
+                        self.ticker.last_compaction_bucket,
                 },
             )
         if self.durability is not None:

@@ -14,6 +14,19 @@ loop; the device wait + UUID decode run on a worker thread, so the loop
 keeps serving transports while the device crunches. A full queue
 (``max_batch``) flushes early. ``tick_interval == 0`` keeps the
 reference-equivalent immediate path and never constructs this class.
+
+Pipelining (``pipeline`` > 1, ISSUE 3): ``flush`` splits into a
+dispatch stage (on the loop, launches the device batch) and a
+collect+deliver stage (a background task: device wait on a worker
+thread, then the batched delivery). With the default depth 2 at most
+ONE tick is in flight while the next accumulates and dispatches — tick
+N+1's device work overlaps tick N's D2H fetch and delivery drain. The
+stage tasks CHAIN (each awaits its predecessor before delivering), so
+per-peer arrival order is exactly the sequential path's, and ``stop``
+awaits the chain instead of cancelling it — the shield/re-queue
+guarantees of the sequential flush carry over unchanged.
+``pipeline == 1`` (the default) keeps the sequential flush byte for
+byte.
 """
 
 from __future__ import annotations
@@ -21,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..protocol.types import Message
@@ -37,15 +51,22 @@ class TickBatcher:
         interval: float,
         max_batch: int = 16_384,
         metrics=None,
+        pipeline: int = 1,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        self.pipeline = max(1, int(pipeline))
         self._queue: list[tuple[Message, LocalQuery]] = []
         self._task: asyncio.Task | None = None
         self._flushing = asyncio.Lock()
+        # pipelined collect+deliver stages: _inflight caps the depth,
+        # _tail is the chain head the NEXT stage must wait out before
+        # delivering (arrival-order guarantee across ticks)
+        self._inflight: deque[asyncio.Task] = deque()
+        self._tail: asyncio.Task | None = None
         # stats (exposed via metrics)
         self.ticks = 0
         self.messages = 0
@@ -53,6 +74,9 @@ class TickBatcher:
         self.last_tick_ms = 0.0
         self.last_resolve_ms = 0.0   # dispatch + device/backend collect
         self.last_deliver_ms = 0.0   # PeerMap.deliver_batch
+        self.last_dispatch_ms = 0.0  # host encode + device launch
+        self.last_collect_ms = 0.0   # device wait + UUID decode
+        self.last_compaction_bucket = 0
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name="tick-batcher")
@@ -65,24 +89,164 @@ class TickBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
-        await self.flush()  # drain whatever is left
+        await self.flush()  # drain in-flight stages + whatever is left
+
+    def inflight(self) -> int:
+        """Dispatched-but-undelivered ticks right now (gauge)."""
+        return len(self._inflight)
 
     async def enqueue(self, message: Message, query: LocalQuery) -> None:
         self._queue.append((message, query))
         if len(self._queue) >= self.max_batch:
-            await self.flush()
+            if self.pipeline > 1:
+                await self.flush_pipelined()
+            else:
+                await self.flush()
 
     async def _run(self) -> None:
         while True:
             await asyncio.sleep(self.interval)
             try:
-                await self.flush()
+                if self.pipeline > 1:
+                    await self.flush_pipelined()
+                else:
+                    await self.flush()
             except Exception:
                 logger.exception("tick flush failed — batch dropped")
 
+    # region: pipelined flush (pipeline > 1)
+
+    async def flush_pipelined(self) -> None:
+        """Dispatch everything queued and hand collect+delivery to a
+        chained background stage, keeping at most ``pipeline`` ticks
+        dispatched-but-undelivered: tick N+1 accumulates and launches
+        while tick N's collect runs on the worker thread and its
+        delivery drains. A dispatch failure drops the batch (same
+        contract as the sequential path's _run handler)."""
+        self._reap()
+        async with self._flushing:
+            batch, self._queue = self._queue, []
+            if batch:
+                t0 = time.perf_counter()
+                handle = self.backend.dispatch_local_batch(
+                    [query for _, query in batch]
+                )
+                self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
+                if self.metrics is not None:
+                    self.metrics.observe_ms(
+                        "tick.dispatch_ms", self.last_dispatch_ms
+                    )
+                task = asyncio.create_task(
+                    self._collect_deliver(batch, handle, self._tail, t0),
+                    name="tick-collect",
+                )
+                self._tail = task
+                self._inflight.append(task)
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "tick.pipeline_inflight", len(self._inflight)
+            )
+        # backpressure: wait out the oldest stage once the pipeline is
+        # full — after this, at most pipeline-1 ticks remain in flight
+        # (pipeline=2: one tick overlaps the next accumulation window)
+        while len(self._inflight) >= 1 + self.pipeline:
+            await self._await_quiet(self._inflight[0])
+            self._reap()
+
+    async def _collect_deliver(self, batch, handle, prev, t0) -> None:
+        """Stage 2 of a pipelined tick: device collect (worker thread),
+        then — strictly after tick N-1's stage finished — the batched
+        delivery. Handles its own errors (a failed collect drops only
+        ITS batch; the next tick's stage runs untouched) and is never
+        cancelled by stop(), which awaits the chain instead."""
+        targets = None
+        try:
+            tc = time.perf_counter()
+            targets = await asyncio.to_thread(
+                self.backend.collect_local_batch, handle
+            )
+            self.last_collect_ms = (time.perf_counter() - tc) * 1e3
+            if self.metrics is not None:
+                self.metrics.observe_ms(
+                    "tick.collect_ms", self.last_collect_ms
+                )
+            self._note_collect_stats()
+        except Exception:
+            logger.exception("tick collect failed — batch dropped")
+        # Arrival order across ticks: tick N-1's deliveries must all
+        # complete before ours start — even when our collect finished
+        # first (worker threads overlap). Ride out cancellation: the
+        # predecessor's delivery is owed regardless.
+        if prev is not None:
+            while not prev.done():
+                try:
+                    await asyncio.shield(prev)
+                except (asyncio.CancelledError, Exception):
+                    continue
+        if targets is None:
+            return
+        try:
+            deliver_task = asyncio.ensure_future(
+                self.peer_map.deliver_batch([
+                    (message, tgts)
+                    for (message, _), tgts in zip(batch, targets)
+                    if tgts
+                ])
+            )
+            td = time.perf_counter()
+            # same shield-and-re-await discipline as the sequential
+            # flush: a cancellation must not abort the delivery tail
+            # half-sent (fast-path frames are already in transport
+            # buffers; re-sending would duplicate)
+            while not deliver_task.done():
+                try:
+                    await asyncio.shield(deliver_task)
+                except asyncio.CancelledError:
+                    continue
+                except Exception:
+                    logger.exception("tick delivery failed")
+                    break
+            self._account(
+                batch, t0, deliver_ms=(time.perf_counter() - td) * 1e3
+            )
+        except Exception:
+            logger.exception("tick delivery failed — batch dropped")
+
+    def _reap(self) -> None:
+        while self._inflight and self._inflight[0].done():
+            self._inflight.popleft()
+
+    @staticmethod
+    async def _await_quiet(task: asyncio.Task) -> None:
+        """Wait for a stage task without cancelling it and without
+        letting its (already-logged) errors escape. Our own
+        cancellation propagates once the task is done — the in-flight
+        batch is owed its delivery first."""
+        cancelled = False
+        while not task.done():
+            try:
+                await asyncio.shield(task)
+            except asyncio.CancelledError:
+                cancelled = True
+            except Exception:
+                break
+        if cancelled:
+            raise asyncio.CancelledError
+
+    async def _drain_inflight(self) -> None:
+        while self._inflight:
+            await self._await_quiet(self._inflight[0])
+            self._reap()
+
+    # endregion
+
     async def flush(self) -> None:
         """Resolve and deliver everything queued so far. Serialized so a
-        size-triggered flush can't interleave with the timer's."""
+        size-triggered flush can't interleave with the timer's. In
+        pipelined mode any in-flight stage is waited out FIRST, so the
+        drained queue delivers after it (stop()'s exactly-once drain
+        keeps cross-tick arrival order)."""
+        await self._drain_inflight()
         async with self._flushing:
             batch, self._queue = self._queue, []
             if not batch:
@@ -92,14 +256,26 @@ class TickBatcher:
             dispatched = False
             deliver_task = None
             try:
+                td = time.perf_counter()
                 handle = self.backend.dispatch_local_batch(
                     [query for _, query in batch]
                 )
+                self.last_dispatch_ms = (time.perf_counter() - td) * 1e3
+                tc = time.perf_counter()
                 targets = await asyncio.to_thread(
                     self.backend.collect_local_batch, handle
                 )
                 dispatched = True
+                self.last_collect_ms = (time.perf_counter() - tc) * 1e3
                 self.last_resolve_ms = (time.perf_counter() - t0) * 1e3
+                if self.metrics is not None:
+                    self.metrics.observe_ms(
+                        "tick.dispatch_ms", self.last_dispatch_ms
+                    )
+                    self.metrics.observe_ms(
+                        "tick.collect_ms", self.last_collect_ms
+                    )
+                self._note_collect_stats()
                 # One batched delivery: every message's frame goes to
                 # its targets' transport buffers synchronously; only
                 # saturated/fast-path-less peers cost an await at the
@@ -139,15 +315,36 @@ class TickBatcher:
                             break  # delivery errors handled by _run
                 raise
 
-            self.ticks += 1
-            self.messages += len(batch)
-            self.last_batch = len(batch)
-            self.last_tick_ms = (time.perf_counter() - t0) * 1e3
-            self.last_deliver_ms = self.last_tick_ms - self.last_resolve_ms
-            if self.metrics is not None:
-                self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)
-                self.metrics.observe_ms(
-                    "tick.deliver_ms", self.last_deliver_ms
-                )
-                self.metrics.inc("tick.flushes")
-                self.metrics.inc("tick.messages", len(batch))
+            self._account(batch, t0)
+
+    def _account(self, batch, t0, deliver_ms: float | None = None) -> None:
+        self.ticks += 1
+        self.messages += len(batch)
+        self.last_batch = len(batch)
+        self.last_tick_ms = (time.perf_counter() - t0) * 1e3
+        self.last_deliver_ms = (
+            deliver_ms if deliver_ms is not None
+            else self.last_tick_ms - self.last_resolve_ms
+        )
+        if self.metrics is not None:
+            self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)
+            self.metrics.observe_ms("tick.deliver_ms", self.last_deliver_ms)
+            self.metrics.inc("tick.flushes")
+            self.metrics.inc("tick.messages", len(batch))
+
+    def _note_collect_stats(self) -> None:
+        """Pull the backend's per-collect transfer stats (what the D2H
+        fetch actually shipped, and whether the on-device compaction
+        packed it) into the metrics registry. Backends without the
+        stats (CPU reference) are silently skipped."""
+        stats = getattr(self.backend, "last_collect_stats", None)
+        if not stats:
+            return
+        self.last_compaction_bucket = int(stats.get("compaction_bucket", 0))
+        if self.metrics is not None:
+            self.metrics.inc(
+                "tick.fetch_bytes", int(stats.get("fetch_bytes", 0))
+            )
+            self.metrics.set_gauge(
+                "tick.compaction_bucket", self.last_compaction_bucket
+            )

@@ -50,6 +50,7 @@ from ..spatial.tpu_backend import (
     _write_chunk,
     compact_sparse,
     match_core,
+    pack_csr,
     probe_buckets_for,
     probe_tables,
     run_bounds_all,
@@ -522,6 +523,84 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         need_local = (CSR_ROW * m_local * len(segs)
                       + 2 * CSR_ROW_B)
         return max(t_cap, next_pow2(self.n_batch * need_local))
+
+    def _pack_kernel(self, bucket_local: int, mq: int, nseg: int,
+                     flat_len: int):
+        """Per-batch-shard pack_csr, vmapped over the shard dim with
+        batch shardings so every shard compacts its own flat region
+        locally — no cross-device traffic, the merge already happened
+        in the CSR kernel's pmax."""
+        key = ("pack_csr", bucket_local, mq, nseg, flat_len)
+        kernel = self._kernels.get(key)
+        if kernel is None:
+            nb = self.n_batch
+
+            def pack_all(counts, flat):
+                c3 = counts.reshape(nb, mq // nb, nseg)
+                f2 = flat.reshape(nb, flat_len // nb)
+                packed, totals = jax.vmap(
+                    lambda c, f: pack_csr(c, f, bucket=bucket_local)
+                )(c3, f2)
+                return packed.reshape(-1), totals
+
+            kernel = self._kernels[key] = jax.jit(
+                pack_all,
+                in_shardings=(
+                    self._sharding("batch", None),
+                    self._sharding("batch"),
+                ),
+                out_shardings=(
+                    self._sharding("batch"), self._sharding("batch"),
+                ),
+            )
+            retrace.GUARD.register("sharded.pack_csr", kernel)
+        return kernel
+
+    def _compact_fetch(self, counts, flat, total: int, t_cap: int):
+        """Mesh compaction: each batch shard packs its own flat region
+        into a local bucket sized for 2x imbalance headroom over a
+        perfectly balanced split. Shards report their raw totals; any
+        shard overflowing its bucket (imbalance past the headroom)
+        falls back to the full fetch — slower, never wrong."""
+        nb = self.n_batch
+        bucket_local = next_pow2(
+            max(-(-2 * total // nb), self.compact_min_bucket)
+        )
+        if (
+            not self._compact_applicable(t_cap)
+            or bucket_local * nb * 2 > t_cap
+        ):
+            return None
+        mq, nseg = counts.shape
+        kernel = self._pack_kernel(
+            bucket_local, mq, nseg, flat.shape[0]
+        )
+        packed, totals = kernel(counts, flat)
+        # fit check first — a tiny [n_batch] fetch, not the payload
+        totals_np = np.asarray(totals)  # wql: allow(jax-host-sync) — [n_batch] scalars
+        if totals_np.size and int(totals_np.max()) > bucket_local:
+            return None
+        out = np.asarray(packed)  # wql: allow(jax-host-sync) — compacted collect point
+        self._note_fetch(bucket_local * nb, bucket_local * nb)
+        return out
+
+    def _decode_packed(self, counts, packed, m: int):
+        """The mesh packed result is per-batch-shard buckets
+        concatenated; walk each shard's queries against its own
+        bucket (mirrors the zoned-layout region walk below)."""
+        nb = self.n_batch
+        bucket_local = len(packed) // nb
+        m_local = counts.shape[0] // nb
+        out: list = []
+        for b in range(nb):
+            if len(out) >= m:
+                break
+            out.extend(super()._decode_packed(
+                counts[b * m_local:(b + 1) * m_local],
+                packed[b * bucket_local:(b + 1) * bucket_local],
+                min(m_local, m - len(out)),
+            ))
+        return out
 
     def _decode_csr(self, counts, flat, m: int):
         """The mesh flat result is per-batch-shard regions of

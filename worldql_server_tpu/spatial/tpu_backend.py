@@ -617,6 +617,60 @@ def _match_run_csr_kernel(*flat_args, nseg, t_cap):
     return match_run_csr(flat_args, nseg, t_cap)
 
 
+def pack_csr(counts, flat, *, bucket: int):
+    """Pack the zoned CSR flat result into a dense ``[bucket]`` lane
+    array ON DEVICE, so the D2H fetch ships O(actual fan-out) bytes
+    instead of the O(t_cap) capacity tier (BENCH_r05:
+    ``fetch_ms.flat`` ≈ 956 ms of a ~1051 ms tick was this padding).
+
+    Output lanes are exactly the lanes :meth:`_decode_csr` would read,
+    in the same order — q-major, segment-minor; within a (query,
+    segment) slot, lane ``l < CSR_ROW`` comes from the zone-A identity
+    row and later lanes from the slot's zone-B region. ``-1`` holes
+    (tombstoned / replication-filtered lanes) ride along, so decoding
+    from raw-count cumsum offsets is bit-identical to walking the
+    zoned layout. Returns ``(packed [bucket] i32, total i32)``; lanes
+    past ``total`` are ``-1``, and ``total > bucket`` means the bucket
+    was too small — the caller falls back to the full fetch (slower,
+    never wrong).
+
+    Cost: three [bucket] element gathers plus O(M·nseg) prefix sums —
+    proportional to the result actually shipped, not the capacity.
+    """
+    mq, nseg = counts.shape
+    cnt = counts.reshape(-1)                       # [M*nseg] raw
+    nslots = cnt.shape[0]
+    off = jnp.cumsum(cnt) - cnt                    # packed slot starts
+    total = cnt.sum(dtype=jnp.int32)
+    cnt_b = jnp.maximum(cnt - CSR_ROW, 0)
+    prow_b = (cnt_b + (CSR_ROW_B - 1)) // CSR_ROW_B
+    rowstart_b = jnp.cumsum(prow_b) - prow_b       # zone-B row starts
+    base = mq * CSR_ROW * nseg
+    # owner map: packed position -> flattened (q, s) slot. Non-empty
+    # slots have strictly increasing starts, so each scatters its id at
+    # its start (empty/overflowing slots get dropped OOB marks) and a
+    # running max fills the gaps.
+    slot_ids = jnp.arange(nslots, dtype=jnp.int32)
+    mark = jnp.where(cnt > 0, off, bucket + 1 + slot_ids)
+    owner = jax.lax.cummax(
+        jnp.zeros(bucket, jnp.int32).at[mark].max(slot_ids, mode="drop")
+    )
+    j = jnp.arange(bucket, dtype=jnp.int32)
+    lane = j - off[owner]
+    src = jnp.where(
+        lane < CSR_ROW,
+        owner * CSR_ROW + lane,
+        base + rowstart_b[owner] * CSR_ROW_B + (lane - CSR_ROW),
+    )
+    vals = flat[jnp.clip(src, 0, flat.shape[0] - 1)]
+    return jnp.where(j < total, vals, jnp.int32(-1)), total
+
+
+@partial(jax.jit, static_argnames=("bucket",))
+def _pack_csr_kernel(counts, flat, *, bucket):
+    return pack_csr(counts, flat, bucket=bucket)
+
+
 def padded_slots(counts: np.ndarray) -> int:
     """Host mirror of the zoned layout's flat-slot footprint for RAW
     [M, nseg] counts: zone A is CSR_ROW per (query, segment), zone B
@@ -733,6 +787,7 @@ for _family, _kernel_fn in {
     "match_dense": _match_dense_kernel,
     "match_sparse": _match_sparse_kernel,
     "match_run_csr": _match_run_csr_kernel,
+    "pack_csr": _pack_csr_kernel,
     "scatter_dead": _scatter_dead,
     "write_chunk": _write_chunk,
     "grow_buffers": _grow_buffers,
@@ -841,6 +896,26 @@ class TpuSpatialBackend(SpatialBackend):
         # CSR result-capacity hint for the delivery path; grows on
         # overflow (collect_local_batch)
         self._delivery_cap = 4096
+
+        # On-device result compaction (pack_csr): pack the lanes the
+        # decoder will read into a power-of-two bucket sized to the
+        # tick's ACTUAL fan-out and fetch only that. Applies once the
+        # capacity tier clears min_cap (below it the prefetched full
+        # fetch wins — the pack dispatch costs a round trip) AND the
+        # bucket saves at least 2x the bytes. min_bucket floors the
+        # bucket ladder so steady traffic reuses a handful of compiled
+        # pack shapes (retrace budget).
+        self.compact_fetch = True
+        self.compact_fetch_min_cap = 1 << 15
+        self.compact_min_bucket = 1 << 10
+        self.compact_fetches = 0
+        self.full_fetches = 0
+        #: what the LAST collect shipped over the link (the tick
+        #: batcher reports these as tick.fetch_bytes /
+        #: tick.compaction_bucket)
+        self.last_collect_stats = {
+            "fetch_slots": 0, "fetch_bytes": 0, "compaction_bucket": 0,
+        }
 
         # pid → base rows: lazily built per base epoch (argsort of the
         # peer column, O(S log S) once), then each eviction is two
@@ -1990,7 +2065,7 @@ class TpuSpatialBackend(SpatialBackend):
         # Convert the whole (prefetched) array, trim on host — a device
         # slice would dispatch again and re-transfer. This sync IS the
         # synchronous API's contract.
-        return np.asarray(result)[:m]  # wql: allow(jax-host-sync)
+        return np.asarray(result)[:m]  # wql: allow(jax-host-sync, full-fetch-on-tick) — the sync API's contract
 
     def match_arrays_async(
         self,
@@ -2046,7 +2121,15 @@ class TpuSpatialBackend(SpatialBackend):
             )
         else:
             result = (self._dispatch(queries, segs, ks, kinds),)
-        for r in result:
+        prefetch = result
+        if csr_cap is not None and self._compact_applicable(csr_cap):
+            # counts + total only: the cap-padded flat stays on device —
+            # collect packs it into a bucket sized to the ACTUAL fan-out
+            # and fetches that instead (prefetching the full array here
+            # would ship the O(cap) bytes the compaction exists to
+            # avoid)
+            prefetch = (result[0], result[2])
+        for r in prefetch:
             copy = getattr(r, "copy_to_host_async", None)
             if copy is not None:
                 copy()
@@ -2172,7 +2255,8 @@ class TpuSpatialBackend(SpatialBackend):
             # collect_local_batch IS the tick's designated sync point:
             # it runs on the worker thread while the loop keeps serving
             # transports, so these converts block nothing but the tick.
-            tgt = np.asarray(payload[1])[:m]  # wql: allow(jax-host-sync)
+            tgt = np.asarray(payload[1])[:m]  # wql: allow(jax-host-sync, full-fetch-on-tick) — dense ceiling path
+            self._note_fetch(int(tgt.size), 0)
             counts, flat = _dense_to_csr(tgt)
             # the hint must keep adapting here too, or a flash-crowd
             # inflation would park every batch on the dense ceiling
@@ -2193,20 +2277,91 @@ class TpuSpatialBackend(SpatialBackend):
                 self._delivery_cap,
             )
             qtuple, segs, ks, kinds = ctx
-            tgt = np.asarray(  # wql: allow(jax-host-sync) — collect point
+            tgt = np.asarray(  # wql: allow(jax-host-sync, full-fetch-on-tick) — overflow re-resolve
                 self._dispatch(qtuple, segs, ks, kinds)
             )[:m]
+            self._note_fetch(int(tgt.size), 0)
             return self._decode_csr(*_dense_to_csr(tgt), m)
         # counts stays UNTRIMMED: padding queries resolve 0 rows, and
         # the sharded decode needs the full padded layout to locate
         # its per-batch-shard flat regions
         counts = np.asarray(counts)  # wql: allow(jax-host-sync) — collect
         self._adapt_delivery_cap(counts, grow=True)
+        packed = self._compact_fetch(
+            payload[2][0], flat, total, t_cap
+        )
+        if packed is not None:
+            return self._decode_packed(counts, packed, m)
+        self._note_fetch(t_cap, 0)
         return self._decode_csr(
             counts,
-            np.asarray(flat),  # wql: allow(jax-host-sync) — collect point
+            np.asarray(flat),  # wql: allow(jax-host-sync, full-fetch-on-tick) — compaction fallback (small tick / no 2x win / shard imbalance)
             m,
         )
+
+    def _compact_applicable(self, t_cap: int) -> bool:
+        """Whether a tick at this capacity tier is worth compacting:
+        below min_cap the dispatch-time full-flat prefetch overlaps
+        the link better than a collect-time pack dispatch could."""
+        return self.compact_fetch and t_cap >= self.compact_fetch_min_cap
+
+    def _compact_fetch(self, counts, flat, total: int, t_cap: int):
+        """On-device compaction of the zoned CSR flat result: pack the
+        lanes the decoder will actually read into a power-of-two bucket
+        >= ``total`` and fetch ONLY that, so D2H bytes scale with the
+        tick's real fan-out instead of the capacity tier. Returns the
+        packed host array, or None when the full-fetch fallback applies
+        (compaction disabled, small tick, or the bucket would not save
+        at least 2x the bytes). ``counts``/``flat`` are the DEVICE
+        arrays; ``total`` the already-fetched raw lane total."""
+        bucket = next_pow2(max(total, self.compact_min_bucket))
+        if not self._compact_applicable(t_cap) or bucket * 2 > t_cap:
+            return None
+        packed, _ = self._dispatch_pack(counts, flat, bucket)
+        out = np.asarray(packed)  # wql: allow(jax-host-sync) — compacted collect point: O(fan-out) bytes
+        self._note_fetch(bucket, bucket)
+        return out
+
+    def _dispatch_pack(self, counts, flat, bucket: int):
+        return _pack_csr_kernel(counts, flat, bucket=bucket)
+
+    def _note_fetch(self, slots: int, bucket: int) -> None:
+        """Record what a collect shipped over the link (``bucket`` 0 =
+        full fetch). Worker-thread safe: the dict is replaced
+        wholesale, never mutated in place."""
+        if bucket:
+            self.compact_fetches += 1
+        else:
+            self.full_fetches += 1
+        self.last_collect_stats = {
+            "fetch_slots": int(slots),
+            "fetch_bytes": int(slots) * 4,
+            "compaction_bucket": int(bucket),
+        }
+
+    def _decode_packed(self, counts, packed, m: int) -> list[list[uuid_mod.UUID]]:
+        """Walk a pack_csr result into per-query UUID lists: lanes for
+        (q, s) start at the cumsum of the RAW [M, nseg] counts —
+        bit-identical output to :meth:`_decode_csr` over the zoned
+        layout (pack_csr emits exactly the lanes that walk reads, in
+        the same order)."""
+        peer_list = self._peer_list
+        mq, nseg = counts.shape
+        cnt = counts.reshape(-1).astype(np.int64)
+        off = np.cumsum(cnt) - cnt
+        out: list[list[uuid_mod.UUID]] = []
+        for q in range(min(m, mq)):
+            lst: list[uuid_mod.UUID] = []
+            for s in range(nseg):
+                slot = q * nseg + s
+                c = int(cnt[slot])
+                if c:
+                    a = int(off[slot])
+                    lst.extend(
+                        peer_list[i] for i in packed[a:a + c] if i >= 0
+                    )
+            out.append(lst)
+        return out
 
     def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool) -> None:
         """Track the capacity the observed tick actually needed. Grows
@@ -2376,6 +2531,11 @@ class TpuSpatialBackend(SpatialBackend):
             "compactions": self.compactions,
             "compaction_failures": self.compaction_failures,
             "compaction_in_flight": self._compaction is not None,
+            "compact_fetches": self.compact_fetches,
+            "full_fetches": self.full_fetches,
+            "last_fetch_bytes": self.last_collect_stats["fetch_bytes"],
+            "last_compaction_bucket":
+                self.last_collect_stats["compaction_bucket"],
         }
 
     # endregion
