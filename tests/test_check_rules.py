@@ -551,16 +551,95 @@ def test_store_on_loop_pragma_suppresses():
 
 # endregion
 
+# region: unsupervised-task
+
+
+SERVER_PATH = "worldql_server_tpu/engine/server.py"
+ZMQ_PATH = "worldql_server_tpu/transports/zeromq.py"
+
+
+def test_unsupervised_task_fires_in_engine_even_when_retained():
+    """Retaining the handle satisfies async-dangling-task but NOT this
+    rule: an unobserved long-lived task still dies silently."""
+    src = """
+    import asyncio
+
+    class Server:
+        async def start(self):
+            self._task = asyncio.create_task(self._sweeper())
+    """
+    assert violations(
+        src, relpath=SERVER_PATH, select="unsupervised-task"
+    ) == [("unsupervised-task", 6)]
+
+
+def test_unsupervised_task_fires_in_transports_on_loop_create_task():
+    src = """
+    import asyncio
+
+    class ZmqTransport:
+        async def start(self):
+            task = asyncio.get_running_loop().create_task(evict())
+            self._evictions.add(task)
+    """
+    assert rules_fired(
+        src, relpath=ZMQ_PATH, select="unsupervised-task"
+    ) == {"unsupervised-task"}
+
+
+def test_unsupervised_task_quiet_on_supervisor_spawns():
+    src = """
+    class Server:
+        async def start(self):
+            self.supervisor.spawn("stale-sweep", self._staleness_sweeper)
+            task = self.supervisor.spawn_transient("tick-collect", coro())
+    """
+    assert rules_fired(src, relpath=SERVER_PATH) == set()
+
+
+def test_unsupervised_task_quiet_outside_scoped_modules():
+    """The supervisor itself (robustness/), durability, and tests may
+    spawn raw tasks — the rule scopes to engine/ and transports/."""
+    src = """
+    import asyncio
+
+    class Supervisor:
+        def spawn(self, name, factory):
+            self._runner = asyncio.create_task(self._run())
+    """
+    assert rules_fired(
+        src, relpath="worldql_server_tpu/robustness/supervisor.py",
+        select="unsupervised-task",
+    ) == set()
+
+
+def test_unsupervised_task_pragma_suppresses():
+    src = """
+    import asyncio
+
+    class TickBatcher:
+        def start(self):
+            self._task = asyncio.create_task(self._run())  # wql: allow(unsupervised-task)
+    """
+    assert rules_fired(
+        src, relpath="worldql_server_tpu/engine/ticker.py",
+        select="unsupervised-task",
+    ) == set()
+
+
+# endregion
+
 
 def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 9
+    assert len(names) >= 10
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
         "async-blocking-call",
+        "unsupervised-task",
         "jax-host-sync",
         "jax-jit-in-loop",
         "jax-traced-branch",

@@ -1,0 +1,158 @@
+"""Unit tests for the fault-injection failpoint registry
+(robustness/failpoints.py): spec parsing, deterministic probabilistic
+firing, fire caps, delay actions, accounting, and the module-level
+near-zero-overhead fast path.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from worldql_server_tpu.robustness import failpoints
+from worldql_server_tpu.robustness.failpoints import (
+    FailpointError,
+    FailpointRegistry,
+    FailpointSpecError,
+    parse_spec,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 10))
+
+
+@pytest.fixture(autouse=True)
+def clean_global_registry():
+    failpoints.registry.reset()
+    yield
+    failpoints.registry.reset()
+
+
+# region: spec parsing
+
+
+def test_parse_spec_full_grammar():
+    points = parse_spec(
+        "a=error, b=error:0.25, c=error:0.5:x3, d=delay:50ms, "
+        "e=delay:1.5s:0.1:x2, f=delay:250"
+    )
+    assert points["a"].action == "error" and points["a"].prob == 1.0
+    assert points["b"].prob == 0.25
+    assert points["c"].prob == 0.5 and points["c"].max_fires == 3
+    assert points["d"].delay_s == pytest.approx(0.050)
+    assert points["e"].delay_s == pytest.approx(1.5)
+    assert points["e"].prob == 0.1 and points["e"].max_fires == 2
+    assert points["f"].delay_s == pytest.approx(0.250)  # bare number = ms
+    assert parse_spec("") == {} and parse_spec(None) == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "nameonly",               # no '='
+    "=error",                 # empty name
+    "a=explode",              # unknown action
+    "a=delay",                # delay without duration
+    "a=error:2.0",            # probability out of range
+    "a=error:0",              # probability must be > 0
+    "a=error:xq",             # bad fire cap
+    "a=delay:soon",           # bad duration
+])
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(FailpointSpecError):
+        parse_spec(bad)
+
+
+# region: firing
+
+
+def test_error_failpoint_fires_and_counts():
+    reg = FailpointRegistry()
+    reg.configure("boom=error")
+    with pytest.raises(FailpointError) as exc:
+        reg.fire("boom")
+    assert exc.value.failpoint == "boom"
+    reg.fire("other")  # un-armed name: no-op
+    assert reg.fired("boom") == 1 and reg.fired("other") == 0
+    assert reg.fired_counts() == {"boom": 1}
+
+
+def test_probabilistic_firing_is_seed_deterministic():
+    def fires(seed):
+        reg = FailpointRegistry(seed=seed)
+        reg.configure("p=error:0.5")
+        out = []
+        for _ in range(64):
+            try:
+                reg.fire("p")
+                out.append(0)
+            except FailpointError:
+                out.append(1)
+        return out
+
+    a, b, c = fires(7), fires(7), fires(8)
+    assert a == b
+    assert a != c  # overwhelmingly likely across 64 draws
+    assert 0 < sum(a) < 64
+
+
+def test_fire_cap_limits_total_fires():
+    reg = FailpointRegistry()
+    reg.configure("capped=error:1:x2")
+    fired = 0
+    for _ in range(10):
+        try:
+            reg.fire("capped")
+        except FailpointError:
+            fired += 1
+    assert fired == 2
+    assert reg.fired("capped") == 2
+    assert reg.stats()["capped"]["hits"] == 10
+
+
+def test_delay_failpoint_sleeps_sync_and_async():
+    reg = FailpointRegistry()
+    reg.configure("slow=delay:30ms")
+    t0 = time.perf_counter()
+    reg.fire("slow")
+    assert time.perf_counter() - t0 >= 0.025
+
+    async def scenario():
+        t0 = time.perf_counter()
+        await reg.afire("slow")
+        return time.perf_counter() - t0
+
+    assert run(scenario()) >= 0.025
+    assert reg.fired("slow") == 2
+
+
+def test_set_clear_and_accounting_survive_reconfigure():
+    reg = FailpointRegistry()
+    reg.set("a", "error")
+    with pytest.raises(FailpointError):
+        reg.fire("a")
+    reg.clear("a")
+    reg.fire("a")  # disarmed: no-op
+    # reconfiguring must keep the audit trail (the chaos suite disarms
+    # everything before its verification phase)
+    reg.configure("b=error")
+    assert reg.fired("a") == 1
+    assert reg.fired_counts() == {"a": 1}
+    assert reg.stats()["a"]["fired"] == 1  # disarmed-but-fired entry
+    reg.reset()
+    assert reg.fired_counts() == {}
+
+
+def test_module_fast_path_and_global_registry():
+    # disarmed: fire() must be a no-op (and cheap — one dict bool)
+    failpoints.fire("anything")
+    run(failpoints.afire("anything"))
+    failpoints.registry.configure("hot=error")
+    with pytest.raises(FailpointError):
+        failpoints.fire("hot")
+
+    async def scenario():
+        with pytest.raises(FailpointError):
+            await failpoints.afire("hot")
+
+    run(scenario())
+    assert failpoints.registry.fired("hot") == 2

@@ -1,0 +1,286 @@
+"""Degraded-mode spatial backend (robustness/resilient.py): failure
+containment, rebuild-from-mirror, and the TPU→CPU failover — driven by
+the real TpuSpatialBackend with `backend.*` failpoints forced on, with
+results pinned against the CPU reference.
+"""
+
+import asyncio
+import uuid
+
+import pytest
+
+from worldql_server_tpu.protocol.types import Replication, Vector3
+from worldql_server_tpu.robustness import failpoints
+from worldql_server_tpu.robustness.resilient import ResilientBackend
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+from worldql_server_tpu.engine.metrics import Metrics
+
+CUBE = 16
+
+
+@pytest.fixture(autouse=True)
+def clean_global_registry():
+    failpoints.registry.reset()
+    yield
+    failpoints.registry.reset()
+
+
+def make_world(backend, n_peers=6):
+    """Subscribe n peers across two cubes of two worlds; returns the
+    peer list (index i at x=i%2 picks the cube)."""
+    peers = [uuid.uuid4() for _ in range(n_peers)]
+    for i, p in enumerate(peers):
+        backend.add_subscription("w", p, Vector3(5.0 + 16 * (i % 2), 1.0, 1.0))
+        if i % 3 == 0:
+            backend.add_subscription("other", p, Vector3(1.0, 1.0, 1.0))
+    backend.flush()
+    return peers
+
+
+def queries_for(peers):
+    return [
+        LocalQuery("w", Vector3(5.0, 1.0, 1.0), peers[0],
+                   Replication.EXCEPT_SELF),
+        LocalQuery("w", Vector3(21.0, 1.0, 1.0), peers[1],
+                   Replication.INCLUDING_SELF),
+        LocalQuery("other", Vector3(1.0, 1.0, 1.0), peers[3],
+                   Replication.ONLY_SELF),
+        LocalQuery("w", Vector3(500.0, 1.0, 1.0), peers[0],
+                   Replication.EXCEPT_SELF),
+    ]
+
+
+def resolve(backend, queries):
+    return [
+        sorted(str(u) for u in row)
+        for row in backend.collect_local_batch(
+            backend.dispatch_local_batch(queries)
+        )
+    ]
+
+
+def cpu_reference(peers, queries):
+    """Independent CPU backend built with make_world's construction."""
+    ref = CpuSpatialBackend(CUBE)
+    for i, p in enumerate(peers):
+        ref.add_subscription("w", p, Vector3(5.0 + 16 * (i % 2), 1.0, 1.0))
+        if i % 3 == 0:
+            ref.add_subscription("other", p, Vector3(1.0, 1.0, 1.0))
+    return [
+        sorted(str(u) for u in row)
+        for row in ref.match_local_batch(queries)
+    ]
+
+
+class ExplodingBackend(CpuSpatialBackend):
+    """A backend whose every call raises — the 'device bricked' case."""
+
+    def __init__(self, cube_size):
+        super().__init__(cube_size)
+        self.exploding = False
+
+    def _maybe(self):
+        if self.exploding:
+            raise RuntimeError("device is gone")
+
+    def add_subscription(self, *a, **k):
+        self._maybe()
+        return super().add_subscription(*a, **k)
+
+    def dispatch_local_batch(self, queries):
+        self._maybe()
+        return super().dispatch_local_batch(queries)
+
+    def collect_local_batch(self, handle):
+        self._maybe()
+        return super().collect_local_batch(handle)
+
+    def query_cube(self, *a):
+        self._maybe()
+        return super().query_cube(*a)
+
+
+def test_tpu_collect_failures_fail_over_to_cpu_and_match_reference():
+    """THE acceptance path: repeated forced collect failures on the
+    real TPU backend → containment (every batch still resolves) →
+    failover to the CPU mirror → subsequent results match the CPU
+    reference, and the whole episode is visible in metrics/status."""
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+    metrics = Metrics()
+    backend = ResilientBackend(
+        TpuSpatialBackend(CUBE),
+        factory=lambda: TpuSpatialBackend(CUBE),
+        failover_after=3,
+        metrics=metrics,
+    )
+    peers = make_world(backend)
+    queries = queries_for(peers)
+    expected = cpu_reference(peers, queries)
+
+    # healthy: the device path answers and matches the reference
+    assert resolve(backend, queries) == expected
+    assert backend.failed_over is False
+
+    failpoints.registry.configure("backend.collect=error")
+    for i in range(3):
+        # EVERY degraded batch still resolves correctly — fan-out
+        # continues, never flatlines
+        assert resolve(backend, queries) == expected
+        assert backend.total_failures == i + 1
+    assert backend.failed_over is True
+    assert backend.rebuilds == 2  # failures 1 and 2 rebuilt; 3rd failed over
+    assert metrics.counters["resilience.failovers"] == 1
+    assert metrics.counters["resilience.failures"] == 3
+
+    # after failover: failpoints disarmed, served entirely by the CPU
+    # mirror, still matching the reference — including NEW mutations
+    failpoints.registry.reset()
+    newcomer = uuid.uuid4()
+    backend.add_subscription("w", newcomer, Vector3(5.0, 1.0, 1.0))
+    got = resolve(backend, queries)
+    assert str(newcomer) in got[0]
+    status = backend.status()
+    assert status["degraded"] and status["failed_over"]
+    assert status["inner"] == "TpuSpatialBackend"
+    assert backend.query_cube("w", Vector3(5.0, 1.0, 1.0)) == \
+        backend.mirror.query_cube("w", Vector3(5.0, 1.0, 1.0))
+
+
+def test_dispatch_failure_is_contained_and_rebuild_restores_device_path():
+    """A single dispatch failure: the batch resolves through the
+    mirror, the inner backend is rebuilt from it, and the NEXT batch
+    runs the device path again (streak reset on healthy collect)."""
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+    built = []
+
+    def factory():
+        b = TpuSpatialBackend(CUBE)
+        built.append(b)
+        return b
+
+    backend = ResilientBackend(
+        TpuSpatialBackend(CUBE), factory=factory, failover_after=3
+    )
+    peers = make_world(backend)
+    queries = queries_for(peers)
+    expected = cpu_reference(peers, queries)
+
+    failpoints.registry.configure("backend.dispatch=error:1:x1")
+    assert resolve(backend, queries) == expected  # contained via mirror
+    assert backend.failures == 1 and backend.rebuilds == 1
+    assert backend.inner is built[-1]  # the REBUILT device backend
+
+    # healthy collect through the rebuilt index: matches and resets
+    assert resolve(backend, queries) == expected
+    assert backend.failures == 0
+    assert backend.failed_over is False
+
+
+def test_mutations_reach_mirror_even_when_inner_is_bricked():
+    inner = ExplodingBackend(CUBE)
+    backend = ResilientBackend(inner, failover_after=2)
+    p = uuid.uuid4()
+    assert backend.add_subscription("w", p, Vector3(1, 1, 1)) is True
+    inner.exploding = True
+    q = uuid.uuid4()
+    # mutation failures are contained; the authoritative mirror keeps
+    # accepting writes, and query fallback serves them
+    assert backend.add_subscription("w", q, Vector3(1, 1, 1)) is True
+    assert backend.query_cube("w", Vector3(1, 1, 1)) == {p, q}
+    assert backend.total_failures >= 1
+
+
+def test_failover_without_factory_still_degrades_cleanly():
+    """No factory (injected backend): no rebuild attempts, straight to
+    failover after the threshold."""
+    inner = ExplodingBackend(CUBE)
+    backend = ResilientBackend(inner, failover_after=2)
+    p = uuid.uuid4()
+    backend.add_subscription("w", p, Vector3(1, 1, 1))
+    inner.exploding = True
+    queries = [LocalQuery("w", Vector3(1, 1, 1), uuid.uuid4(),
+                          Replication.EXCEPT_SELF)]
+    assert resolve(backend, queries) == [[str(p)]]
+    assert resolve(backend, queries) == [[str(p)]]
+    assert backend.failed_over is True
+    assert backend.rebuilds == 0
+
+
+def test_snapshot_surface_is_served_by_the_mirror():
+    """export_rows/subscription_count answer from the authority, so the
+    shutdown index snapshot works even mid-device-failure."""
+    inner = ExplodingBackend(CUBE)
+    backend = ResilientBackend(inner, failover_after=1)
+    p = uuid.uuid4()
+    backend.add_subscription("w", p, Vector3(1, 1, 1))
+    inner.exploding = True
+    worlds, peers, wid, cube, pid = backend.export_rows()
+    assert worlds == ["w"] and peers == [p]
+    assert backend.subscription_count() == 1
+    assert backend.world_names() == ["w"]
+    assert backend.cube_count("w") == 1
+
+
+def test_remove_peer_and_unsubscribe_track_the_mirror():
+    backend = ResilientBackend(CpuSpatialBackend(CUBE), failover_after=3)
+    p, q = uuid.uuid4(), uuid.uuid4()
+    backend.add_subscription("w", p, Vector3(1, 1, 1))
+    backend.add_subscription("w", q, Vector3(1, 1, 1))
+    assert backend.remove_subscription("w", q, Vector3(1, 1, 1)) is True
+    assert backend.query_cube("w", Vector3(1, 1, 1)) == {p}
+    assert backend.remove_peer(p) is True
+    assert backend.query_cube("w", Vector3(1, 1, 1)) == set()
+    assert backend.total_failures == 0
+
+
+def test_ticker_integration_degrades_instead_of_dropping_ticks():
+    """Through the real TickBatcher: with backend.collect forced to
+    fail, delivered fan-out still reaches peers (degraded), and the
+    inflight accounting stays clean."""
+    from worldql_server_tpu.engine.peers import Peer, PeerMap
+    from worldql_server_tpu.engine.ticker import TickBatcher
+    from worldql_server_tpu.protocol import (
+        Instruction, Message, deserialize_message,
+    )
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+    async def scenario():
+        backend = ResilientBackend(
+            TpuSpatialBackend(CUBE),
+            factory=lambda: TpuSpatialBackend(CUBE),
+            failover_after=2,
+        )
+        peer_map = PeerMap()
+        inbox = []
+
+        sender, listener = uuid.uuid4(), uuid.uuid4()
+
+        async def send_raw(data):
+            inbox.append(deserialize_message(data))
+
+        await peer_map.insert(Peer(listener, "loop", send_raw, "test"))
+        backend.add_subscription("w", listener, Vector3(5, 1, 1))
+        backend.flush()
+
+        ticker = TickBatcher(backend, peer_map, interval=3600)
+        failpoints.registry.configure("backend.collect=error")
+        for i in range(2):
+            await ticker.enqueue(
+                Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    sender_uuid=sender, world_name="w",
+                    position=Vector3(5, 1, 1), parameter=f"m{i}",
+                ),
+                LocalQuery("w", Vector3(5, 1, 1), sender,
+                           Replication.EXCEPT_SELF),
+            )
+            await ticker.flush()
+        failpoints.registry.reset()
+        assert [m.parameter for m in inbox] == ["m0", "m1"]
+        assert backend.failed_over is True
+        await ticker.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))

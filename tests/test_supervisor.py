@@ -1,0 +1,207 @@
+"""Unit tests for the task supervisor (robustness/supervisor.py):
+restart-with-backoff, budget exhaustion, healthy-run budget refund,
+critical escalation, transient crash containment, and metrics
+accounting.
+"""
+
+import asyncio
+
+from worldql_server_tpu.engine.metrics import Metrics
+from worldql_server_tpu.robustness.supervisor import Supervisor, TaskPolicy
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 15))
+
+
+FAST = dict(backoff_base=0.005, backoff_max=0.02, reset_after=60.0)
+
+
+def test_crash_restarts_until_healthy():
+    async def scenario():
+        metrics = Metrics()
+        sup = Supervisor(metrics=metrics)
+        crashes = 0
+        healthy = asyncio.Event()
+
+        async def loop():
+            nonlocal crashes
+            if crashes < 2:
+                crashes += 1
+                raise RuntimeError("boom")
+            healthy.set()
+            await asyncio.sleep(3600)
+
+        st = sup.spawn("loop", loop, policy=TaskPolicy(budget=5, **FAST))
+        await asyncio.wait_for(healthy.wait(), 5)
+        assert st.state == "running"
+        assert st.crashes == 2 and st.restarts == 2
+        assert metrics.counters["supervisor.crashes"] == 2
+        assert metrics.counters["supervisor.restarts"] == 2
+        assert sup.unhealthy_count() == 0
+        await sup.stop()
+        assert st.state == "stopped"
+
+    run(scenario())
+
+
+def test_budget_exhaustion_marks_failed_without_escalation():
+    async def scenario():
+        metrics = Metrics()
+        escalated = []
+        sup = Supervisor(metrics=metrics, on_escalate=escalated.append)
+
+        async def always_crashes():
+            raise RuntimeError("boom")
+
+        st = sup.spawn(
+            "sweeper", always_crashes, policy=TaskPolicy(budget=2, **FAST)
+        )
+        await st.task
+        assert st.state == "failed"
+        assert st.crashes == 3  # initial run + 2 restarts
+        assert sup.unhealthy_count() == 1
+        assert sup.stats()["tasks"]["sweeper"]["state"] == "failed"
+        assert metrics.counters["supervisor.task_failures"] == 1
+        assert escalated == []  # non-critical: unhealthy, not fatal
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_critical_budget_exhaustion_escalates():
+    async def scenario():
+        metrics = Metrics()
+        escalated = []
+        sup = Supervisor(metrics=metrics, on_escalate=escalated.append)
+
+        async def always_crashes():
+            raise RuntimeError("device gone")
+
+        st = sup.spawn(
+            "ticker", always_crashes,
+            policy=TaskPolicy(budget=1, critical=True, **FAST),
+        )
+        await st.task
+        assert st.state == "failed"
+        assert escalated == ["ticker"]
+        assert metrics.counters["supervisor.escalations"] == 1
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_no_restart_policy_fails_on_first_crash():
+    async def scenario():
+        sup = Supervisor()
+
+        async def crashes():
+            raise RuntimeError("once")
+
+        st = sup.spawn(
+            "one-shot", crashes, policy=TaskPolicy(restart=False, **FAST)
+        )
+        await st.task
+        assert st.state == "failed" and st.restarts == 0
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_clean_return_is_done_not_restarted():
+    async def scenario():
+        sup = Supervisor()
+        runs = []
+
+        async def one_shot():
+            runs.append(1)
+
+        st = sup.spawn("restored-sweep", one_shot)
+        await st.task
+        await asyncio.sleep(0.05)
+        assert st.state == "done" and runs == [1]
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_healthy_run_refunds_the_budget():
+    async def scenario():
+        sup = Supervisor()
+        crashes = 0
+        done = asyncio.Event()
+
+        async def crashes_after_healthy_stretch():
+            nonlocal crashes
+            crashes += 1
+            if crashes > 4:
+                done.set()
+                await asyncio.sleep(3600)
+            # "healthy" for longer than reset_after, then crash: each
+            # crash must look like a fresh independent incident
+            await asyncio.sleep(0.03)
+            raise RuntimeError("rare independent crash")
+
+        st = sup.spawn(
+            "sweeper", crashes_after_healthy_stretch,
+            policy=TaskPolicy(
+                budget=1, backoff_base=0.001, backoff_max=0.002,
+                reset_after=0.02,
+            ),
+        )
+        # budget=1 would die on the second crash without the refund;
+        # with it the task survives 4 spaced-out crashes
+        await asyncio.wait_for(done.wait(), 5)
+        assert st.state == "running"
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_transient_crash_is_contained_and_counted():
+    async def scenario():
+        metrics = Metrics()
+        sup = Supervisor(metrics=metrics)
+
+        async def stage():
+            raise RuntimeError("collect failed")
+
+        task = sup.spawn_transient("tick-collect", stage())
+        assert await task is None  # exception contained, not raised
+        assert sup.transient_crashes == 1
+        assert metrics.counters["supervisor.crashes"] == 1
+
+        async def ok_stage():
+            return "result"
+
+        assert await sup.spawn_transient("tick-collect", ok_stage()) == "result"
+        await sup.stop()
+
+    run(scenario())
+
+
+def test_stop_cancels_running_and_pending_transients():
+    async def scenario():
+        sup = Supervisor()
+        started = asyncio.Event()
+
+        async def forever():
+            started.set()
+            await asyncio.sleep(3600)
+
+        st = sup.spawn("loop", forever)
+        t = sup.spawn_transient("stage", asyncio.sleep(3600))
+        await started.wait()
+        await sup.stop()
+        assert st.state == "stopped"
+        assert t.done()
+
+    run(scenario())
+
+
+def test_policy_defaults_come_from_supervisor_config():
+    sup = Supervisor(backoff_base=0.123, budget=9)
+    policy = sup.policy(critical=True)
+    assert policy.backoff_base == 0.123
+    assert policy.budget == 9
+    assert policy.critical is True

@@ -93,6 +93,34 @@ def _check_suppress_await(ctx: FileContext) -> Iterator[Violation]:
                 break
 
 
+#: modules whose long-lived tasks must run under the robustness
+#: supervisor (engine loops, transport recv loops) — a raw spawn there
+#: is an unobserved task whose crash silently kills its subsystem
+_SUPERVISED_SCOPE = (
+    "worldql_server_tpu/engine/",
+    "worldql_server_tpu/transports/",
+)
+
+
+def _check_unsupervised_task(ctx: FileContext) -> Iterator[Violation]:
+    if not any(scope in ctx.relpath for scope in _SUPERVISED_SCOPE):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_task_spawn(node):
+            yield from ctx.flag(
+                UNSUPERVISED_TASK,
+                node,
+                "raw task spawn in a supervised module — long-lived "
+                "tasks in engine/ and transports/ must go through "
+                "robustness.supervisor (spawn for loops, "
+                "spawn_transient for one-shots) so a crash is logged, "
+                "counted, restarted within budget, and escalated when "
+                "critical; a deliberate raw spawn (e.g. an "
+                "awaited-in-place helper task) needs "
+                "`# wql: allow(unsupervised-task)` with a rationale",
+            )
+
+
 def _check_blocking_call(ctx: FileContext) -> Iterator[Violation]:
     # collect every async function, then shallow-walk its body so calls
     # in nested sync defs (to_thread workers) stay legal
@@ -131,5 +159,11 @@ BLOCKING_CALL = Rule(
     "blocking call (time.sleep, sync sqlite, subprocess, ...) in async def",
     _check_blocking_call,
 )
+UNSUPERVISED_TASK = Rule(
+    "unsupervised-task",
+    "raw create_task/ensure_future in engine/ or transports/ instead of "
+    "the robustness supervisor",
+    _check_unsupervised_task,
+)
 
-RULES = [DANGLING_TASK, SUPPRESS_AWAIT, BLOCKING_CALL]
+RULES = [DANGLING_TASK, SUPPRESS_AWAIT, BLOCKING_CALL, UNSUPERVISED_TASK]

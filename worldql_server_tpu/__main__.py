@@ -95,6 +95,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-message-size", type=int,
                    help="inbound wire-message byte cap, both transports "
                         "(default 8 MiB)")
+    p.add_argument("--failpoints",
+                   help="arm fault-injection failpoints, e.g. "
+                        "'store.insert=error:0.2,wal.fsync=delay:5ms' "
+                        "(robustness/failpoints.py; default none)")
+    p.add_argument("--failpoints-seed", type=int, dest="failpoints_seed",
+                   help="deterministic RNG seed for probabilistic "
+                        "failpoints (chaos runs)")
+    p.add_argument("--failpoints-admin", action="store_true",
+                   help="expose GET/POST /failpoints on the HTTP admin "
+                        "surface (gated off by default)")
+    p.add_argument("--resilience", choices=["off", "on"],
+                   help="wrap the spatial backend in the degraded-mode "
+                        "ResilientBackend: contain device failures, "
+                        "rebuild from the CPU mirror, fail over "
+                        "TPU->CPU after --failover-after consecutive "
+                        "failures (default off)")
+    p.add_argument("--failover-after", type=int, dest="failover_after",
+                   help="consecutive backend failures before the "
+                        "TPU->CPU failover (default 3)")
+    p.add_argument("--supervisor-budget", type=int, dest="supervisor_budget",
+                   help="restarts a supervised task gets per unhealthy "
+                        "streak before it is marked failed (default 5)")
+    p.add_argument("--supervisor-backoff", type=float,
+                   dest="supervisor_backoff",
+                   help="first restart backoff in seconds, doubling to "
+                        "30s (default 0.5)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -108,6 +134,8 @@ _OVERRIDES = [
     "max_message_size",
     "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
     "checkpoint_interval",
+    "failpoints", "failpoints_seed", "resilience", "failover_after",
+    "supervisor_budget", "supervisor_backoff",
 ]
 
 
@@ -120,6 +148,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
     config.http_enabled = not args.no_http
     config.ws_enabled = not args.no_ws
     config.zmq_enabled = not args.no_zmq
+    if args.failpoints_admin:
+        config.failpoints_admin = True
     config.verbose = args.verbose
     return config
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..robustness import failpoints
 from ..spatial.quantize import region_coords
 from ..storage.store import DedupeOp, RecordStore, StoredRecord
 from ..protocol.types import Record, Vector3
@@ -83,6 +84,7 @@ class DurabilityPipeline:
         self._rz = getattr(config, "db_region_z_size", 16)
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: asyncio.Task | None = None
+        self._handle = None  # SupervisedTask when run under a supervisor
         # sequence bookkeeping for barriers: _seq stamps every enqueued
         # op, _applied trails it as the applier finishes store calls
         self._seq = 0
@@ -102,8 +104,20 @@ class DurabilityPipeline:
 
     # region: lifecycle
 
-    def start(self) -> None:
-        if self.mode == "wal" and self._task is None:
+    def start(self, supervisor=None) -> None:
+        """Start the write-behind applier (wal mode only). Under a
+        robustness.Supervisor the applier is a CRITICAL supervised
+        task — a permanently dead applier means a filling queue that
+        eventually backpressures every record handler, so budget
+        exhaustion escalates to clean shutdown."""
+        if self.mode != "wal":
+            return
+        if supervisor is not None:
+            if self._handle is None:
+                self._handle = supervisor.spawn(
+                    "durability-applier", self._applier, critical=True
+                )
+        elif self._task is None:
             self._task = asyncio.create_task(
                 self._applier(), name="durability-applier"
             )
@@ -116,7 +130,7 @@ class DurabilityPipeline:
         so the next boot's recovery replays them (dedupe ops are the
         exception and are derivable)."""
         drained = True
-        if self._task is not None:
+        if self._task is not None or self._handle is not None:
             try:
                 await asyncio.wait_for(self.drain(), drain_timeout)
             except asyncio.TimeoutError:
@@ -126,6 +140,10 @@ class DurabilityPipeline:
                     "they remain in the WAL for boot-time replay",
                     self._seq - self._applied,
                 )
+        if self._handle is not None:
+            await self._handle.stop()
+            self._handle = None
+        if self._task is not None:
             self._task.cancel()
             try:
                 await self._task
@@ -153,9 +171,11 @@ class DurabilityPipeline:
 
     async def insert_records(self, records: list[Record]) -> int:
         if self.mode == "off" or not records:
+            failpoints.fire("store.insert")
             return await self.store.insert_records(records)
         if self.mode == "sync":
             await self.wal.append(encode_insert(records))
+            failpoints.fire("store.insert")
             return await self.store.insert_records(records)
         # enqueue BEFORE the WAL ack (module docstring: the ordering
         # invariant checkpoints rely on). If the append then fails the
@@ -167,9 +187,11 @@ class DurabilityPipeline:
 
     async def delete_records(self, records: list[Record]) -> int:
         if self.mode == "off" or not records:
+            failpoints.fire("store.delete")
             return await self.store.delete_records(records)
         if self.mode == "sync":
             await self.wal.append(encode_delete(records))
+            failpoints.fire("store.delete")
             return await self.store.delete_records(records)
         await self._enqueue("delete", records)
         await self.wal.append(encode_delete(records))
@@ -317,6 +339,10 @@ class DurabilityPipeline:
 
     async def _apply(self, kind: str, batch: list) -> None:
         try:
+            # write-behind boundary: an armed `durability.apply` drops
+            # this batch exactly like a store error — counted, WAL
+            # truncation blocked, replay re-applies it at next boot
+            failpoints.fire("durability.apply")
             if kind == "insert":
                 await self.store.insert_records(batch)
             elif kind == "delete":

@@ -42,6 +42,7 @@ import zlib
 
 from ..protocol.codec import deserialize_message, serialize_message
 from ..protocol.types import Instruction, Message, Record
+from ..robustness import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -163,6 +164,10 @@ class WriteAheadLog:
     async def append(self, payload: bytes) -> None:
         """Durably append one entry: returns once the entry is written
         AND fsynced (possibly sharing its fsync with a whole group)."""
+        # an armed `wal.append` error rejects the append before it is
+        # framed — the pipeline's enqueue-first ordering means the op
+        # still reaches the store while the handler reports the failure
+        await failpoints.afire("wal.append")
         fut = self._loop.create_future()
         self._q.put(("write", frame_entry(payload), fut))
         await fut
@@ -272,6 +277,11 @@ class WriteAheadLog:
         if writes:
             t0 = time.perf_counter()
             try:
+                # `wal.fsync` failpoint: error = the whole group fails
+                # before any byte lands (clean disk-full simulation);
+                # delay = fsync latency, blocking only this writer
+                # thread (group-commit coalescing absorbs it)
+                failpoints.fire("wal.fsync")
                 for frame, _ in writes:
                     self._write_frame(frame)
                 self._file.flush()

@@ -167,6 +167,45 @@ class Config:
     checkpoint_interval: float = field(
         default_factory=lambda: float(_env("WQL_CHECKPOINT_INTERVAL", "60"))
     )
+    # Fault-injection failpoints (robustness/failpoints.py): a spec
+    # like "store.insert=error:0.2,wal.fsync=delay:5ms" arms named
+    # failure sites process-wide. Empty (the default) arms nothing and
+    # costs one dict-truthiness check per site.
+    failpoints: str = field(
+        default_factory=lambda: _env("WQL_FAILPOINTS", "")
+    )
+    # Deterministic RNG seed for probabilistic failpoints (chaos runs).
+    failpoints_seed: int | None = field(
+        default_factory=lambda: (
+            int(os.environ["WQL_FAILPOINTS_SEED"])
+            if os.environ.get("WQL_FAILPOINTS_SEED") else None
+        )
+    )
+    # Expose GET/POST /failpoints on the HTTP admin surface (gated:
+    # fault injection must be an explicit operator decision).
+    failpoints_admin: bool = field(
+        default_factory=lambda: _env("WQL_FAILPOINTS_ADMIN", "0") == "1"
+    )
+    # Degraded-mode spatial backend (robustness/resilient.py): 'on'
+    # wraps the spatial backend in ResilientBackend — contain device
+    # failures, rebuild from the authoritative CPU mirror, fail over
+    # TPU→CPU after `failover_after` consecutive failures. 'off' (the
+    # default) keeps the raw backend, reference-equivalent.
+    resilience: str = field(
+        default_factory=lambda: _env("WQL_RESILIENCE", "off")
+    )
+    failover_after: int = field(
+        default_factory=lambda: int(_env("WQL_FAILOVER_AFTER", "3"))
+    )
+    # Supervisor defaults (robustness/supervisor.py): restarts allowed
+    # per unhealthy streak and the first-restart backoff in seconds
+    # (doubles up to 30 s; a 60 s healthy run refunds the budget).
+    supervisor_budget: int = field(
+        default_factory=lambda: int(_env("WQL_SUPERVISOR_BUDGET", "5"))
+    )
+    supervisor_backoff: float = field(
+        default_factory=lambda: float(_env("WQL_SUPERVISOR_BACKOFF", "0.5"))
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation
@@ -244,6 +283,22 @@ class Config:
             errors.append("wal_segment_bytes must be greater than 0")
         if self.checkpoint_interval < 0:
             errors.append("checkpoint_interval must be >= 0 (0 = no timer)")
+        if self.resilience not in ("off", "on"):
+            errors.append("resilience must be 'off' or 'on'")
+        if self.failover_after < 1:
+            errors.append("failover_after must be >= 1")
+        if self.supervisor_budget < 0:
+            errors.append("supervisor_budget must be >= 0")
+        if self.supervisor_backoff < 0:
+            errors.append("supervisor_backoff must be >= 0")
+        if self.failpoints:
+            # fail at config time, not at the first armed boundary
+            from ..robustness.failpoints import FailpointSpecError, parse_spec
+
+            try:
+                parse_spec(self.failpoints)
+            except FailpointSpecError as exc:
+                errors.append(f"failpoints: {exc}")
         if self.mesh_batch <= 0:
             errors.append("mesh_batch must be greater than 0")
         if self.mesh_space < 0:

@@ -6,14 +6,20 @@ only"). The rebuild's contract is structured per-tick timing and
 engine state, exposed by ``GET /metrics`` (transports/http.py) and
 importable for tests.
 
-Single-threaded by design: all writers run on the asyncio loop, so
-plain ints suffice (the tick batcher's worker thread reports through
-loop-side code). Histograms are fixed log-spaced latency buckets —
-cheap, allocation-free, good enough for p50/p99 estimates.
+Mostly loop-confined: histogram and gauge writers all run on the
+asyncio loop (the WAL writer thread reports via
+``call_soon_threadsafe``). Counters are the one exception — the
+resilience layer increments failure counters from the ticker's collect
+worker thread — so ``inc`` takes a small lock: a read-modify-write on
+a plain int can lose updates across threads, and a chaos run's
+fault accounting must never under-count. Histograms are fixed
+log-spaced latency buckets — cheap, allocation-free, good enough for
+p50/p99 estimates.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -79,9 +85,11 @@ class Metrics:
         self.counters: defaultdict[str, int] = defaultdict(int)
         self.histograms: dict[str, Histogram] = {}
         self._gauges: dict[str, Callable[[], object]] = {}
+        self._counter_lock = threading.Lock()
 
     def inc(self, name: str, by: int = 1) -> None:
-        self.counters[name] += by
+        with self._counter_lock:
+            self.counters[name] += by
 
     def observe_ms(self, name: str, value_ms: float) -> None:
         hist = self.histograms.get(name)

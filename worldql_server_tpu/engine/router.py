@@ -22,6 +22,7 @@ import logging
 import uuid as uuid_mod
 
 from ..durability.pipeline import DurabilityPipeline
+from ..robustness import failpoints
 from ..protocol import Instruction, Message, Replication
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..storage.store import RecordStore
@@ -83,6 +84,10 @@ class Router:
             )
 
     async def _dispatch(self, message: Message) -> None:
+        # handler-boundary fault injection: fires INSIDE
+        # handle_message's containment, so an armed `router.dispatch`
+        # drops this message (counted in messages.errors), never more
+        failpoints.fire("router.dispatch")
         instruction = message.instruction
 
         if instruction == Instruction.HEARTBEAT:

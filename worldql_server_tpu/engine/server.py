@@ -13,6 +13,8 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..robustness import failpoints
+from ..robustness.supervisor import Supervisor
 from ..spatial.backend import SpatialBackend
 from ..spatial.cpu_backend import CpuSpatialBackend
 from ..storage.store import RecordStore, open_store
@@ -57,11 +59,51 @@ class WorldQLServer:
     ):
         config.validate()
         self.config = config
+        # Arm fault-injection failpoints BEFORE any subsystem that
+        # hosts an injection site comes up. The registry is
+        # process-global (like logging); only a non-empty spec touches
+        # it, so constructing a second server never disarms points a
+        # test configured directly.
+        if config.failpoints:
+            failpoints.registry.configure(
+                config.failpoints, seed=config.failpoints_seed
+            )
+        elif config.failpoints_seed is not None:
+            failpoints.registry.seed(config.failpoints_seed)
         self.backend = backend if backend is not None else build_backend(config)
+        if config.resilience == "on":
+            from ..robustness.resilient import ResilientBackend
+
+            if not isinstance(self.backend, ResilientBackend):
+                inner = self.backend
+                self.backend = ResilientBackend(
+                    inner,
+                    # rebuilds get a fresh backend of the configured
+                    # kind; injected test backends can't be re-made
+                    factory=(
+                        (lambda: build_backend(config))
+                        if backend is None else None
+                    ),
+                    failover_after=config.failover_after,
+                )
         self.store = store if store is not None else open_store(
             config.store_url, config
         )
         self.metrics = Metrics()
+        if hasattr(self.backend, "_note_failure"):  # ResilientBackend
+            self.backend.metrics = self.metrics
+        # Escalation contract: when a CRITICAL supervised task (ticker
+        # pump, ZMQ recv loop, durability applier) exhausts its restart
+        # budget the server requests its own clean shutdown — a broker
+        # that can no longer receive or tick must hand control back to
+        # the orchestrator, not sit up and deaf.
+        self.shutdown_requested = asyncio.Event()
+        self.supervisor = Supervisor(
+            metrics=self.metrics,
+            on_escalate=self._escalate,
+            backoff_base=config.supervisor_backoff,
+            budget=config.supervisor_budget,
+        )
         self.peer_map = PeerMap(
             on_remove=self._on_peer_remove, metrics=self.metrics
         )
@@ -72,6 +114,7 @@ class WorldQLServer:
             self.ticker = TickBatcher(
                 self.backend, self.peer_map, config.tick_interval,
                 metrics=self.metrics, pipeline=config.tick_pipeline,
+                supervisor=self.supervisor,
             )
         # Durability engine: WAL + write-behind pipeline. With
         # durability='off' (default) both stay None and the Router's
@@ -136,6 +179,37 @@ class WorldQLServer:
             )
         if self.durability is not None:
             self.metrics.gauge("durability", self.durability_status)
+        # Supervision + fault-injection accounting: restart/crash
+        # counters and the tasks_unhealthy gauge; per-failpoint fire
+        # counts so no injected fault is ever invisible in /metrics.
+        self.metrics.gauge("supervisor", self.supervisor.stats)
+        self.metrics.gauge(
+            "failpoints", failpoints.registry.fired_counts
+        )
+        if hasattr(self.backend, "status") and hasattr(
+            self.backend, "failed_over"
+        ):
+            self.metrics.gauge("resilience", self.backend.status)
+
+    def resilience_status(self) -> dict | None:
+        """Degraded-mode state for /healthz; None without a
+        ResilientBackend wrapper."""
+        if hasattr(self.backend, "status") and hasattr(
+            self.backend, "failed_over"
+        ):
+            return self.backend.status()
+        return None
+
+    def _escalate(self, task_name: str) -> None:
+        """Supervisor escalation hook: a critical task is permanently
+        dead — request a clean shutdown (run_forever exits its serve
+        loop; embedded callers watch ``shutdown_requested``)."""
+        logger.critical(
+            "critical task %r failed permanently — requesting clean "
+            "server shutdown", task_name,
+        )
+        self.metrics.inc("server.escalations")
+        self.shutdown_requested.set()
 
     def durability_status(self) -> dict | None:
         """Queue depth, WAL state, and last recovery for /healthz and
@@ -158,6 +232,7 @@ class WorldQLServer:
 
     async def start(self) -> None:
         """Bring up the store and all enabled transports (main.rs:106-207)."""
+        failpoints.fire("store.init")
         await self.store.init()
         if self.wal is not None:
             # Replay whatever the last process acked but never applied,
@@ -168,11 +243,9 @@ class WorldQLServer:
                 self.store, self.config.wal_dir, metrics=self.metrics
             )
             self.wal.start()
-            self.durability.start()
+            self.durability.start(supervisor=self.supervisor)
             if self.config.checkpoint_interval > 0:
-                self._tasks.append(asyncio.create_task(
-                    self._checkpoint_loop(), name="checkpoint"
-                ))
+                self.supervisor.spawn("checkpoint", self._checkpoint_loop)
         self._restore_index_snapshot()
 
         if self.config.ws_enabled:
@@ -197,30 +270,47 @@ class WorldQLServer:
             await zmq_t.start()
 
         if self.config.zmq_enabled:
-            self._tasks.append(
-                asyncio.create_task(self._staleness_sweeper(), name="stale-sweep")
-            )
+            self.supervisor.spawn("stale-sweep", self._staleness_sweeper)
 
         if self.ticker is not None:
             self.ticker.start()
 
         if self._restored_peers:
-            self._tasks.append(asyncio.create_task(
-                self._sweep_restored_peers(), name="restored-peer-sweep"
-            ))
+            self.supervisor.spawn(
+                "restored-peer-sweep", self._sweep_restored_peers
+            )
 
         self._started.set()
         logger.info("worldql-server-tpu started")
 
+    async def _sweep_stale_once(self) -> int:
+        """One staleness pass: evict every silent heartbeat-tracked
+        peer. One peer's failing removal hook must not abort the sweep
+        over the REST of the stale set (or kill the sweeper task) —
+        the peer is already out of the map by the time a hook can
+        raise, so continuing is always safe. Returns peers evicted."""
+        timeout = self.config.zmq_timeout_secs
+        removed = 0
+        for uuid in self.peer_map.stale_peers(timeout):
+            logger.info("removing stale peer: %s", uuid)
+            try:
+                await self.peer_map.remove(uuid)
+                removed += 1
+                self.metrics.inc("peers.evicted_stale")
+            except Exception:
+                self.metrics.inc("sweeper.remove_errors")
+                logger.exception(
+                    "stale-peer removal hook failed for %s — continuing "
+                    "the sweep", uuid,
+                )
+        return removed
+
     async def _staleness_sweeper(self) -> None:
         """Evict heartbeat-tracked peers that went silent
         (outgoing.rs:132-150)."""
-        timeout = self.config.zmq_timeout_secs
         while True:
-            await asyncio.sleep(timeout)
-            for uuid in self.peer_map.stale_peers(timeout):
-                logger.info("removing stale peer: %s", uuid)
-                await self.peer_map.remove(uuid)
+            await asyncio.sleep(self.config.zmq_timeout_secs)
+            await self._sweep_stale_once()
 
     def _restore_index_snapshot(self) -> None:
         """Reload the subscription index saved by the last shutdown —
@@ -348,6 +438,16 @@ class WorldQLServer:
         self._save_index_snapshot()
         if self.ticker is not None:
             await self.ticker.stop()
+        # Ordered teardown of supervised loops: the periodic loops stop
+        # FIRST (a checkpoint must not race the shutdown drain below),
+        # transports stop their own recv tasks, and the durability
+        # applier stays ALIVE until durability.stop() has drained the
+        # write-behind queue — only then does the supervisor's final
+        # sweep run (by which point every handle is already stopped).
+        for name in ("checkpoint", "stale-sweep", "restored-peer-sweep"):
+            handle = self.supervisor.get(name)
+            if handle is not None:
+                await handle.stop()
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:
@@ -378,14 +478,15 @@ class WorldQLServer:
                     f"{self.durability.dropped_batches} dropped batches",
                 )
             await self.wal.close()
+        await self.supervisor.stop()
         await self.store.close()
 
     async def run_forever(self) -> None:
-        """Serve until SIGINT/SIGTERM, then shut down gracefully — the
-        index snapshot and transport teardown must run on a container
-        stop (SIGTERM), not only on Ctrl-C. Registering loop handlers
-        also overrides the SIG_IGN that non-interactive shells hand to
-        background processes."""
+        """Serve until SIGINT/SIGTERM — or a supervisor escalation —
+        then shut down gracefully: the index snapshot and transport
+        teardown must run on a container stop (SIGTERM), not only on
+        Ctrl-C. Registering loop handlers also overrides the SIG_IGN
+        that non-interactive shells hand to background processes."""
         import signal
 
         await self.start()
@@ -398,10 +499,21 @@ class WorldQLServer:
                 hooked.append(sig)
             except (NotImplementedError, RuntimeError):
                 pass  # non-unix / nested loop: fall back to default
+        # awaited-in-place waiters, cancelled below (not long-lived
+        # loops, so they ride outside the supervisor)
+        waiters = [
+            asyncio.ensure_future(stop_requested.wait()),  # wql: allow(unsupervised-task)
+            asyncio.ensure_future(self.shutdown_requested.wait()),  # wql: allow(unsupervised-task)
+        ]
         try:
-            await stop_requested.wait()
-            logger.info("shutdown signal received")
+            await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
+            if self.shutdown_requested.is_set():
+                logger.critical("shutting down on supervisor escalation")
+            else:
+                logger.info("shutdown signal received")
         finally:
+            for waiter in waiters:
+                waiter.cancel()
             for sig in hooked:
                 loop.remove_signal_handler(sig)
             await self.stop()

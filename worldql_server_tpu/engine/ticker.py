@@ -36,6 +36,7 @@ import logging
 import time
 from collections import deque
 
+from ..robustness import failpoints
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..protocol.types import Message
 from .peers import PeerMap
@@ -52,12 +53,20 @@ class TickBatcher:
         max_batch: int = 16_384,
         metrics=None,
         pipeline: int = 1,
+        supervisor=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        # Optional robustness.Supervisor: the pump runs as a CRITICAL
+        # supervised task (restart with backoff; escalate to clean
+        # shutdown on budget exhaustion — a server that stopped ticking
+        # is deaf to its whole LocalMessage workload), and pipeline
+        # stages spawn crash-contained.
+        self._sup = supervisor
+        self._handle = None
         self.pipeline = max(1, int(pipeline))
         self._queue: list[tuple[Message, LocalQuery]] = []
         self._task: asyncio.Task | None = None
@@ -79,9 +88,17 @@ class TickBatcher:
         self.last_compaction_bucket = 0
 
     def start(self) -> None:
-        self._task = asyncio.create_task(self._run(), name="tick-batcher")
+        if self._sup is not None:
+            self._handle = self._sup.spawn(
+                "tick-batcher", self._run, critical=True
+            )
+            return
+        self._task = asyncio.create_task(self._run(), name="tick-batcher")  # wql: allow(unsupervised-task)
 
     async def stop(self) -> None:
+        if self._handle is not None:
+            await self._handle.stop()
+            self._handle = None
         if self._task is not None:
             self._task.cancel()
             try:
@@ -106,6 +123,10 @@ class TickBatcher:
     async def _run(self) -> None:
         while True:
             await asyncio.sleep(self.interval)
+            # deliberately OUTSIDE the containment below: an armed
+            # `ticker.pump` failpoint kills the pump itself, which is
+            # how the chaos suite drives supervisor restart/escalation
+            failpoints.fire("ticker.pump")
             try:
                 if self.pipeline > 1:
                     await self.flush_pipelined()
@@ -136,10 +157,11 @@ class TickBatcher:
                     self.metrics.observe_ms(
                         "tick.dispatch_ms", self.last_dispatch_ms
                     )
-                task = asyncio.create_task(
-                    self._collect_deliver(batch, handle, self._tail, t0),
-                    name="tick-collect",
-                )
+                stage = self._collect_deliver(batch, handle, self._tail, t0)
+                if self._sup is not None:
+                    task = self._sup.spawn_transient("tick-collect", stage)
+                else:
+                    task = asyncio.create_task(stage, name="tick-collect")  # wql: allow(unsupervised-task)
                 self._tail = task
                 self._inflight.append(task)
         if self.metrics is not None:
@@ -186,7 +208,9 @@ class TickBatcher:
         if targets is None:
             return
         try:
-            deliver_task = asyncio.ensure_future(
+            # awaited in place below (shield loop) — not a dangling
+            # loop, so it rides outside the supervisor
+            deliver_task = asyncio.ensure_future(  # wql: allow(unsupervised-task)
                 self.peer_map.deliver_batch([
                     (message, tgts)
                     for (message, _), tgts in zip(batch, targets)
@@ -283,7 +307,7 @@ class TickBatcher:
                 # cancel must not abort the awaited (slow-path) tail
                 # half-sent — fast-path frames are already in
                 # transport buffers and re-sending would duplicate.
-                deliver_task = asyncio.ensure_future(
+                deliver_task = asyncio.ensure_future(  # wql: allow(unsupervised-task)
                     self.peer_map.deliver_batch([
                         (message, tgts)
                         for (message, _), tgts in zip(batch, targets)

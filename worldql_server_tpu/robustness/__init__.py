@@ -1,0 +1,35 @@
+"""Crash containment: fault injection, task supervision, degraded mode.
+
+Three pillars (README "Fault injection & supervision"):
+
+* :mod:`.failpoints` — named, near-zero-overhead-when-off fault
+  injection at every boundary the server can lose work at;
+* :mod:`.supervisor` — every long-lived task observed, restarted with
+  backoff within a budget, escalated to clean shutdown when critical;
+* :mod:`.resilient` — the spatial backend wrapper that contains device
+  failures, rebuilds from the authoritative mirror, and fails over
+  TPU→CPU so fan-out degrades instead of flatlining.
+
+``resilient`` imports lazily via ``__getattr__``: it pulls in the
+spatial package, which the failpoint call sites (wal, transports)
+must not.
+"""
+
+from . import failpoints
+from .supervisor import Supervisor, SupervisedTask, TaskPolicy
+
+__all__ = [
+    "failpoints",
+    "Supervisor",
+    "SupervisedTask",
+    "TaskPolicy",
+    "ResilientBackend",
+]
+
+
+def __getattr__(name):
+    if name == "ResilientBackend":
+        from .resilient import ResilientBackend
+
+        return ResilientBackend
+    raise AttributeError(name)

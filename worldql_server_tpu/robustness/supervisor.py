@@ -1,0 +1,290 @@
+"""Long-lived task supervision: observe, restart with backoff, escalate.
+
+Before this module, the server's long-lived loops (checkpoint timer,
+staleness sweepers, ZMQ recv loop, durability applier, ticker pump)
+were bare ``asyncio.create_task`` calls nobody awaited: one unhandled
+exception and that subsystem was silently dead while the process kept
+"running" — the worst failure mode a production server can have.
+
+Every such loop now runs under a :class:`Supervisor` with a per-task
+:class:`TaskPolicy`:
+
+* a crash is logged with its traceback and counted
+  (``supervisor.crashes``), then the task is **restarted** after an
+  exponential backoff (``backoff_base`` doubling up to ``backoff_max``)
+  while the **restart budget** lasts;
+* a run that stays healthy for ``reset_after`` seconds refunds the
+  budget and resets the backoff — a sweeper that crashes once a week
+  must not drift toward permanent failure;
+* when the budget is exhausted the task enters the ``failed`` state
+  (the ``tasks_unhealthy`` gauge, wired into ``/healthz``); a
+  **critical** task (ticker pump, ZMQ recv loop, durability applier)
+  additionally **escalates** — the server's hook requests a clean
+  shutdown, because a broker that can no longer receive or tick is
+  better restarted by its orchestrator than left up and deaf.
+
+``spawn_transient`` covers the short-lived per-tick stage tasks: no
+restart (their batch is gone), but crashes are contained, logged and
+counted instead of vanishing into a GC'd task object.
+
+The ``tools/check`` rule ``unsupervised-task`` keeps this invariant
+static: a raw ``create_task``/``ensure_future`` in ``engine/`` or
+``transports/`` fails the lint unless deliberately pragma'd.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class TaskPolicy:
+    restart: bool = True        # restart after a crash (within budget)
+    backoff_base: float = 0.5   # first restart delay, seconds
+    backoff_max: float = 30.0   # backoff ceiling
+    budget: int = 5             # restarts allowed per unhealthy streak
+    reset_after: float = 60.0   # healthy-run seconds that refund budget
+    critical: bool = False      # escalate when the budget is exhausted
+
+
+class SupervisedTask:
+    """One supervised long-lived task: the runner loop that owns the
+    crash/restart/escalate state machine for a single factory."""
+
+    def __init__(
+        self,
+        name: str,
+        factory: Callable[[], Awaitable],
+        policy: TaskPolicy,
+        supervisor: "Supervisor",
+    ):
+        self.name = name
+        self.factory = factory
+        self.policy = policy
+        self.state = "running"   # running | done | stopped | failed
+        self.crashes = 0
+        self.restarts = 0
+        self._sup = supervisor
+        self._runner = asyncio.create_task(self._run(), name=f"sup:{name}")
+
+    @property
+    def task(self) -> asyncio.Task:
+        return self._runner
+
+    def done(self) -> bool:
+        return self._runner.done()
+
+    def cancel(self) -> None:
+        self._runner.cancel()
+
+    async def stop(self) -> None:
+        """Cancel the runner (and whatever factory run is in flight)
+        and wait it out; idempotent."""
+        if not self._runner.done():
+            self._runner.cancel()
+        try:
+            await self._runner
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    async def _run(self) -> None:
+        policy = self.policy
+        backoff = policy.backoff_base
+        while True:
+            started = time.monotonic()
+            try:
+                await self.factory()
+            except asyncio.CancelledError:
+                self.state = "stopped"
+                raise
+            except Exception:
+                self.crashes += 1
+                self._sup._note_crash(self.name)
+                logger.exception(
+                    "supervised task %r crashed (crash #%d)",
+                    self.name, self.crashes,
+                )
+                if time.monotonic() - started >= policy.reset_after:
+                    # it ran healthily for a long stretch before this
+                    # crash: refund the budget instead of letting rare
+                    # independent crashes accumulate into a failure
+                    self.restarts = 0
+                    backoff = policy.backoff_base
+                if not policy.restart or self.restarts >= policy.budget:
+                    self.state = "failed"
+                    self._sup._note_failure(self.name, self.policy.critical)
+                    return
+                self.restarts += 1
+                self._sup._note_restart(self.name)
+                logger.warning(
+                    "restarting task %r in %.3gs (restart %d/%d)",
+                    self.name, backoff, self.restarts, policy.budget,
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, policy.backoff_max)
+            else:
+                # clean return is completion (restored-peer sweep), not
+                # a crash — never restart it
+                self.state = "done"
+                return
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "crashes": self.crashes,
+            "restarts": self.restarts,
+            "critical": self.policy.critical,
+        }
+
+
+class Supervisor:
+    """Registry of supervised tasks for one server instance."""
+
+    def __init__(
+        self,
+        metrics=None,
+        on_escalate: Callable[[str], None] | None = None,
+        *,
+        backoff_base: float = 0.5,
+        budget: int = 5,
+    ):
+        self.metrics = metrics
+        self.on_escalate = on_escalate
+        self.backoff_base = backoff_base
+        self.budget = budget
+        self._tasks: dict[str, SupervisedTask] = {}
+        self._transients: set[asyncio.Task] = set()
+        self.transient_crashes = 0
+
+    # region: spawning
+
+    def policy(self, **overrides) -> TaskPolicy:
+        """A TaskPolicy seeded with this supervisor's configured
+        defaults (server config knobs)."""
+        base = dict(backoff_base=self.backoff_base, budget=self.budget)
+        base.update(overrides)
+        return TaskPolicy(**base)
+
+    def spawn(
+        self,
+        name: str,
+        factory: Callable[[], Awaitable],
+        *,
+        critical: bool = False,
+        policy: TaskPolicy | None = None,
+    ) -> SupervisedTask:
+        """Run ``factory`` under supervision. ``factory`` is re-invoked
+        on each restart, so pass the coroutine *function*, not a
+        coroutine object."""
+        if policy is None:
+            policy = self.policy(critical=critical)
+        st = SupervisedTask(name, factory, policy, self)
+        self._tasks[name] = st
+        return st
+
+    def spawn_transient(self, name: str, coro) -> asyncio.Task:
+        """Crash-contained one-shot task (per-tick pipeline stages):
+        no restart — its batch is gone — but the exception is logged
+        and counted instead of dying inside a GC'd task object."""
+
+        async def contained():
+            try:
+                return await coro
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.transient_crashes += 1
+                self._note_crash(name)
+                logger.exception("transient task %r crashed", name)
+                return None
+
+        task = asyncio.create_task(contained(), name=f"sup:{name}")
+        self._transients.add(task)
+        task.add_done_callback(self._transients.discard)
+        return task
+
+    # endregion
+
+    # region: lifecycle + introspection
+
+    async def stop(self) -> None:
+        """Stop every supervised task and cancel outstanding
+        transients. Final sweep of server shutdown — subsystems that
+        need ordered teardown (ticker, durability applier, ZMQ recv)
+        stop their own handles first; stopping an already-stopped
+        handle is a no-op."""
+        for st in list(self._tasks.values()):
+            await st.stop()
+        for task in list(self._transients):
+            task.cancel()
+        for task in list(self._transients):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._transients.clear()
+
+    def get(self, name: str) -> SupervisedTask | None:
+        return self._tasks.get(name)
+
+    def task_count(self) -> int:
+        return len(self._tasks)
+
+    def unhealthy_count(self) -> int:
+        """Tasks that exhausted their restart budget — the
+        ``tasks_unhealthy`` gauge surfaced by ``/healthz``."""
+        return sum(1 for t in self._tasks.values() if t.state == "failed")
+
+    def stats(self) -> dict:
+        return {
+            "tasks_unhealthy": self.unhealthy_count(),
+            "crashes": sum(t.crashes for t in self._tasks.values())
+            + self.transient_crashes,
+            "restarts": sum(t.restarts for t in self._tasks.values()),
+            "tasks": {
+                name: t.snapshot() for name, t in self._tasks.items()
+            },
+        }
+
+    # endregion
+
+    # region: accounting hooks (called by SupervisedTask)
+
+    def _note_crash(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("supervisor.crashes")
+            self.metrics.inc(f"supervisor.crashes.{name}")
+
+    def _note_restart(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("supervisor.restarts")
+            self.metrics.inc(f"supervisor.restarts.{name}")
+
+    def _note_failure(self, name: str, critical: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("supervisor.task_failures")
+        if not critical:
+            logger.error(
+                "task %r exhausted its restart budget — marked "
+                "unhealthy (see /healthz tasks_unhealthy)", name,
+            )
+            return
+        logger.critical(
+            "CRITICAL task %r exhausted its restart budget — "
+            "escalating to clean server shutdown", name,
+        )
+        if self.metrics is not None:
+            self.metrics.inc("supervisor.escalations")
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate(name)
+            except Exception:
+                logger.exception("escalation hook failed for %r", name)
+
+    # endregion

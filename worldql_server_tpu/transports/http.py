@@ -18,6 +18,7 @@ from aiohttp import web
 
 from ..protocol import Instruction, Message, Replication
 from ..protocol.types import NIL_UUID
+from ..robustness import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +36,12 @@ class HttpTransport:
         # a health endpoint nor metrics).
         app.router.add_get("/healthz", self._get_healthz)
         app.router.add_get("/metrics", self._get_metrics)
+        if config.failpoints_admin:
+            # fault-injection toggle — an explicit operator opt-in
+            # (WQL_FAILPOINTS_ADMIN=1 / --failpoints-admin); absent
+            # otherwise, so the route 404s like any unknown path
+            app.router.add_get("/failpoints", self._get_failpoints)
+            app.router.add_post("/failpoints", self._post_failpoints)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, config.http_host, config.http_port)
@@ -66,7 +73,61 @@ class HttpTransport:
         status = status_fn() if status_fn is not None else None
         if status is not None:
             body["durability"] = status
+        # Supervision state: per-task health plus the tasks_unhealthy
+        # gauge. Only present once something is actually supervised,
+        # so minimal servers keep the reference-shaped body.
+        supervisor = getattr(self.server, "supervisor", None)
+        if supervisor is not None and supervisor.task_count():
+            stats = supervisor.stats()
+            body["tasks_unhealthy"] = stats["tasks_unhealthy"]
+            body["supervisor"] = stats
+            if stats["tasks_unhealthy"]:
+                body["status"] = "degraded"
+        # Degraded-mode spatial backend (ResilientBackend): failover is
+        # THE signal an orchestrator restarts a node on.
+        res_fn = getattr(self.server, "resilience_status", None)
+        resilience = res_fn() if res_fn is not None else None
+        if resilience is not None:
+            body["resilience"] = resilience
+            if resilience["degraded"]:
+                body["status"] = "degraded"
         return web.json_response(body)
+
+    async def _get_failpoints(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.Response(status=401)
+        return web.json_response({
+            "active": failpoints.registry.active(),
+            "points": failpoints.registry.stats(),
+        })
+
+    async def _post_failpoints(self, request: web.Request) -> web.Response:
+        """Replace the armed failpoint set: JSON ``{"spec": "...",
+        "seed": N?}`` or a raw text spec body. An empty spec disarms
+        everything."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        try:
+            if "application/json" in request.headers.get("Content-Type", ""):
+                body = await request.json()
+                spec = body.get("spec", "")
+                seed = body.get("seed")
+            else:
+                spec = (await request.text()).strip()
+                seed = None
+            if not isinstance(spec, str) or not (
+                seed is None or isinstance(seed, int)
+            ):
+                raise ValueError("wrong field types")
+            failpoints.registry.configure(spec, seed=seed)
+        except failpoints.FailpointSpecError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        except Exception:
+            return web.Response(status=400)
+        return web.json_response({
+            "active": failpoints.registry.active(),
+            "points": failpoints.registry.stats(),
+        })
 
     async def _get_metrics(self, request: web.Request) -> web.Response:
         if not self._authorized(request):

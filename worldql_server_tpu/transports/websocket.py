@@ -31,6 +31,8 @@ from ..protocol import (
     serialize_message,
 )
 from ..engine.peers import FramedPayload, Peer
+from ..robustness import failpoints
+from ..robustness.failpoints import FailpointError
 
 logger = logging.getLogger(__name__)
 
@@ -129,7 +131,8 @@ class WebSocketTransport:
                     # finally runs the map removal too; the task makes
                     # the removal prompt rather than
                     # next-inbound-frame-delayed
-                    task = asyncio.get_running_loop().create_task(
+                    self.server.metrics.inc("peers.evicted_overflow")
+                    task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
                         self.server.peer_map.remove(peer_uuid)
                     )
                     self._evictions.add(task)
@@ -167,10 +170,14 @@ class WebSocketTransport:
                 connection.transport.writelines(frames)
                 return True
 
+            async def send_raw(data) -> None:
+                failpoints.fire("transport.send")
+                await connection.send(data)
+
             peer = Peer(
                 uuid=peer_uuid,
                 addr=addr,
-                send_raw=connection.send,
+                send_raw=send_raw,
                 kind="websocket",
                 tracks_heartbeat=False,
                 try_write=try_write,
@@ -186,7 +193,16 @@ class WebSocketTransport:
                 if message.instruction == Instruction.HANDSHAKE:
                     # Duplicate handshake ⇒ disconnect (websocket.rs:108-111).
                     return
-                await self.server.router.handle_message(message)
+                try:
+                    await self.server.router.handle_message(message)
+                except Exception:
+                    # same per-message containment as the ZMQ loop: a
+                    # poison message must cost one message, not the
+                    # connection
+                    self.server.metrics.inc("ws.recv_errors")
+                    logger.exception(
+                        "error processing websocket message — dropped"
+                    )
         except ConnectionClosed:
             pass
         except Exception:
@@ -215,8 +231,9 @@ class WebSocketTransport:
                     continue  # non-binary → ignore
                 return None
             try:
+                failpoints.fire("codec.decode")
                 message = deserialize_message(frame)
-            except DeserializeError:
+            except (DeserializeError, FailpointError):
                 logger.debug("deserialize error from peer: %s", addr)
                 if ignore_retries:
                     continue

@@ -35,6 +35,7 @@ from ..protocol import (
     deserialize_message,
     serialize_message,
 )
+from ..robustness import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +64,7 @@ class ZmqTransport:
         self._pull: zmq.asyncio.Socket | None = None
         self._push_sockets: dict[uuid_mod.UUID, zmq.asyncio.Socket] = {}
         self._recv_task: asyncio.Task | None = None
+        self._recv_handle = None  # SupervisedTask under a supervisor
         # Failed-send evictions run as tasks; the loop only weak-refs
         # running tasks, so retain them or a GC pass could drop an
         # eviction mid-flight and leak the dead peer from the map.
@@ -83,9 +85,20 @@ class ZmqTransport:
             config.zmq_server_host,
             config.zmq_server_port,
         )
-        self._recv_task = asyncio.create_task(self._recv_loop(), name="zmq-pull")
+        supervisor = getattr(self.server, "supervisor", None)
+        if supervisor is not None:
+            # CRITICAL: a permanently dead recv loop is a silently deaf
+            # transport — restart within budget, then escalate
+            self._recv_handle = supervisor.spawn(
+                "zmq-recv", self._recv_loop, critical=True
+            )
+        else:
+            self._recv_task = asyncio.create_task(self._recv_loop(), name="zmq-pull")  # wql: allow(unsupervised-task)
 
     async def stop(self) -> None:
+        if self._recv_handle is not None:
+            await self._recv_handle.stop()
+            self._recv_handle = None
         if self._recv_task is not None:
             self._recv_task.cancel()
             try:
@@ -103,41 +116,64 @@ class ZmqTransport:
 
     async def _recv_loop(self) -> None:
         """PULL loop (incoming.rs:26-75): multipart frames are
-        concatenated, deserialized-or-dropped, then routed."""
+        concatenated, deserialized-or-dropped, then routed.
+
+        Per-message crash containment: ANY exception escaping the
+        processing of one message (a router bug a hostile payload
+        tickles, a handshake connect error) drops THAT message —
+        logged and counted in ``zmq.recv_errors`` — and the loop keeps
+        receiving. Before this, one poison message permanently deafened
+        the transport while the process kept running. Faults in the
+        receive machinery itself (socket teardown, the `zmq.recv`
+        failpoint) still escape and are the supervisor's job."""
         assert self._pull is not None
         limit = self.server.config.max_message_size
         while True:
+            # outside the containment: kills the LOOP, exercising the
+            # supervisor's restart/escalate policy in the chaos suite
+            failpoints.fire("zmq.recv")
             parts = await self._pull.recv_multipart()
-            # MAXMSGSIZE bounds each PART; bound the flattened total
-            # before the join materializes it a second time. (libzmq
-            # assembles multipart atomically before delivery, so its
-            # own buffering of many under-cap parts cannot be bounded
-            # by any socket option — see Config.max_message_size.)
-            if sum(len(p) for p in parts) > limit:
-                logger.warning(
-                    "dropping oversized multipart zmq message (%d parts)",
-                    len(parts),
-                )
-                continue
-            data = b"".join(parts)
             try:
-                message = deserialize_message(data)
-            except DeserializeError:
-                logger.debug("dropping invalid zmq message: deserialize error")
-                continue
+                await self._process_inbound(parts, limit)
+            except Exception:
+                self.server.metrics.inc("zmq.recv_errors")
+                logger.exception(
+                    "error processing inbound zmq message — dropped"
+                )
 
-            if message.sender_uuid in self.server.peer_map:
-                if message.instruction != Instruction.HANDSHAKE:
-                    await self.server.router.handle_message(message)
-                continue
+    async def _process_inbound(self, parts: list[bytes], limit: int) -> None:
+        """One inbound multipart message: bound, decode, route."""
+        # MAXMSGSIZE bounds each PART; bound the flattened total
+        # before the join materializes it a second time. (libzmq
+        # assembles multipart atomically before delivery, so its
+        # own buffering of many under-cap parts cannot be bounded
+        # by any socket option — see Config.max_message_size.)
+        if sum(len(p) for p in parts) > limit:
+            logger.warning(
+                "dropping oversized multipart zmq message (%d parts)",
+                len(parts),
+            )
+            return
+        data = b"".join(parts)
+        try:
+            failpoints.fire("codec.decode")
+            message = deserialize_message(data)
+        except DeserializeError:
+            logger.debug("dropping invalid zmq message: deserialize error")
+            return
 
-            if (
-                message.instruction != Instruction.HANDSHAKE
-                or message.parameter is None
-            ):
-                continue  # unknown sender, not a handshake → ignore
+        if message.sender_uuid in self.server.peer_map:
+            if message.instruction != Instruction.HANDSHAKE:
+                await self.server.router.handle_message(message)
+            return
 
-            await self._handle_handshake(message)
+        if (
+            message.instruction != Instruction.HANDSHAKE
+            or message.parameter is None
+        ):
+            return  # unknown sender, not a handshake → ignore
+
+        await self._handle_handshake(message)
 
     async def _handle_handshake(self, message: Message) -> None:
         """Connect-back PUSH + handshake echo + registration
@@ -169,11 +205,13 @@ class ZmqTransport:
             if sock is None:
                 raise ConnectionError("push socket gone")
             try:
+                failpoints.fire("transport.send")
                 await sock.send(data)
             except Exception:
                 # Failed send ⇒ evict peer (outgoing.rs:66-76).
+                self.server.metrics.inc("peers.evicted_send_failed")
                 self._drop_socket(peer_uuid)
-                task = asyncio.get_running_loop().create_task(
+                task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
                     self.server.peer_map.remove(peer_uuid)
                 )
                 self._evictions.add(task)
