@@ -52,6 +52,8 @@ from collections import deque
 
 import numpy as np
 
+from worldql_server_tpu.observability import FlightRecorder, Tracer
+from worldql_server_tpu.observability.spans import NULL_TRACE
 from worldql_server_tpu.spatial.hashing import next_pow2
 
 
@@ -249,7 +251,7 @@ def _collect_compact(backend, result) -> int:
     return total
 
 
-def run_pipelined(backend, batches, csr_cap: int, depth: int):
+def run_pipelined(backend, batches, csr_cap: int, depth: int, tracer=None):
     """Drive the fan-out engine at a fixed pipeline depth.
 
     Returns ``(per_tick_latency_ms, sustained_ms, total_fanout)`` where
@@ -258,6 +260,11 @@ def run_pipelined(backend, batches, csr_cap: int, depth: int):
     throughput figure). depth=1 is the unpipelined request latency;
     deeper overlaps transfer and compute of adjacent ticks. The
     collect path is the server's compacted fetch (_collect_compact).
+
+    With an observability ``tracer``, each tick records a span trace
+    (dispatch / collect stages) into the tracer's sink — the same
+    flight-recorder substrate the server runs, so a 207 s outlier in a
+    BENCH run now leaves its own span tree behind (ISSUE 5).
     """
     lat, inflight, total_fanout = [], deque(), 0
     overflow = 0
@@ -269,19 +276,26 @@ def run_pipelined(backend, batches, csr_cap: int, depth: int):
 
     def drain():
         nonlocal total_fanout, overflow
-        t0, (m, result) = inflight.popleft()
-        n = _collect_compact(backend, result)
+        t0, trace, (m, result) = inflight.popleft()
+        with trace.span("tick.collect"):
+            n = _collect_compact(backend, result)
         if n > t_cap:
             overflow += 1
         else:
             total_fanout += n
+        trace.tag(fanout=n, overflowed=n > t_cap)
+        trace.finish()
         lat.append((time.perf_counter() - t0) * 1e3)
 
-    for b in batches:
-        inflight.append(
-            (time.perf_counter(),
-             backend.match_arrays_async(*b, csr_cap=csr_cap))
+    for i, b in enumerate(batches):
+        trace = (
+            tracer.begin("tick", tick=i, depth=depth)
+            if tracer is not None else NULL_TRACE
         )
+        t0 = time.perf_counter()
+        with trace.span("tick.dispatch"):
+            handle = backend.match_arrays_async(*b, csr_cap=csr_cap)
+        inflight.append((t0, trace, handle))
         if len(inflight) >= depth:
             drain()
     while inflight:
@@ -300,7 +314,8 @@ def steady(lat, depth: int):
     return lat[1:] if depth > 1 and len(lat) > 1 else lat
 
 
-def run_pipelined_adaptive(backend, batches, csr_cap: int, depth: int):
+def run_pipelined_adaptive(backend, batches, csr_cap: int, depth: int,
+                           tracer=None):
     """run_pipelined with capacity retry: the CSR result buffer is the
     dominant device→host payload, so it is sized to the workload's real
     fan-out rather than a worst-case bound — on overflow (total >
@@ -308,7 +323,7 @@ def run_pipelined_adaptive(backend, batches, csr_cap: int, depth: int):
     capacity. Returns (lat, sustained, total_fanout, csr_cap)."""
     while True:
         lat, sustained, total, overflow = run_pipelined(
-            backend, batches, csr_cap, depth
+            backend, batches, csr_cap, depth, tracer=tracer
         )
         if not overflow:
             return lat, sustained, total, csr_cap
@@ -795,15 +810,34 @@ def bench_config5(args) -> dict:
     # double-buffered. The first depth-2 tick (pipeline fill + any
     # first-use stall — the BENCH_r05 207 s outlier) reports
     # separately, outside the percentiles.
-    lat1, _, _, _ = run_pipelined_adaptive(tpu, batches, csr_cap, depth=1)
+    # Flight recorder on for the latency runs (ISSUE 5): every tick
+    # leaves a span trace, and the WORST tick reports its per-stage
+    # breakdown instead of hiding inside a bare p99 — the next 207 s
+    # outlier (BENCH_r05) names its stage.
+    tracer = Tracer(enabled=True)
+    flight = FlightRecorder(depth=2 * len(batches) + 8)
+    tracer.on_trace = flight.record
+    lat1, _, _, _ = run_pipelined_adaptive(tpu, batches, csr_cap, depth=1,
+                                           tracer=tracer)
     lat2_all, _, _, _ = run_pipelined_adaptive(tpu, batches, csr_cap,
-                                               depth=2)
+                                               depth=2, tracer=tracer)
     lat2 = steady(lat2_all, 2)
     first_tick2 = float(lat2_all[0])
+    worst = flight.worst_tick()
+    worst_tick = None
+    if worst is not None:
+        worst_tick = {
+            "wall_ms": round(worst.dur_ms, 3),
+            "tags": dict(worst.tags),
+            "stage_ms": {
+                k: round(v, 3) for k, v in sorted(worst.stage_ms().items())
+            },
+        }
     log(f"latency depth1: p50 {pctl(lat1, 50):.2f} p99 {pctl(lat1, 99):.2f} ms"
         f"  depth2: p50 {pctl(lat2, 50):.2f} p99 {pctl(lat2, 99):.2f} ms"
         f"  first depth-2 tick {first_tick2:.2f} ms"
         f"  (budget {TARGET_P99_MS} ms)")
+    log(f"worst recorded tick: {worst_tick}")
 
     # Attribution probes: how much of the latency is host↔device link
     # round trip (on tunneled devices: ~all of it) vs device compute —
@@ -898,6 +932,9 @@ def bench_config5(args) -> dict:
         # pipeline-fill tick, excluded from the p50/p99 above (the
         # BENCH_r05 207 s outlier was this sample)
         "first_tick_ms_depth2": round(first_tick2, 3),
+        # flight-recorder attribution of the slowest latency-run tick:
+        # wall + per-stage span breakdown (dispatch vs compacted fetch)
+        "worst_tick": worst_tick,
         "compact_fetches": tpu.compact_fetches,
         "full_fetches": tpu.full_fetches,
         "link_rtt_ms": round(rtt_ms, 3),

@@ -1,0 +1,121 @@
+"""Strict Prometheus text-exposition (0.0.4) parser for golden tests.
+
+Implements the subset of the scrape grammar a real scraper enforces on
+``render_prometheus()`` output, and fails loudly on anything it would
+reject: malformed sample lines, duplicate ``# TYPE`` declarations,
+samples without a ``TYPE``, non-monotone ``_bucket`` series,
+out-of-order ``le`` bounds, a ``+Inf`` bucket that disagrees with
+``_count``, or a histogram missing ``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+SAMPLE_RE = re.compile(
+    rf"^(?P<name>{_NAME})(?:\{{(?P<labels>[^}}]*)\}})? (?P<value>\S+)$"
+)
+LABEL_RE = re.compile(rf'^(?P<k>{_NAME})="(?P<v>[^"]*)"$')
+TYPE_RE = re.compile(rf"^# TYPE (?P<name>{_NAME}) (?P<kind>\w+)$")
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    return float(raw)  # raises on garbage — that's the point
+
+
+def parse_exposition(text: str):
+    """→ (types, samples): ``types`` maps metric name → kind, asserting
+    no duplicate TYPE lines; ``samples`` is a list of
+    ``(name, labels_dict, value)`` with every line strictly matched."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict, float]] = []
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = TYPE_RE.match(line)
+            assert m is not None, f"malformed comment line: {line!r}"
+            name = m.group("name")
+            assert name not in types, f"duplicate # TYPE for {name}"
+            types[name] = m.group("kind")
+            continue
+        m = SAMPLE_RE.match(line)
+        assert m is not None, f"malformed sample line: {line!r}"
+        labels: dict[str, str] = {}
+        if m.group("labels"):
+            for part in m.group("labels").split(","):
+                lm = LABEL_RE.match(part)
+                assert lm is not None, f"malformed label in {line!r}"
+                assert lm.group("k") not in labels, f"dup label in {line!r}"
+                labels[lm.group("k")] = lm.group("v")
+        samples.append(
+            (m.group("name"), labels, _parse_value(m.group("value")))
+        )
+    return types, samples
+
+
+def base_name(sample_name: str, types: dict) -> str:
+    """The TYPE-declared metric a sample belongs to (histogram series
+    samples carry _bucket/_sum/_count suffixes)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        stripped = sample_name.removesuffix(suffix)
+        if stripped != sample_name and types.get(stripped) == "histogram":
+            return stripped
+    return sample_name
+
+
+def validate_exposition(text: str):
+    """Full strict pass; returns (types, samples) for extra assertions.
+
+    * every sample's base metric carries exactly one ``# TYPE``
+    * histogram ``le`` bounds strictly ascend and end at ``+Inf``
+    * cumulative bucket counts are monotone non-decreasing
+    * the ``+Inf`` bucket equals ``_count``
+    * every histogram has ``_sum`` and ``_count``
+    """
+    types, samples = parse_exposition(text)
+    by_hist: dict[str, dict] = {}
+    for name, labels, value in samples:
+        base = base_name(name, types)
+        assert base in types, f"sample {name} has no # TYPE"
+        if types[base] != "histogram":
+            # a gauge legitimately named *_bucket (tick.compaction_bucket)
+            # is legal with its own TYPE; only a name that aliases a
+            # DECLARED histogram's series would confuse a scraper
+            stripped = name.removesuffix("_bucket")
+            assert stripped == name or types.get(stripped) != "histogram", (
+                f"{name} collides with histogram {stripped}'s series"
+            )
+            continue
+        h = by_hist.setdefault(
+            base, {"buckets": [], "sum": None, "count": None}
+        )
+        if name == base + "_bucket":
+            assert set(labels) == {"le"}, f"{name}: bucket needs only le"
+            h["buckets"].append((_parse_value(labels["le"]), value))
+        elif name == base + "_sum":
+            h["sum"] = value
+        elif name == base + "_count":
+            h["count"] = value
+    for base, h in by_hist.items():
+        bounds = [le for le, _ in h["buckets"]]
+        assert bounds == sorted(bounds), f"{base}: le bounds out of order"
+        assert len(set(bounds)) == len(bounds), f"{base}: duplicate le"
+        assert bounds and bounds[-1] == math.inf, f"{base}: no +Inf bucket"
+        counts = [c for _, c in h["buckets"]]
+        assert counts == sorted(counts), (
+            f"{base}: non-monotone cumulative bucket counts {counts}"
+        )
+        assert h["sum"] is not None, f"{base}: missing _sum"
+        assert h["count"] is not None, f"{base}: missing _count"
+        assert counts[-1] == h["count"], (
+            f"{base}: +Inf bucket {counts[-1]} != _count {h['count']}"
+        )
+    return types, samples

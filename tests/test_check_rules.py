@@ -629,12 +629,92 @@ def test_unsupervised_task_pragma_suppresses():
 
 # endregion
 
+# region: unspanned-stage
+
+
+TICKER_PATH = "worldql_server_tpu/engine/ticker.py"
+
+
+def test_unspanned_stage_fires_on_bare_tick_timer():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            handle = self.backend.dispatch_local_batch(batch)
+            self.metrics.observe_ms("tick.dispatch_ms", 1.0)
+    """
+    assert violations(
+        src, relpath=TICKER_PATH, select="unspanned-stage"
+    ) == [("unspanned-stage", 5)]
+
+
+def test_unspanned_stage_fires_on_time_ms_context():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            with self.metrics.time_ms("tick.collect_ms"):
+                targets = await self._collect()
+    """
+    assert violations(
+        src, relpath=TICKER_PATH, select="unspanned-stage"
+    ) == [("unspanned-stage", 4)]
+
+
+def test_unspanned_stage_quiet_inside_span_block():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            with trace.span("tick.dispatch"):
+                handle = self.backend.dispatch_local_batch(batch)
+                self.metrics.observe_ms("tick.dispatch_ms", 1.0)
+            with self._tracer.span("tick.collect"):
+                with self.metrics.time_ms("tick.collect_ms"):
+                    targets = await self._collect()
+    """
+    assert rules_fired(
+        src, relpath=TICKER_PATH, select="unspanned-stage"
+    ) == set()
+
+
+def test_unspanned_stage_ignores_non_tick_series_and_other_modules():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            self.metrics.observe_ms("durability.apply_ms", 1.0)
+            self.metrics.inc("tick.flushes")
+    """
+    assert rules_fired(
+        src, relpath=TICKER_PATH, select="unspanned-stage"
+    ) == set()
+    bare = """
+    class Pipeline:
+        async def _applier(self):
+            self.metrics.observe_ms("tick.collect_ms", 1.0)
+    """
+    assert rules_fired(
+        bare, relpath="worldql_server_tpu/durability/pipeline.py",
+        select="unspanned-stage",
+    ) == set()
+
+
+def test_unspanned_stage_pragma_suppresses():
+    src = """
+    class TickBatcher:
+        def _account(self):
+            self.metrics.observe_ms("tick.flush_ms", 1.0)  # wql: allow(unspanned-stage)
+    """
+    assert rules_fired(
+        src, relpath=TICKER_PATH, select="unspanned-stage"
+    ) == set()
+
+
+# endregion
+
 
 def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 10
+    assert len(names) >= 11
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -645,6 +725,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-traced-branch",
         "full-fetch-on-tick",
         "store-on-loop",
+        "unspanned-stage",
         "wire-mutable-buffer",
     }
 

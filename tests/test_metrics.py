@@ -7,8 +7,6 @@ import uuid
 
 import pytest
 
-pytest.importorskip("websockets")  # the e2e flows drive a WS client
-
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.engine.metrics import Histogram, Metrics
 from worldql_server_tpu.engine.server import WorldQLServer
@@ -32,10 +30,89 @@ def test_histogram_quantiles():
     assert snap["p99_ms"] >= 90.0
 
 
-def test_histogram_overflow_bucket():
+def test_histogram_multi_second_range_stays_finite():
+    # BENCH_r05's 207 s outlier regime: the ladder must resolve
+    # multi-second latencies into real buckets, not collapse to +inf
     h = Histogram()
     h.observe_ms(10_000.0)
-    assert h.quantile(0.5) == float("inf")
+    assert h.quantile(0.5) == 10_000.0
+
+
+def test_histogram_overflow_reports_max_observed_not_inf():
+    h = Histogram()
+    h.observe_ms(500_000.0)   # above the 250 s top bucket
+    h.observe_ms(750_000.0)
+    snap = h.snapshot()
+    assert h.quantile(0.5) == 750_000.0      # finite upper estimate
+    assert snap["p99_ms"] == 750_000.0
+    assert snap["max_ms"] == 750_000.0
+    assert snap["p50_ms"] != float("inf")
+
+
+def test_histogram_max_tracks_in_range_values_too():
+    h = Histogram()
+    for v in (1.0, 42.0, 3.0):
+        h.observe_ms(v)
+    assert h.snapshot()["max_ms"] == 42.0
+    # ranks inside the ladder still report bucket upper bounds
+    assert h.quantile(0.99) == 50.0
+
+
+def test_observe_ms_thread_safe_under_contention():
+    # PR 3 observes tick.collect_ms from the collect worker thread
+    # while the loop observes other series: lazy Histogram creation
+    # plus bucket list read-modify-writes must not lose updates.
+    import threading
+
+    m = Metrics()
+    n, workers = 20_000, 4
+
+    def hammer():
+        for i in range(n):
+            m.observe_ms("contended_ms", 1.0 if i % 2 else 5_000.0)
+
+    threads = [threading.Thread(target=hammer) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h = m.histograms["contended_ms"]
+    assert h.total == n * workers
+    assert sum(h.counts) == h.total
+    assert h.max_ms == 5_000.0
+
+
+def test_render_prometheus_passes_strict_scraper_grammar():
+    from prom_parser import validate_exposition
+
+    m = Metrics()
+    m.inc("messages.local_message", 3)
+    m.inc("zmq.recv_errors")
+    for v in (0.1, 4.0, 90.0, 3_000.0, 999_999.0):  # incl. overflow
+        m.observe_ms("tick.flush_ms", v)
+    m.observe_ms("durability.apply_ms", 1.25)
+    m.gauge("peers", lambda: 2)
+    m.gauge("tick", lambda: {"last_batch": 1, "pipeline": 2,
+                             "label": "text-skipped"})
+    m.set_gauge("tick.compaction_bucket", 4096)
+
+    text = m.render_prometheus()
+    types, samples = validate_exposition(text)
+
+    assert types["wql_messages_local_message_total"] == "counter"
+    assert types["wql_tick_flush_seconds"] == "histogram"
+    assert types["wql_peers"] == "gauge"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    # le bounds are in SECONDS: the ms ladder's 2.5 ms bucket is 0.0025
+    les = {lab["le"] for lab, _ in by_name["wql_tick_flush_seconds_bucket"]}
+    assert "0.0025" in les and "250" in les and "+Inf" in les
+    # flattened dict gauge leaves, non-numeric leaf skipped
+    assert ("wql_tick_last_batch", [({}, 1.0)]) in by_name.items()
+    assert "wql_tick_label" not in by_name
+    [(_, count)] = by_name["wql_tick_flush_seconds_count"]
+    assert count == 5
 
 
 def test_counters_and_gauges():
@@ -51,6 +128,8 @@ def test_counters_and_gauges():
 
 
 def test_server_metrics_endpoint():
+    pytest.importorskip("websockets")  # this e2e flow drives a WS client
+
     async def scenario():
         ws_port, http_port = free_port(), free_port()
         server = WorldQLServer(Config(
@@ -122,6 +201,8 @@ def test_server_metrics_endpoint():
 
 
 def test_metrics_endpoint_requires_auth_token():
+    pytest.importorskip("websockets")  # server boots the WS transport
+
     async def scenario():
         ws_port, http_port = free_port(), free_port()
         server = WorldQLServer(Config(

@@ -1,0 +1,92 @@
+"""Observability-coverage rule: tick stages must be spanned.
+
+The flight recorder (ISSUE 5) can only attribute a slow tick to the
+stages that were actually spanned — a new tick-stage timer added to
+``engine/ticker.py`` without an enclosing ``span(...)`` block silently
+rots the attribution (the tick's wall time grows, the span tree
+doesn't, and the next 207 s outlier is back to being unexplained).
+
+This rule keeps that invariant static: any
+``metrics.observe_ms("tick.*", ...)`` or ``metrics.time_ms("tick.*")``
+call in ``engine/ticker.py`` must sit lexically inside a ``with``
+whose context expression is a ``...span(...)`` call (``trace.span``,
+``tracer.span`` — anything whose final attribute is ``span``).
+Whole-tick accounting series that the ROOT trace already covers are
+suppressed with ``# wql: allow(unspanned-stage)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name
+
+#: the module whose tick-stage timers must carry span coverage
+_SCOPED = ("engine/ticker.py",)
+
+_TIMER_METHODS = ("observe_ms", "time_ms")
+
+
+def _is_tick_timer(call: ast.Call) -> str | None:
+    """The observed series name if ``call`` is a tick-stage metrics
+    timer (``<x>.observe_ms("tick.…", …)`` / ``<x>.time_ms("tick.…")``),
+    else None."""
+    if not (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _TIMER_METHODS
+        and call.args
+    ):
+        return None
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        if first.value.startswith("tick."):
+            return first.value
+    return None
+
+
+def _is_span_with(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return False
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if name is not None and name.split(".")[-1] == "span":
+                return True
+    return False
+
+
+def _check_unspanned_stage(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_SCOPED):
+        return
+
+    def visit(node: ast.AST, spanned: bool) -> Iterator[Violation]:
+        if isinstance(node, ast.Call):
+            series = _is_tick_timer(node)
+            if series is not None and not spanned:
+                yield from ctx.flag(
+                    UNSPANNED_STAGE,
+                    node,
+                    f"tick-stage timer {series!r} observed outside a "
+                    "span block — the flight recorder cannot attribute "
+                    "this stage's wall time; wrap the stage in `with "
+                    "trace.span(...)` (or mark whole-tick accounting "
+                    "the root trace covers with "
+                    "`# wql: allow(unspanned-stage)`)",
+                )
+        child_spanned = spanned or _is_span_with(node)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, child_spanned)
+
+    yield from visit(ctx.tree, False)
+
+
+UNSPANNED_STAGE = Rule(
+    "unspanned-stage",
+    "tick-stage metrics timer in engine/ticker.py without an enclosing "
+    "span — flight-recorder attribution coverage rot",
+    _check_unspanned_stage,
+)
+
+RULES = [UNSPANNED_STAGE]

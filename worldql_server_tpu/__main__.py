@@ -121,6 +121,24 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="supervisor_backoff",
                    help="first restart backoff in seconds, doubling to "
                         "30s (default 0.5)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable span tracing + the tick flight "
+                        "recorder (observability/): per-stage tick "
+                        "traces at GET /debug/ticks, loop-lag and "
+                        "GC-pause histograms (default off)")
+    p.add_argument("--slow-tick-ms", type=float, dest="slow_tick_ms",
+                   help="auto-dump any tick slower than this many ms "
+                        "(full span tree + loop health to "
+                        "<slow-tick-dir>/slow-ticks.jsonl, CRITICAL "
+                        "log); 0 dumps every tick; implies --trace "
+                        "(default: no dumping)")
+    p.add_argument("--flight-recorder-depth", type=int,
+                   dest="flight_recorder_depth",
+                   help="tick traces kept in the flight-recorder ring "
+                        "(default 64)")
+    p.add_argument("--slow-tick-dir", dest="slow_tick_dir",
+                   help="directory for slow-tick dump files "
+                        "(default ./slow_ticks)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -136,6 +154,7 @@ _OVERRIDES = [
     "checkpoint_interval",
     "failpoints", "failpoints_seed", "resilience", "failover_after",
     "supervisor_budget", "supervisor_backoff",
+    "slow_tick_ms", "flight_recorder_depth", "slow_tick_dir",
 ]
 
 
@@ -150,6 +169,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
     config.zmq_enabled = not args.no_zmq
     if args.failpoints_admin:
         config.failpoints_admin = True
+    if args.trace:
+        config.trace = True
     config.verbose = args.verbose
     return config
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..observability.spans import NOOP_SPAN
 from ..robustness import failpoints
 from ..spatial.quantize import region_coords
 from ..storage.store import DedupeOp, RecordStore, StoredRecord
@@ -69,6 +70,7 @@ class DurabilityPipeline:
         max_queue: int = 1024,
         max_batch_records: int = 512,
         prune_regions_above: int = 1024,
+        tracer=None,
     ):
         if mode not in MODES:
             raise ValueError(f"durability mode must be one of {MODES}")
@@ -78,6 +80,7 @@ class DurabilityPipeline:
         self.mode = mode
         self.wal = wal
         self.metrics = metrics
+        self.tracer = tracer
         self._max_batch = max_batch_records
         self._rx = getattr(config, "db_region_x_size", 16)
         self._ry = getattr(config, "db_region_y_size", 256)
@@ -169,12 +172,22 @@ class DurabilityPipeline:
 
     # region: record ops (the router's surface)
 
+    def _span(self, name: str, **tags):
+        """A handler-path span (one branch when tracing is off). These
+        nest under the router's per-message handle span, so a slow
+        record op shows its WAL/store split in the same trace."""
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            return NOOP_SPAN
+        return tracer.span(name, **tags)
+
     async def insert_records(self, records: list[Record]) -> int:
         if self.mode == "off" or not records:
             failpoints.fire("store.insert")
             return await self.store.insert_records(records)
         if self.mode == "sync":
-            await self.wal.append(encode_insert(records))
+            with self._span("wal.append", kind="insert", n=len(records)):
+                await self.wal.append(encode_insert(records))
             failpoints.fire("store.insert")
             return await self.store.insert_records(records)
         # enqueue BEFORE the WAL ack (module docstring: the ordering
@@ -182,7 +195,8 @@ class DurabilityPipeline:
         # op still reaches the store through the queue while the
         # handler raises — at-least-once, never an acked-but-lost write.
         await self._enqueue("insert", records)
-        await self.wal.append(encode_insert(records))
+        with self._span("wal.append", kind="insert", n=len(records)):
+            await self.wal.append(encode_insert(records))
         return len(records)
 
     async def delete_records(self, records: list[Record]) -> int:
@@ -190,11 +204,13 @@ class DurabilityPipeline:
             failpoints.fire("store.delete")
             return await self.store.delete_records(records)
         if self.mode == "sync":
-            await self.wal.append(encode_delete(records))
+            with self._span("wal.append", kind="delete", n=len(records)):
+                await self.wal.append(encode_delete(records))
             failpoints.fire("store.delete")
             return await self.store.delete_records(records)
         await self._enqueue("delete", records)
-        await self.wal.append(encode_delete(records))
+        with self._span("wal.append", kind="delete", n=len(records)):
+            await self.wal.append(encode_delete(records))
         return 0
 
     async def dedupe_records(self, ops: list[DedupeOp]) -> int:
@@ -314,12 +330,13 @@ class DurabilityPipeline:
                     break
                 seq = nxt[0]
                 batch.extend(nxt[2])
-            if self.metrics is not None:
-                with self.metrics.time_ms("durability.apply_ms"):
+            with self._span("durability.apply", kind=kind, n=len(batch)):
+                if self.metrics is not None:
+                    with self.metrics.time_ms("durability.apply_ms"):
+                        await self._apply(kind, batch)
+                    self.metrics.inc("durability.applied_ops")
+                else:
                     await self._apply(kind, batch)
-                self.metrics.inc("durability.applied_ops")
-            else:
-                await self._apply(kind, batch)
             self._applied = seq
             # prune applied regions: at quiesce (empty queue) always,
             # under load once the map outgrows the doubling threshold —

@@ -40,6 +40,7 @@ import threading
 import time
 import zlib
 
+from ..observability.spans import NOOP_SPAN
 from ..protocol.codec import deserialize_message, serialize_message
 from ..protocol.types import Instruction, Message, Record
 from ..robustness import failpoints
@@ -127,11 +128,16 @@ class WriteAheadLog:
         fsync_ms: float = 0.0,
         segment_bytes: int = 64 * 1024 * 1024,
         metrics=None,
+        tracer=None,
     ):
         self.dir = wal_dir
         self._fsync_s = max(fsync_ms, 0.0) / 1e3
         self._segment_bytes = segment_bytes
         self._metrics = metrics
+        # observability.Tracer: the writer thread emits a loose
+        # "wal.fsync" span per group commit (Trace.add is lock-guarded,
+        # so recording from this thread is safe)
+        self._tracer = tracer
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -276,26 +282,33 @@ class WriteAheadLog:
 
         if writes:
             t0 = time.perf_counter()
-            try:
-                # `wal.fsync` failpoint: error = the whole group fails
-                # before any byte lands (clean disk-full simulation);
-                # delay = fsync latency, blocking only this writer
-                # thread (group-commit coalescing absorbs it)
-                failpoints.fire("wal.fsync")
-                for frame, _ in writes:
-                    self._write_frame(frame)
-                self._file.flush()
-                os.fsync(self._file.fileno())
-            except Exception as exc:  # disk full / IO error: fail appends
-                logger.exception("WAL write/fsync failed")
-                self._resolve([fut for _, fut in writes], exc)
-            else:
-                self.fsyncs += 1
-                self.appended_entries += len(writes)
-                fsync_ms = (time.perf_counter() - t0) * 1e3
-                self._resolve(
-                    [fut for _, fut in writes], None, fsync_ms, len(writes)
-                )
+            span = (
+                self._tracer.span("wal.fsync", group=len(writes))
+                if self._tracer is not None and self._tracer.enabled
+                else NOOP_SPAN
+            )
+            with span:
+                try:
+                    # `wal.fsync` failpoint: error = the whole group
+                    # fails before any byte lands (clean disk-full
+                    # simulation); delay = fsync latency, blocking only
+                    # this writer thread (group commit absorbs it)
+                    failpoints.fire("wal.fsync")
+                    for frame, _ in writes:
+                        self._write_frame(frame)
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except Exception as exc:  # disk full / IO error
+                    logger.exception("WAL write/fsync failed")
+                    self._resolve([fut for _, fut in writes], exc)
+                else:
+                    self.fsyncs += 1
+                    self.appended_entries += len(writes)
+                    fsync_ms = (time.perf_counter() - t0) * 1e3
+                    self._resolve(
+                        [fut for _, fut in writes], None, fsync_ms,
+                        len(writes),
+                    )
 
         for op, arg, fut in controls:
             if op == "rotate":

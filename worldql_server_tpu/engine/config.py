@@ -206,6 +206,31 @@ class Config:
     supervisor_backoff: float = field(
         default_factory=lambda: float(_env("WQL_SUPERVISOR_BACKOFF", "0.5"))
     )
+    # Tick flight recorder (worldql_server_tpu/observability): span
+    # tracing of every tick/message stage, a ring buffer of the last
+    # N tick traces served at GET /debug/ticks, and the event-loop/GC
+    # health probes. Off by default — the disabled hot path pays one
+    # branch per flush/message (trace.py discipline).
+    trace: bool = field(
+        default_factory=lambda: _env("WQL_TRACE", "0") == "1"
+    )
+    # Auto-dump threshold: a tick slower than this many ms dumps its
+    # full span tree + loop-health context to
+    # <slow_tick_dir>/slow-ticks.jsonl with a CRITICAL log line.
+    # 0 dumps EVERY tick (CI smoke); unset/None disables dumping.
+    # Setting it implies tracing on (the dump needs the spans).
+    slow_tick_ms: float | None = field(
+        default_factory=lambda: (
+            float(os.environ["WQL_SLOW_TICK_MS"])
+            if os.environ.get("WQL_SLOW_TICK_MS") else None
+        )
+    )
+    flight_recorder_depth: int = field(
+        default_factory=lambda: int(_env("WQL_FLIGHT_RECORDER_DEPTH", "64"))
+    )
+    slow_tick_dir: str = field(
+        default_factory=lambda: _env("WQL_SLOW_TICK_DIR", "slow_ticks")
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation
@@ -291,6 +316,12 @@ class Config:
             errors.append("supervisor_budget must be >= 0")
         if self.supervisor_backoff < 0:
             errors.append("supervisor_backoff must be >= 0")
+        if self.slow_tick_ms is not None and self.slow_tick_ms < 0:
+            errors.append("slow_tick_ms must be >= 0 (0 = dump every tick)")
+        if self.flight_recorder_depth < 1:
+            errors.append("flight_recorder_depth must be >= 1")
+        if self.slow_tick_ms is not None and not self.slow_tick_dir:
+            errors.append("slow_tick_ms requires slow_tick_dir")
         if self.failpoints:
             # fail at config time, not at the first armed boundary
             from ..robustness.failpoints import FailpointSpecError, parse_spec
@@ -306,3 +337,10 @@ class Config:
 
         if errors:
             raise ValueError("; ".join(errors))
+
+    @property
+    def trace_enabled(self) -> bool:
+        """Tracing is on when asked for explicitly OR implied by a
+        slow-tick threshold — an auto-dump without spans would be an
+        empty tree."""
+        return self.trace or self.slow_tick_ms is not None

@@ -6,15 +6,15 @@ only"). The rebuild's contract is structured per-tick timing and
 engine state, exposed by ``GET /metrics`` (transports/http.py) and
 importable for tests.
 
-Mostly loop-confined: histogram and gauge writers all run on the
-asyncio loop (the WAL writer thread reports via
-``call_soon_threadsafe``). Counters are the one exception — the
-resilience layer increments failure counters from the ticker's collect
-worker thread — so ``inc`` takes a small lock: a read-modify-write on
-a plain int can lose updates across threads, and a chaos run's
-fault accounting must never under-count. Histograms are fixed
-log-spaced latency buckets — cheap, allocation-free, good enough for
-p50/p99 estimates.
+Thread-safe: counters were the first writers off the loop (the
+resilience layer increments from the ticker's collect worker thread),
+and since PR 3 histograms are too — ``tick.collect_ms`` is observed
+from the collect worker, and PR 5's span/flight-recorder plumbing adds
+the WAL writer thread. Lazy ``Histogram`` creation plus the bucket
+list's read-modify-writes can lose updates across threads, so
+``inc`` and ``observe_ms`` both take the registry lock. Histograms are
+fixed log-spaced latency buckets — cheap, allocation-free, good enough
+for p50/p99 estimates.
 """
 
 from __future__ import annotations
@@ -26,20 +26,27 @@ from contextlib import contextmanager
 from typing import Callable
 
 # Bucket upper bounds in milliseconds (log-spaced), +inf implicit.
+# The ladder runs into the multi-MINUTE range on purpose: BENCH_r05
+# recorded a 207,000 ms tick, and with a 2.5 s top bucket everything
+# above it collapsed into +inf — exactly the outlier regime the
+# flight recorder exists for. Anything past 250 s reports via the
+# overflow bucket's max-observed estimate (see ``quantile``).
 LATENCY_BUCKETS_MS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
-    1000.0, 2500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 25000.0, 50000.0, 100000.0,
+    250000.0,
 )
 
 
 class Histogram:
-    __slots__ = ("buckets", "counts", "total", "sum_ms")
+    __slots__ = ("buckets", "counts", "total", "sum_ms", "max_ms")
 
     def __init__(self, buckets=LATENCY_BUCKETS_MS):
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)
         self.total = 0
         self.sum_ms = 0.0
+        self.max_ms = 0.0
 
     def observe_ms(self, value_ms: float) -> None:
         i = 0
@@ -51,9 +58,14 @@ class Histogram:
         self.counts[i] += 1
         self.total += 1
         self.sum_ms += value_ms
+        if value_ms > self.max_ms:
+            self.max_ms = value_ms
 
     def quantile(self, q: float) -> float:
-        """Upper-bound estimate of the q-quantile from bucket counts."""
+        """Upper-bound estimate of the q-quantile from bucket counts.
+        Always finite: a rank landing in the overflow bucket reports
+        the maximum observed value (a true upper bound) instead of the
+        useless ``+inf`` the outlier regime used to collapse to."""
         if self.total == 0:
             return 0.0
         rank = q * self.total
@@ -64,9 +76,9 @@ class Histogram:
                 return (
                     self.buckets[i]
                     if i < len(self.buckets)
-                    else float("inf")
+                    else self.max_ms
                 )
-        return float("inf")
+        return self.max_ms
 
     def snapshot(self) -> dict:
         return {
@@ -74,6 +86,7 @@ class Histogram:
             "mean_ms": (self.sum_ms / self.total) if self.total else 0.0,
             "p50_ms": self.quantile(0.50),
             "p99_ms": self.quantile(0.99),
+            "max_ms": self.max_ms,
         }
 
 
@@ -85,17 +98,24 @@ class Metrics:
         self.counters: defaultdict[str, int] = defaultdict(int)
         self.histograms: dict[str, Histogram] = {}
         self._gauges: dict[str, Callable[[], object]] = {}
-        self._counter_lock = threading.Lock()
+        self._lock = threading.Lock()
 
     def inc(self, name: str, by: int = 1) -> None:
-        with self._counter_lock:
+        with self._lock:
             self.counters[name] += by
 
     def observe_ms(self, name: str, value_ms: float) -> None:
-        hist = self.histograms.get(name)
-        if hist is None:
-            hist = self.histograms[name] = Histogram()
-        hist.observe_ms(value_ms)
+        """Thread-safe: observed from the event loop AND worker threads
+        (tick.collect_ms from the collect worker, gc/wal series from
+        their own threads). The lock covers BOTH the lazy Histogram
+        creation (two racing creators would each keep half the
+        observations) and the bucket increments (list writes are
+        read-modify-write and can lose updates across threads)."""
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram()
+            hist.observe_ms(value_ms)
 
     @contextmanager
     def time_ms(self, name: str):
@@ -130,12 +150,15 @@ class Metrics:
 
     def snapshot(self) -> dict:
         gauges = self._eval_gauges()
+        with self._lock:
+            # copy under the lock: a worker thread lazily creating a
+            # histogram mid-iteration would otherwise blow up the scrape
+            counters = dict(self.counters)
+            hists = list(self.histograms.items())
         return {
             "uptime_s": round(time.time() - self.started_at, 3),
-            "counters": dict(self.counters),
-            "latency": {
-                name: hist.snapshot() for name, hist in self.histograms.items()
-            },
+            "counters": counters,
+            "latency": {name: hist.snapshot() for name, hist in hists},
             "gauges": gauges,
         }
 
@@ -155,22 +178,31 @@ class Metrics:
         out.append(
             f"wql_uptime_seconds {time.time() - self.started_at:.3f}"
         )
-        for raw, value in sorted(self.counters.items()):
+        with self._lock:
+            counters = sorted(self.counters.items())
+            hists = sorted(self.histograms.items())
+        for raw, value in counters:
             n = name_of(raw) + "_total"  # Prometheus counter convention
             out.append(f"# TYPE {n} counter")
             out.append(f"{n} {value}")
-        for raw, hist in sorted(self.histograms.items()):
+        for raw, hist in hists:
             # registry names carry '_ms'; the export is in seconds, so
             # swap the unit suffix instead of stacking both
             n = name_of(raw.removesuffix("_ms")) + "_seconds"
+            with self._lock:
+                # consistent point-in-time copy: a worker observing
+                # mid-render must not make +Inf's cumulative count
+                # disagree with _count (scrapers reject that)
+                counts = list(hist.counts)
+                total, sum_ms = hist.total, hist.sum_ms
             out.append(f"# TYPE {n} histogram")
             acc = 0
-            for bound, count in zip(hist.buckets, hist.counts):
+            for bound, count in zip(hist.buckets, counts):
                 acc += count
                 out.append(f'{n}_bucket{{le="{bound / 1e3:g}"}} {acc}')
-            out.append(f'{n}_bucket{{le="+Inf"}} {hist.total}')
-            out.append(f"{n}_sum {hist.sum_ms / 1e3:.6f}")
-            out.append(f"{n}_count {hist.total}")
+            out.append(f'{n}_bucket{{le="+Inf"}} {total}')
+            out.append(f"{n}_sum {sum_ms / 1e3:.6f}")
+            out.append(f"{n}_count {total}")
         for raw, value in sorted(self._eval_gauges().items()):
             leaves = (
                 {f"{raw}.{k}": v for k, v in value.items()}

@@ -48,6 +48,7 @@ class Router:
         ticker=None,
         metrics=None,
         durability: DurabilityPipeline | None = None,
+        tracer=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
@@ -56,6 +57,10 @@ class Router:
         # batch instead of resolving immediately (engine/ticker.py).
         self.ticker = ticker
         self.metrics = metrics
+        # Optional observability.Tracer: per-message handle spans with
+        # the instruction as tag. One `enabled` branch per message when
+        # off — same budget as the trace_packet call below.
+        self.tracer = tracer
         # Every record op goes through the durability frontend — never
         # `await self.store.…` directly (tools/check: store-on-loop).
         # Without an injected pipeline, an off-mode pass-through keeps
@@ -72,8 +77,15 @@ class Router:
         trace_packet(message)
         if self.metrics is not None:
             self.metrics.inc(_MSG_COUNTERS[message.instruction])
+        tracer = self.tracer
         try:
-            await self._dispatch(message)
+            if tracer is not None and tracer.enabled:
+                with tracer.span(
+                    "router.handle", type=message.instruction.name
+                ):
+                    await self._dispatch(message)
+            else:
+                await self._dispatch(message)
         except Exception:
             if self.metrics is not None:
                 self.metrics.inc("messages.errors")

@@ -90,6 +90,26 @@ class WorldQLServer:
             config.store_url, config
         )
         self.metrics = Metrics()
+        # Observability: the tracer ALWAYS exists (router/transports
+        # test one `enabled` flag, no None checks on the hot path);
+        # the flight recorder + loop monitor only when tracing is on.
+        from ..observability import FlightRecorder, LoopMonitor, Tracer
+        from ..observability.export import ProfilerHook
+
+        self.tracer = Tracer(enabled=config.trace_enabled)
+        self.recorder = None
+        self.loop_monitor = None
+        self.profiler = ProfilerHook()
+        if config.trace_enabled:
+            self.loop_monitor = LoopMonitor(metrics=self.metrics)
+            self.recorder = FlightRecorder(
+                depth=config.flight_recorder_depth,
+                slow_tick_ms=config.slow_tick_ms,
+                dump_dir=config.slow_tick_dir,
+                metrics=self.metrics,
+                context=self.loop_monitor.snapshot,
+            )
+            self.tracer.on_trace = self.recorder.record
         if hasattr(self.backend, "_note_failure"):  # ResilientBackend
             self.backend.metrics = self.metrics
         # Escalation contract: when a CRITICAL supervised task (ticker
@@ -114,7 +134,7 @@ class WorldQLServer:
             self.ticker = TickBatcher(
                 self.backend, self.peer_map, config.tick_interval,
                 metrics=self.metrics, pipeline=config.tick_pipeline,
-                supervisor=self.supervisor,
+                supervisor=self.supervisor, tracer=self.tracer,
             )
         # Durability engine: WAL + write-behind pipeline. With
         # durability='off' (default) both stay None and the Router's
@@ -135,15 +155,16 @@ class WorldQLServer:
                 ),
                 segment_bytes=config.wal_segment_bytes,
                 metrics=self.metrics,
+                tracer=self.tracer,
             )
             self.durability = DurabilityPipeline(
                 self.store, mode=config.durability, wal=self.wal,
-                config=config, metrics=self.metrics,
+                config=config, metrics=self.metrics, tracer=self.tracer,
             )
         self.router = Router(
             self.peer_map, self.backend, self.store,
             ticker=self.ticker, metrics=self.metrics,
-            durability=self.durability,
+            durability=self.durability, tracer=self.tracer,
         )
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
@@ -186,6 +207,10 @@ class WorldQLServer:
         self.metrics.gauge(
             "failpoints", failpoints.registry.fired_counts
         )
+        if self.recorder is not None:
+            self.metrics.gauge("flight_recorder", self.recorder.stats)
+        if self.loop_monitor is not None:
+            self.metrics.gauge("loop_health", self.loop_monitor.snapshot)
         if hasattr(self.backend, "status") and hasattr(
             self.backend, "failed_over"
         ):
@@ -247,6 +272,13 @@ class WorldQLServer:
             if self.config.checkpoint_interval > 0:
                 self.supervisor.spawn("checkpoint", self._checkpoint_loop)
         self._restore_index_snapshot()
+
+        if self.loop_monitor is not None:
+            # loop-health probe: supervised (a dead probe restarts, and
+            # its absence shows in /healthz) but not critical — losing
+            # lag samples must never take the broker down
+            self.loop_monitor.install()
+            self.supervisor.spawn("loop-monitor", self.loop_monitor.run)
 
         if self.config.ws_enabled:
             from ..transports.websocket import WebSocketTransport
@@ -444,10 +476,15 @@ class WorldQLServer:
         # applier stays ALIVE until durability.stop() has drained the
         # write-behind queue — only then does the supervisor's final
         # sweep run (by which point every handle is already stopped).
-        for name in ("checkpoint", "stale-sweep", "restored-peer-sweep"):
+        for name in (
+            "checkpoint", "stale-sweep", "restored-peer-sweep",
+            "loop-monitor",
+        ):
             handle = self.supervisor.get(name)
             if handle is not None:
                 await handle.stop()
+        if self.loop_monitor is not None:
+            self.loop_monitor.uninstall()
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:

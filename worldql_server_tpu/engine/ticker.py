@@ -36,6 +36,7 @@ import logging
 import time
 from collections import deque
 
+from ..observability.spans import NULL_TRACE, Tracer
 from ..robustness import failpoints
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..protocol.types import Message
@@ -54,12 +55,19 @@ class TickBatcher:
         metrics=None,
         pipeline: int = 1,
         supervisor=None,
+        tracer: Tracer | None = None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        # Span tracing (observability/): every flush opens a "tick"
+        # trace whose stage spans the flight recorder ring-buffers.
+        # A disabled (or absent) tracer hands back shared null objects
+        # — the overhead is one branch per FLUSH, never per message.
+        self._tracer = tracer if tracer is not None else Tracer()
+        self._tick_seq = 0
         # Optional robustness.Supervisor: the pump runs as a CRITICAL
         # supervised task (restart with backoff; escalate to clean
         # shutdown on budget exhaustion — a server that stopped ticking
@@ -148,16 +156,20 @@ class TickBatcher:
         async with self._flushing:
             batch, self._queue = self._queue, []
             if batch:
+                trace = self._begin_trace(len(batch))
                 t0 = time.perf_counter()
-                handle = self.backend.dispatch_local_batch(
-                    [query for _, query in batch]
-                )
-                self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
-                if self.metrics is not None:
-                    self.metrics.observe_ms(
-                        "tick.dispatch_ms", self.last_dispatch_ms
+                with trace.span("tick.dispatch"):
+                    handle = self.backend.dispatch_local_batch(
+                        [query for _, query in batch]
                     )
-                stage = self._collect_deliver(batch, handle, self._tail, t0)
+                    self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
+                    if self.metrics is not None:
+                        self.metrics.observe_ms(
+                            "tick.dispatch_ms", self.last_dispatch_ms
+                        )
+                stage = self._collect_deliver(
+                    batch, handle, self._tail, t0, trace
+                )
                 if self._sup is not None:
                     task = self._sup.spawn_transient("tick-collect", stage)
                 else:
@@ -175,24 +187,33 @@ class TickBatcher:
             await self._await_quiet(self._inflight[0])
             self._reap()
 
-    async def _collect_deliver(self, batch, handle, prev, t0) -> None:
+    async def _collect_deliver(self, batch, handle, prev, t0, trace) -> None:
         """Stage 2 of a pipelined tick: device collect (worker thread),
         then — strictly after tick N-1's stage finished — the batched
         delivery. Handles its own errors (a failed collect drops only
         ITS batch; the next tick's stage runs untouched) and is never
         cancelled by stop(), which awaits the chain instead."""
+        try:
+            await self._collect_deliver_inner(batch, handle, prev, t0, trace)
+        finally:
+            trace.finish()  # idempotent; seals drop/error paths too
+
+    async def _collect_deliver_inner(
+        self, batch, handle, prev, t0, trace
+    ) -> None:
         targets = None
         try:
             tc = time.perf_counter()
-            targets = await asyncio.to_thread(
-                self.backend.collect_local_batch, handle
-            )
-            self.last_collect_ms = (time.perf_counter() - tc) * 1e3
-            if self.metrics is not None:
-                self.metrics.observe_ms(
-                    "tick.collect_ms", self.last_collect_ms
+            with trace.span("tick.collect"):
+                targets = await asyncio.to_thread(
+                    self.backend.collect_local_batch, handle
                 )
-            self._note_collect_stats()
+                self.last_collect_ms = (time.perf_counter() - tc) * 1e3
+                if self.metrics is not None:
+                    self.metrics.observe_ms(
+                        "tick.collect_ms", self.last_collect_ms
+                    )
+            self._note_collect_stats(trace)
         except Exception:
             logger.exception("tick collect failed — batch dropped")
         # Arrival order across ticks: tick N-1's deliveries must all
@@ -200,11 +221,12 @@ class TickBatcher:
         # first (worker threads overlap). Ride out cancellation: the
         # predecessor's delivery is owed regardless.
         if prev is not None:
-            while not prev.done():
-                try:
-                    await asyncio.shield(prev)
-                except (asyncio.CancelledError, Exception):
-                    continue
+            with trace.span("tick.wait_prev"):
+                while not prev.done():
+                    try:
+                        await asyncio.shield(prev)
+                    except (asyncio.CancelledError, Exception):
+                        continue
         if targets is None:
             return
         try:
@@ -222,16 +244,18 @@ class TickBatcher:
             # flush: a cancellation must not abort the delivery tail
             # half-sent (fast-path frames are already in transport
             # buffers; re-sending would duplicate)
-            while not deliver_task.done():
-                try:
-                    await asyncio.shield(deliver_task)
-                except asyncio.CancelledError:
-                    continue
-                except Exception:
-                    logger.exception("tick delivery failed")
-                    break
+            with trace.span("tick.deliver"):
+                while not deliver_task.done():
+                    try:
+                        await asyncio.shield(deliver_task)
+                    except asyncio.CancelledError:
+                        continue
+                    except Exception:
+                        logger.exception("tick delivery failed")
+                        break
             self._account(
-                batch, t0, deliver_ms=(time.perf_counter() - td) * 1e3
+                batch, t0, deliver_ms=(time.perf_counter() - td) * 1e3,
+                trace=trace,
             )
         except Exception:
             logger.exception("tick delivery failed — batch dropped")
@@ -275,31 +299,35 @@ class TickBatcher:
             batch, self._queue = self._queue, []
             if not batch:
                 return
+            trace = self._begin_trace(len(batch))
             t0 = time.perf_counter()
 
             dispatched = False
             deliver_task = None
             try:
                 td = time.perf_counter()
-                handle = self.backend.dispatch_local_batch(
-                    [query for _, query in batch]
-                )
-                self.last_dispatch_ms = (time.perf_counter() - td) * 1e3
+                with trace.span("tick.dispatch"):
+                    handle = self.backend.dispatch_local_batch(
+                        [query for _, query in batch]
+                    )
+                    self.last_dispatch_ms = (time.perf_counter() - td) * 1e3
+                    if self.metrics is not None:
+                        self.metrics.observe_ms(
+                            "tick.dispatch_ms", self.last_dispatch_ms
+                        )
                 tc = time.perf_counter()
-                targets = await asyncio.to_thread(
-                    self.backend.collect_local_batch, handle
-                )
-                dispatched = True
-                self.last_collect_ms = (time.perf_counter() - tc) * 1e3
-                self.last_resolve_ms = (time.perf_counter() - t0) * 1e3
-                if self.metrics is not None:
-                    self.metrics.observe_ms(
-                        "tick.dispatch_ms", self.last_dispatch_ms
+                with trace.span("tick.collect"):
+                    targets = await asyncio.to_thread(
+                        self.backend.collect_local_batch, handle
                     )
-                    self.metrics.observe_ms(
-                        "tick.collect_ms", self.last_collect_ms
-                    )
-                self._note_collect_stats()
+                    dispatched = True
+                    self.last_collect_ms = (time.perf_counter() - tc) * 1e3
+                    self.last_resolve_ms = (time.perf_counter() - t0) * 1e3
+                    if self.metrics is not None:
+                        self.metrics.observe_ms(
+                            "tick.collect_ms", self.last_collect_ms
+                        )
+                self._note_collect_stats(trace)
                 # One batched delivery: every message's frame goes to
                 # its targets' transport buffers synchronously; only
                 # saturated/fast-path-less peers cost an await at the
@@ -314,7 +342,8 @@ class TickBatcher:
                         if tgts
                     ])
                 )
-                await asyncio.shield(deliver_task)
+                with trace.span("tick.deliver"):
+                    await asyncio.shield(deliver_task)
             except asyncio.CancelledError:
                 if not dispatched:
                     # stop() landed before the device collect: the
@@ -339,9 +368,31 @@ class TickBatcher:
                             break  # delivery errors handled by _run
                 raise
 
-            self._account(batch, t0)
+            self._account(batch, t0, trace=trace)
 
-    def _account(self, batch, t0, deliver_ms: float | None = None) -> None:
+    def _begin_trace(self, batch_size: int):
+        """Open this flush's "tick" trace (the shared null trace when
+        tracing is off — one branch inside Tracer.begin, per flush)."""
+        self._tick_seq += 1
+        trace = self._tracer.begin(
+            "tick", tick=self._tick_seq, batch=batch_size,
+            inflight=len(self._inflight), pipeline=self.pipeline,
+        )
+        if trace is not NULL_TRACE:
+            stats_fn = getattr(self.backend, "device_stats", None)
+            if stats_fn is not None:
+                try:
+                    trace.tags["device_stats_at_dispatch"] = {
+                        k: v for k, v in stats_fn().items()
+                        if isinstance(v, (int, float))
+                    }
+                except Exception:
+                    pass  # diagnostics must never cost the tick
+        return trace
+
+    def _account(
+        self, batch, t0, deliver_ms: float | None = None, trace=NULL_TRACE,
+    ) -> None:
         self.ticks += 1
         self.messages += len(batch)
         self.last_batch = len(batch)
@@ -351,16 +402,21 @@ class TickBatcher:
             else self.last_tick_ms - self.last_resolve_ms
         )
         if self.metrics is not None:
-            self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)
-            self.metrics.observe_ms("tick.deliver_ms", self.last_deliver_ms)
+            # whole-tick accounting: the enclosing "tick" root trace IS
+            # the span for these two series
+            self.metrics.observe_ms("tick.flush_ms", self.last_tick_ms)  # wql: allow(unspanned-stage)
+            self.metrics.observe_ms("tick.deliver_ms", self.last_deliver_ms)  # wql: allow(unspanned-stage)
             self.metrics.inc("tick.flushes")
             self.metrics.inc("tick.messages", len(batch))
+        trace.tag(tick_ms=round(self.last_tick_ms, 3))
+        trace.finish()
 
-    def _note_collect_stats(self) -> None:
+    def _note_collect_stats(self, trace=NULL_TRACE) -> None:
         """Pull the backend's per-collect transfer stats (what the D2H
         fetch actually shipped, and whether the on-device compaction
-        packed it) into the metrics registry. Backends without the
-        stats (CPU reference) are silently skipped."""
+        packed it) into the metrics registry and the tick trace.
+        Backends without the stats (CPU reference) are silently
+        skipped."""
         stats = getattr(self.backend, "last_collect_stats", None)
         if not stats:
             return
@@ -372,3 +428,7 @@ class TickBatcher:
             self.metrics.set_gauge(
                 "tick.compaction_bucket", self.last_compaction_bucket
             )
+        trace.tag(
+            fetch_bytes=int(stats.get("fetch_bytes", 0)),
+            compaction_bucket=self.last_compaction_bucket,
+        )

@@ -1,0 +1,102 @@
+"""Trace export: Chrome-trace/Perfetto JSON + the jax.profiler hook.
+
+``chrome_trace`` converts flight-recorder trace dicts into the Trace
+Event Format every Chrome/Perfetto build loads (``chrome://tracing``,
+https://ui.perfetto.dev): complete events (``ph: "X"``) with
+microsecond epoch timestamps, one ``pid`` per process and one ``tid``
+per recorded thread name (named via ``thread_name`` metadata events).
+Served at ``GET /debug/ticks?format=chrome`` by the HTTP transport.
+
+``ProfilerHook`` is the device-level escalation: when host-side spans
+show the wall time disappearing INSIDE a dispatch/collect, a
+``POST /debug/profile`` round captures a ``jax.profiler`` trace
+(viewable in xprof/tensorboard) without restarting the server. jax is
+imported lazily so the debug surface itself never forces device
+bring-up.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def chrome_trace(traces: list[dict], pid: int | None = None) -> dict:
+    """Trace Event Format JSON for a list of ``Trace.as_dict()`` dicts."""
+    import os
+
+    if pid is None:
+        pid = os.getpid()
+    events: list[dict] = []
+    tids: dict[str, int] = {}
+    for trace in traces:
+        base_us = trace.get("start_unix_s", 0.0) * 1e6
+        for span in trace.get("spans", ()):
+            thread = span.get("thread") or "main"
+            tid = tids.setdefault(thread, len(tids) + 1)
+            args = dict(span.get("tags") or {})
+            args["trace"] = trace.get("name")
+            args.update(trace.get("tags") or {})
+            events.append({
+                "name": span["name"],
+                "cat": trace.get("name", "trace"),
+                "ph": "X",
+                "ts": round(base_us + span["t0_ms"] * 1e3, 3),
+                "dur": round(span["dur_ms"] * 1e3, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            })
+    for thread, tid in tids.items():
+        events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "ts": 0,
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": thread},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class ProfilerHook:
+    """Start/stop guard around ``jax.profiler`` for the HTTP hook.
+
+    One capture at a time (jax itself enforces this); start/stop from
+    the admin endpoint, state readable for ``GET``. Thread-safe — the
+    aiohttp handlers run on the loop but tests poke it directly.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.active_dir: str | None = None
+        self.captures = 0
+
+    def start(self, log_dir: str) -> None:
+        with self._lock:
+            if self.active_dir is not None:
+                raise RuntimeError(
+                    f"profiler already capturing into {self.active_dir}"
+                )
+            import jax
+
+            jax.profiler.start_trace(log_dir)
+            self.active_dir = log_dir
+            logger.info("jax profiler capture started → %s", log_dir)
+
+    def stop(self) -> str:
+        with self._lock:
+            if self.active_dir is None:
+                raise RuntimeError("no profiler capture in flight")
+            import jax
+
+            jax.profiler.stop_trace()
+            log_dir, self.active_dir = self.active_dir, None
+            self.captures += 1
+            logger.info("jax profiler capture stopped → %s", log_dir)
+            return log_dir
+
+    def status(self) -> dict:
+        return {"active_dir": self.active_dir, "captures": self.captures}

@@ -1,0 +1,153 @@
+"""Fixed-size ring of the last N completed tick traces + slow-tick dumps.
+
+The recorder is the ``on_trace`` sink of the server's
+:class:`~worldql_server_tpu.observability.spans.Tracer`: tick traces
+(root name ``"tick"``) land in the tick ring, everything else
+(per-message router handles, WAL fsyncs, transport recv spans) in a
+loose ring four times as deep. Both are dumpable on demand
+(``GET /debug/ticks``) and survive for exactly as long as an operator
+debugging a latency incident needs recent history — a bounded deque,
+no unbounded growth, no disk I/O on the happy path.
+
+Auto-dump: a tick trace whose wall time exceeds ``slow_tick_ms`` is
+appended — full span tree plus the loop-health context (event-loop lag
+and GC stats from ``loop_monitor``) — as one JSON line to
+``<dump_dir>/slow-ticks.jsonl``, with a CRITICAL log line carrying the
+stage breakdown, so the next BENCH_r05-style 207 s outlier explains
+itself instead of leaving a bare percentile. ``slow_tick_ms = 0``
+dumps every tick (the CI smoke uses this to prove the path end to
+end); ``None`` disables dumping while keeping the ring.
+
+Thread-safety: ``record`` is called from the event loop (tick traces)
+AND from worker threads (loose WAL-fsync traces), so the rings sit
+behind one lock. The dump write is synchronous on purpose — it fires
+only in the pathological case it documents, and a tick already 200 s
+late is not hurt by one small buffered write.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+logger = logging.getLogger(__name__)
+
+DUMP_FILENAME = "slow-ticks.jsonl"
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        depth: int = 64,
+        slow_tick_ms: float | None = None,
+        dump_dir: str = "slow_ticks",
+        metrics=None,
+        context=None,
+    ):
+        self.depth = max(1, int(depth))
+        self.slow_tick_ms = slow_tick_ms
+        self.dump_dir = dump_dir
+        self.metrics = metrics
+        #: zero-arg callable returning loop-health context for dumps
+        #: (the LoopMonitor's snapshot); None = no extra context
+        self.context = context
+        self._ticks: deque = deque(maxlen=self.depth)
+        self._loose: deque = deque(maxlen=self.depth * 4)
+        self._lock = threading.Lock()
+        self.ticks_recorded = 0
+        self.slow_ticks = 0
+
+    @property
+    def dump_path(self) -> str:
+        return os.path.join(self.dump_dir, DUMP_FILENAME)
+
+    def record(self, trace) -> None:
+        """Tracer sink: ring-buffer the finished trace; auto-dump slow
+        ticks. Never raises (the tracer guards, but a recorder bug
+        must not cost a tick either way)."""
+        is_tick = trace.name == "tick"
+        with self._lock:
+            if is_tick:
+                self._ticks.append(trace)
+                self.ticks_recorded += 1
+            else:
+                self._loose.append(trace)
+        if (
+            is_tick
+            and self.slow_tick_ms is not None
+            and trace.dur_ms >= self.slow_tick_ms
+        ):
+            self._dump_slow(trace)
+
+    def _dump_slow(self, trace) -> None:
+        self.slow_ticks += 1
+        if self.metrics is not None:
+            self.metrics.inc("tick.slow_dumps")
+        record = {
+            "dumped_at_unix_s": round(time.time(), 6),
+            "slow_tick_ms_threshold": self.slow_tick_ms,
+            "trace": trace.as_dict(),
+        }
+        if self.context is not None:
+            try:
+                record["loop_health"] = self.context()
+            except Exception:
+                logger.exception("slow-tick dump: loop-health probe failed")
+        stages = trace.stage_ms()
+        attributed = sum(stages.values())
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(self.dump_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+            where = self.dump_path
+        except Exception:
+            logger.exception("slow-tick dump write failed")
+            where = "<dump write failed>"
+        logger.critical(
+            "SLOW TICK: %.1f ms (threshold %.1f ms) — stages %s attribute "
+            "%.1f ms (%.0f%%); full span tree dumped to %s",
+            trace.dur_ms, self.slow_tick_ms,
+            {k: round(v, 1) for k, v in sorted(stages.items())},
+            attributed,
+            100.0 * attributed / trace.dur_ms if trace.dur_ms else 0.0,
+            where,
+        )
+
+    # region: introspection (HTTP debug surface + tests)
+
+    def snapshot(self) -> list[dict]:
+        """Tick traces, oldest first."""
+        with self._lock:
+            return [t.as_dict() for t in self._ticks]
+
+    def loose_snapshot(self) -> list[dict]:
+        with self._lock:
+            return [t.as_dict() for t in self._loose]
+
+    def last_tick(self):
+        with self._lock:
+            return self._ticks[-1] if self._ticks else None
+
+    def worst_tick(self):
+        """The slowest recorded tick trace (None when empty)."""
+        with self._lock:
+            if not self._ticks:
+                return None
+            return max(self._ticks, key=lambda t: t.dur_ms)
+
+    def stats(self) -> dict:
+        with self._lock:
+            recorded = len(self._ticks)
+        return {
+            "depth": self.depth,
+            "recorded": recorded,
+            "ticks_seen": self.ticks_recorded,
+            "slow_ticks": self.slow_ticks,
+            "slow_tick_ms": self.slow_tick_ms,
+        }
+
+    # endregion

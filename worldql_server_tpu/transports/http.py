@@ -42,6 +42,12 @@ class HttpTransport:
             # otherwise, so the route 404s like any unknown path
             app.router.add_get("/failpoints", self._get_failpoints)
             app.router.add_post("/failpoints", self._post_failpoints)
+        if getattr(self.server, "recorder", None) is not None:
+            # flight recorder debug surface — exists only when tracing
+            # is on (--trace / --slow-tick-ms), 404s otherwise
+            app.router.add_get("/debug/ticks", self._get_debug_ticks)
+            app.router.add_post("/debug/profile", self._post_debug_profile)
+            app.router.add_get("/debug/profile", self._get_debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, config.http_host, config.http_port)
@@ -91,7 +97,75 @@ class HttpTransport:
             body["resilience"] = resilience
             if resilience["degraded"]:
                 body["status"] = "degraded"
+        # Flight-recorder state (slow-tick count front and center): an
+        # operator probing a limping node sees HOW MANY ticks blew the
+        # threshold before scraping anything. Absent when tracing is
+        # off so the minimal body stays reference-shaped.
+        recorder = getattr(self.server, "recorder", None)
+        if recorder is not None:
+            body["flight_recorder"] = recorder.stats()
         return web.json_response(body)
+
+    async def _get_debug_ticks(self, request: web.Request) -> web.Response:
+        """Flight-recorder dump: the last N tick traces (plus the loose
+        message/WAL spans). ``?format=chrome`` renders Trace Event
+        Format JSON loadable in chrome://tracing / ui.perfetto.dev."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        recorder = self.server.recorder
+        ticks = recorder.snapshot()
+        if request.query.get("format") == "chrome":
+            from ..observability.export import chrome_trace
+
+            return web.json_response(
+                chrome_trace(ticks + recorder.loose_snapshot())
+            )
+        return web.json_response({
+            "recorder": recorder.stats(),
+            "ticks": ticks,
+            "loose": recorder.loose_snapshot(),
+        })
+
+    async def _get_debug_profile(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.Response(status=401)
+        return web.json_response(self.server.profiler.status())
+
+    async def _post_debug_profile(self, request: web.Request) -> web.Response:
+        """Device-level escalation: JSON ``{"action": "start", "dir":
+        PATH}`` begins a jax.profiler capture, ``{"action": "stop"}``
+        ends it (trace lands in the start dir, viewable with xprof/
+        tensorboard)."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        try:
+            body = await request.json()
+            action = body.get("action")
+        except Exception:
+            return web.Response(status=400)
+        profiler = self.server.profiler
+        try:
+            if action == "start":
+                log_dir = body.get("dir")
+                if not isinstance(log_dir, str) or not log_dir:
+                    return web.json_response(
+                        {"error": "start requires a 'dir' string"},
+                        status=400,
+                    )
+                profiler.start(log_dir)
+            elif action == "stop":
+                profiler.stop()
+            else:
+                return web.json_response(
+                    {"error": "action must be 'start' or 'stop'"},
+                    status=400,
+                )
+        except RuntimeError as exc:  # double start / stop without start
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:  # jax missing / profiler backend error
+            logger.exception("jax profiler hook failed")
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(profiler.status())
 
     async def _get_failpoints(self, request: web.Request) -> web.Response:
         if not self._authorized(request):

@@ -194,7 +194,17 @@ class WebSocketTransport:
                     # Duplicate handshake ⇒ disconnect (websocket.rs:108-111).
                     return
                 try:
-                    await self.server.router.handle_message(message)
+                    tracer = getattr(self.server, "tracer", None)
+                    if tracer is not None and tracer.enabled:
+                        # the router's handle span nests inside, so one
+                        # trace covers recv→decode (in _next_message's
+                        # loose span) and route→handle here
+                        with tracer.span(
+                            "ws.route", type=message.instruction.name
+                        ):
+                            await self.server.router.handle_message(message)
+                    else:
+                        await self.server.router.handle_message(message)
                 except Exception:
                     # same per-message containment as the ZMQ loop: a
                     # poison message must cost one message, not the
@@ -232,7 +242,12 @@ class WebSocketTransport:
                 return None
             try:
                 failpoints.fire("codec.decode")
-                message = deserialize_message(frame)
+                tracer = getattr(self.server, "tracer", None)
+                if tracer is not None and tracer.enabled:
+                    with tracer.span("ws.decode", bytes=len(frame)):
+                        message = deserialize_message(frame)
+                else:
+                    message = deserialize_message(frame)
             except (DeserializeError, FailpointError):
                 logger.debug("deserialize error from peer: %s", addr)
                 if ignore_retries:

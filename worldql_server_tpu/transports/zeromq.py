@@ -155,9 +155,24 @@ class ZmqTransport:
             )
             return
         data = b"".join(parts)
+        tracer = getattr(self.server, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            # recv→decode→route under one span tree: the decode and the
+            # router's handle span nest inside "zmq.recv", so a slow
+            # inbound message shows WHERE it spent its wall time
+            with tracer.span("zmq.recv", bytes=len(data)):
+                await self._decode_route(data, tracer)
+        else:
+            await self._decode_route(data, None)
+
+    async def _decode_route(self, data: bytes, tracer) -> None:
         try:
             failpoints.fire("codec.decode")
-            message = deserialize_message(data)
+            if tracer is not None:
+                with tracer.span("codec.decode"):
+                    message = deserialize_message(data)
+            else:
+                message = deserialize_message(data)
         except DeserializeError:
             logger.debug("dropping invalid zmq message: deserialize error")
             return
