@@ -49,11 +49,20 @@ def test_bench_default_contract():
     p50/p99 latency keys (VERDICT r2 #3, r4 next #2)."""
     records, stderr = run_bench(
         "--subs", "4000", "--queries", "256", "--ticks", "6",
-        "--cpu-ticks", "2",
+        "--cpu-ticks", "2", "--delivery-clients", "256",
     )
     assert len(records) == 1, records
     rec = records[0]
     assert rec["metric"] == "local_fanout_engine_tick_ms"
+    # the sharded-plane delivery variant rode along (ISSUE 6): same
+    # workload through worker processes, zero lost frames
+    workers = rec["server_delivery"]["workers"]
+    assert workers["n_workers"] >= 2
+    assert workers["lost_frames"] == 0
+    assert workers["deliveries_per_s"] > 0
+    assert workers["per_worker_deliveries_per_s"] > 0
+    assert workers["workers_for_1m_per_s"] >= 1
+    assert sum(w.get("deliveries", 0) for w in workers["per_worker"]) > 0
     assert rec["engine_p99_ms"] >= rec["value"] > 0
     assert rec["sustained_e2e_tick_ms"] > 0
     assert rec["p99_ms_depth1"] > 0
@@ -138,10 +147,36 @@ def test_bench_smoke_forces_compacted_collect():
 
 
 def test_bench_all_emits_one_line_per_config():
-    """--all: six configs, six JSON lines, in config order."""
+    """--all: six configs, six JSON lines, in config order (config 7
+    re-execs with a forced device topology and runs standalone)."""
     records, _ = run_bench(
         "--all", "--quick", "--subs", "4000", "--queries", "256",
         "--ticks", "6", "--cpu-ticks", "2",
     )
     assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6]
     assert len({rec["metric"] for rec in records}) == 6
+
+
+@pytest.mark.slow   # two jax boots + per-mesh compiles: minutes on CPU
+def test_bench_config7_sharded_overhead():
+    """Config 7 (ISSUE 6 satellite / ROADMAP item 3): the sharded
+    backend's 1→N-device scaling curve. In this CPU container the
+    bench re-execs itself with 8 virtual host devices; quick mode
+    times the 1- and 2-shard meshes against single-device."""
+    records, stderr = run_bench(
+        "--config", "7", "--quick", "--subs", "4000", "--queries",
+        "256", "--ticks", "4",
+    )
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "sharded_overhead_tick_ms"
+    block = rec["sharded_overhead"]
+    assert block["single_device_tick_ms"] > 0
+    devices = [p["devices"] for p in block["curve"]]
+    assert devices == [1, 2]
+    for point in block["curve"]:
+        assert point["tick_ms"] > 0 and point["vs_single"] > 0
+    assert block["shard_map_pmax_overhead_x"] == block["curve"][0][
+        "vs_single"
+    ]
+    assert "sharded_overhead" in stderr

@@ -709,12 +709,122 @@ def test_unspanned_stage_pragma_suppresses():
 
 # endregion
 
+# region: worker-unsafe-delivery
+
+WORKER_PATH = "worldql_server_tpu/delivery/worker.py"
+PLANE_PATH = "worldql_server_tpu/delivery/plane.py"
+
+
+def test_worker_unsafe_fires_on_asyncio_in_worker():
+    src = """
+    import asyncio
+
+    def worker_main():
+        loop = asyncio.new_event_loop()
+    """
+    assert violations(
+        src, relpath=WORKER_PATH, select="worker-unsafe-delivery"
+    ) == [("worker-unsafe-delivery", 2)]
+
+
+def test_worker_unsafe_fires_on_await_and_async_def_in_worker():
+    src = """
+    async def drain(peer):
+        await peer.flush()
+    """
+    fired = violations(
+        src, relpath=WORKER_PATH, select="worker-unsafe-delivery"
+    )
+    assert ("worker-unsafe-delivery", 2) in fired  # the async def
+
+
+def test_worker_unsafe_fires_on_peer_write_calls_in_worker():
+    src = """
+    def pump(peer, peers, frame):
+        peer.send(frame)
+        peers[0].try_write(frame)
+        self.peer_map.send_raw(frame)
+    """
+    assert [line for _, line in violations(
+        src, relpath=WORKER_PATH, select="worker-unsafe-delivery"
+    )] == [3, 5]  # subscript chains have no dotted name; attr chains do
+
+
+def test_worker_unsafe_quiet_on_socket_sends_in_worker():
+    src = """
+    import socket
+
+    def pump(sock, frame):
+        sock.send(frame)          # raw socket — the worker's JOB
+        sock.sendall(frame)
+        self.sock.send(frame)
+    """
+    assert violations(
+        src, relpath=WORKER_PATH, select="worker-unsafe-delivery"
+    ) == []
+
+
+def test_worker_unsafe_fires_on_pickle_in_ring_write_path():
+    src = """
+    import pickle
+
+    def submit(ring, frame, slots):
+        ring.try_write(pickle.dumps(frame), slots)
+    """
+    assert violations(
+        src, relpath=PLANE_PATH, select="worker-unsafe-delivery"
+    ) == [("worker-unsafe-delivery", 5)]
+
+
+def test_worker_unsafe_fires_on_deepcopy_in_ring_write_path():
+    src = """
+    import copy
+
+    def submit(ring, frame, slots):
+        ring.try_write(copy.deepcopy(frame), slots)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/delivery/ring.py",
+        select="worker-unsafe-delivery",
+    ) == [("worker-unsafe-delivery", 5)]
+
+
+def test_worker_unsafe_quiet_outside_delivery_modules():
+    src = """
+    import asyncio
+    import pickle
+
+    async def handler(peer, frame):
+        await peer.send(frame)
+        blob = pickle.dumps(frame)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/peers.py",
+        select="worker-unsafe-delivery",
+    ) == []
+
+
+def test_worker_unsafe_pragma_suppresses():
+    src = """
+    import pickle
+
+    def submit(ring, frame, slots):
+        blob = pickle.dumps(frame)  # wql: allow(worker-unsafe-delivery)
+        ring.try_write(blob, slots)
+    """
+    assert violations(
+        src, relpath=PLANE_PATH, select="worker-unsafe-delivery"
+    ) == []
+
+
+# endregion
+
 
 def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 11
+    assert len(names) >= 12
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -727,6 +837,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "store-on-loop",
         "unspanned-stage",
         "wire-mutable-buffer",
+        "worker-unsafe-delivery",
     }
 
 

@@ -1,0 +1,135 @@
+"""Unit tests for the delivery plane's shared-memory SPSC ring
+(worldql_server_tpu/delivery/ring.py): struct framing, wrap handling,
+full-ring refusal, and the create/attach cursor contract."""
+
+import os
+import struct
+
+import pytest
+
+from worldql_server_tpu.delivery.ring import (
+    RING_MIN_BYTES, Ring, _HDR, _REC,
+)
+
+
+@pytest.fixture
+def ring():
+    r = Ring.create(RING_MIN_BYTES)
+    yield r
+    r.close()
+    r.unlink()
+
+
+def slots_le(*slots):
+    return struct.pack(f"<{len(slots)}I", *slots)
+
+
+def test_roundtrip_single_record(ring):
+    assert ring.try_write(b"payload", slots_le(1, 2, 3))
+    frame, slots = ring.read()
+    assert frame == b"payload"
+    assert slots == [1, 2, 3]
+    assert ring.read() is None
+
+
+def test_empty_slot_list(ring):
+    assert ring.try_write(b"x", b"")
+    frame, slots = ring.read()
+    assert frame == b"x" and slots == []
+
+
+def test_attach_sees_creator_writes(ring):
+    other = Ring.attach(ring.name)
+    try:
+        # SharedMemory rounds the block to page size — the true cap
+        # must ride in-band, not be derived from the mapping size
+        assert other.cap == ring.cap
+        assert ring.try_write(b"cross-process", slots_le(7))
+        frame, slots = other.read()
+        assert frame == b"cross-process" and slots == [7]
+        # tail written by the attached side is visible to the creator
+        assert ring.pending_bytes() == 0
+    finally:
+        other.close()
+
+
+def test_full_ring_refuses_then_recovers(ring):
+    big = os.urandom(4096)
+    wrote = 0
+    while ring.try_write(big, slots_le(wrote)):
+        wrote += 1
+    assert wrote > 0
+    # full: the writer is refused, never blocked or corrupted
+    assert not ring.try_write(big, slots_le(999))
+    frame, slots = ring.read()
+    assert frame == big and slots == [0]
+    # space reclaimed → accepts again
+    assert ring.try_write(big, slots_le(999))
+    got = [ring.read()[1][0] for _ in range(wrote)]
+    assert got == list(range(1, wrote)) + [999]
+
+
+def test_wrap_preserves_record_order(ring):
+    """Mixed-size records over many ring cycles: every record comes
+    back intact and in order across wrap boundaries (including the
+    burned-remainder case where no WRAP header fits)."""
+    payloads = [os.urandom(n) for n in (1, 100, 1000, 7, 63, 64, 65, 4096)]
+    pending = []
+    seq = 0
+    for _ in range(5000):
+        p = payloads[seq % len(payloads)]
+        seq += 1
+        while not ring.try_write(p, slots_le(seq)):
+            exp_p, exp_s = pending.pop(0)
+            frame, slots = ring.read()
+            assert frame == exp_p and slots == [exp_s]
+        pending.append((p, seq))
+    while pending:
+        exp_p, exp_s = pending.pop(0)
+        frame, slots = ring.read()
+        assert frame == exp_p and slots == [exp_s]
+    assert ring.read() is None
+
+
+def test_oversized_record_detectable():
+    r = Ring.create(RING_MIN_BYTES)
+    try:
+        frame = b"x" * (r.cap * 2)
+        # the caller's guard: a record bigger than the ring can NEVER
+        # fit — record_size is the check plane.py drops on
+        assert Ring.record_size(len(frame), 1) > r.cap
+        assert not r.try_write(frame, slots_le(1))
+    finally:
+        r.close()
+        r.unlink()
+
+
+def test_capacity_rounds_to_pow2_with_floor():
+    r = Ring.create(1)
+    try:
+        assert r.cap == RING_MIN_BYTES  # floored
+        assert r.cap & (r.cap - 1) == 0
+    finally:
+        r.close()
+        r.unlink()
+
+
+def test_record_size_alignment():
+    # header + frame + slots, rounded to 8
+    assert Ring.record_size(0, 0) == (_REC.size + 7) & ~7
+    assert Ring.record_size(1, 1) % 8 == 0
+    assert Ring.record_size(9, 3) >= _REC.size + 9 + 12
+
+
+def test_header_reserved_region():
+    r = Ring.create(RING_MIN_BYTES)
+    try:
+        # data writes must never touch the header (cursor) region
+        assert r.try_write(b"A" * 64, slots_le(1))
+        head = struct.unpack_from("<Q", r.buf, 0)[0]
+        assert head == Ring.record_size(64, 1)
+        assert struct.unpack_from("<Q", r.buf, 16)[0] == r.cap
+        assert _HDR >= 24
+    finally:
+        r.close()
+        r.unlink()

@@ -136,11 +136,14 @@ def enclosing_functions(tree: ast.Module):
 
 
 def all_rules() -> list[Rule]:
-    from . import rules_async, rules_jax, rules_obs, rules_store, rules_wire
+    from . import (
+        rules_async, rules_delivery, rules_jax, rules_obs, rules_store,
+        rules_wire,
+    )
 
     return [
-        *rules_async.RULES, *rules_jax.RULES, *rules_obs.RULES,
-        *rules_store.RULES, *rules_wire.RULES,
+        *rules_async.RULES, *rules_delivery.RULES, *rules_jax.RULES,
+        *rules_obs.RULES, *rules_store.RULES, *rules_wire.RULES,
     ]
 
 

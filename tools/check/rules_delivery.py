@@ -1,0 +1,116 @@
+"""Delivery-plane safety rules (ISSUE 6).
+
+The sender workers (``worldql_server_tpu/delivery/worker.py``) are
+plain synchronous processes by DESIGN: they own raw sockets, never the
+event loop, and never the parent's ``Peer`` objects — a worker that
+awaits, spins up asyncio, or calls a peer's transport write path has
+silently re-serialized delivery onto one interpreter (the exact GIL
+ceiling the plane exists to break), or worse, is touching loop-owned
+state from another process's pickle of it.
+
+The ring write path (``delivery/ring.py`` + ``delivery/plane.py``) has
+its own invariant: frames cross the process boundary as raw struct
+records in shared memory. A ``pickle.dumps``/``marshal``/``copy``
+creeping into that path reintroduces a per-frame serialization (the
+multiprocessing.Queue shape this design replaced — ~10x the cost and
+unbounded memory under backlog).
+
+One rule, two scopes:
+
+* worker modules: flag ``asyncio``/``await``/``async def`` usage and
+  any ``.send``/``.send_raw``/``.try_write``/``.try_write_many`` call
+  on a name containing ``peer`` (workers speak to SOCKETS, the parent
+  speaks to peers);
+* ring-write modules: flag ``pickle.*``/``marshal.*``/``copy.copy``/
+  ``copy.deepcopy`` calls.
+
+Suppress a deliberate use with ``# wql: allow(worker-unsafe-delivery)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name
+
+#: worker-side modules: no event loop, no Peer write paths
+_WORKER_SCOPED = ("delivery/worker.py",)
+#: ring write path: no per-frame pickling/copying
+_RING_SCOPED = (
+    "delivery/ring.py", "delivery/worker.py", "delivery/plane.py",
+)
+
+_PEER_WRITE_METHODS = ("send", "send_raw", "try_write", "try_write_many")
+_SERIALIZER_CALLS = (
+    "pickle.dumps", "pickle.loads", "pickle.dump", "pickle.load",
+    "marshal.dumps", "marshal.loads", "copy.copy", "copy.deepcopy",
+)
+
+
+def _check_worker_unsafe(ctx: FileContext) -> Iterator[Violation]:
+    worker_scope = ctx.relpath.endswith(_WORKER_SCOPED)
+    ring_scope = ctx.relpath.endswith(_RING_SCOPED)
+    if not (worker_scope or ring_scope):
+        return
+    for node in ast.walk(ctx.tree):
+        if worker_scope:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                modules = (
+                    [a.name for a in node.names]
+                    if isinstance(node, ast.Import)
+                    else [node.module or ""]
+                )
+                for mod in modules:
+                    if mod.split(".")[0] == "asyncio":
+                        yield from ctx.flag(
+                            WORKER_UNSAFE_DELIVERY, node,
+                            "asyncio imported in a sender-worker module "
+                            "— workers are synchronous processes; event-"
+                            "loop machinery belongs in delivery/plane.py",
+                        )
+            elif isinstance(node, (ast.Await, ast.AsyncFunctionDef,
+                                   ast.AsyncFor, ast.AsyncWith)):
+                yield from ctx.flag(
+                    WORKER_UNSAFE_DELIVERY, node,
+                    "await/async in a sender-worker module — the worker "
+                    "hot loop must stay a plain synchronous process (no "
+                    "event loop to starve, nothing to re-serialize "
+                    "delivery onto one interpreter)",
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is not None:
+                    parts = name.split(".")
+                    if (
+                        parts[-1] in _PEER_WRITE_METHODS
+                        and any("peer" in p.lower() for p in parts[:-1])
+                    ):
+                        yield from ctx.flag(
+                            WORKER_UNSAFE_DELIVERY, node,
+                            f"`{name}(...)` in a sender-worker module — "
+                            "Peer write paths are parent/event-loop "
+                            "objects; workers write to the raw sockets "
+                            "they own",
+                        )
+        if ring_scope and isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in _SERIALIZER_CALLS:
+                yield from ctx.flag(
+                    WORKER_UNSAFE_DELIVERY, node,
+                    f"`{name}(...)` on the delivery ring write path — "
+                    "frames cross the process boundary as raw struct "
+                    "records (ring.py framing); a per-frame pickle/copy "
+                    "reintroduces the multiprocessing.Queue cost this "
+                    "design replaced",
+                )
+
+
+WORKER_UNSAFE_DELIVERY = Rule(
+    "worker-unsafe-delivery",
+    "sender-worker modules must stay synchronous and socket-only; the "
+    "ring write path must stay pickle/copy-free",
+    _check_worker_unsafe,
+)
+
+RULES = [WORKER_UNSAFE_DELIVERY]

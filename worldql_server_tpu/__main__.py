@@ -95,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-message-size", type=int,
                    help="inbound wire-message byte cap, both transports "
                         "(default 8 MiB)")
+    p.add_argument("--delivery-workers", type=int, dest="delivery_workers",
+                   help="sender worker processes for the sharded "
+                        "delivery plane: frames pump through per-worker "
+                        "shared-memory rings to processes owning "
+                        "disjoint socket shards; 0 (default) = the "
+                        "single-process in-process pump")
+    p.add_argument("--delivery-ring-bytes", type=int,
+                   dest="delivery_ring_bytes",
+                   help="per-worker fan-out ring capacity in bytes "
+                        "(default 4 MiB; rounded up to a power of two)")
     p.add_argument("--failpoints",
                    help="arm fault-injection failpoints, e.g. "
                         "'store.insert=error:0.2,wal.fsync=delay:5ms' "
@@ -151,7 +161,7 @@ _OVERRIDES = [
     "tick_pipeline", "mesh_batch", "mesh_space", "index_snapshot",
     "max_message_size",
     "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
-    "checkpoint_interval",
+    "checkpoint_interval", "delivery_workers", "delivery_ring_bytes",
     "failpoints", "failpoints_seed", "resilience", "failover_after",
     "supervisor_budget", "supervisor_backoff",
     "slow_tick_ms", "flight_recorder_depth", "slow_tick_dir",

@@ -1,0 +1,330 @@
+"""Sender worker process: one shard of the delivery plane.
+
+Each worker is a plain SYNCHRONOUS process — no asyncio, no event
+loop, no Peer objects (the ``worker-unsafe-delivery`` lint rule keeps
+it that way): it drains its shared-memory ring of
+``(frame_bytes, slot_ids)`` records and pushes frames out of the
+sockets it OWNS —
+
+* WebSocket peers arrive as raw TCP fds passed over the control
+  channel at handshake (``socket.recv_fds``); the worker writes
+  complete server→client frames (``ws_framing``) non-blocking with a
+  bounded per-socket backlog, mirroring the parent's
+  ``_WRITE_HARD_LIMIT`` eviction semantics.
+* ZeroMQ peers arrive as connect-back endpoints; the worker connects
+  its OWN ``PUSH`` socket (sends never touch the parent's context).
+
+The worker never decides membership: a failed/overflowing peer is
+closed locally and REPORTED (``{"op": "fail"}``) — the parent's
+authoritative PeerMap performs the eviction, so ``on_peer_removed``
+and staleness semantics are identical to single-process mode.
+
+Control channel: one ``AF_UNIX`` ``SOCK_SEQPACKET`` connection (packet
+boundaries preserved, fd passing supported). JSON packets both ways —
+control is not the hot path; the hot path is the pickle-free ring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import socket
+import time
+
+from .ring import Ring
+from ..transports.ws_framing import ws_binary_frame
+
+#: per-socket outbound backlog bound — a consumer that lets this much
+#: buffer is dead-or-pathological and is evicted (same constant and
+#: rationale as transports/websocket.py _WRITE_HARD_LIMIT)
+PENDING_HARD_LIMIT = 8 << 20
+
+#: worker→parent cumulative-stats cadence (seconds)
+STATS_INTERVAL = 0.25
+
+
+class _WsSink:
+    """One handed-off WebSocket TCP socket: non-blocking whole-frame
+    writes with an ordered backlog for partial sends."""
+
+    kind = "ws"
+    __slots__ = ("sock", "pending", "pending_bytes")
+
+    def __init__(self, fd: int):
+        self.sock = socket.socket(fileno=fd)
+        self.sock.setblocking(False)
+        self.pending: list[memoryview] = []
+        self.pending_bytes = 0
+
+    def send(self, frame: bytes) -> str:
+        if self.pending:
+            # order over speed: never bypass the backlog
+            self.pending.append(memoryview(frame))
+            self.pending_bytes += len(frame)
+            if self.pending_bytes > PENDING_HARD_LIMIT:
+                return "overflow"
+            return "ok"
+        try:
+            n = self.sock.send(frame)
+        except (BlockingIOError, InterruptedError):
+            n = 0
+        except OSError:
+            return "fail"
+        if n < len(frame):
+            self.pending.append(memoryview(frame)[n:])
+            self.pending_bytes += len(frame) - n
+        return "ok"
+
+    def flush(self) -> str:
+        while self.pending:
+            mv = self.pending[0]
+            try:
+                n = self.sock.send(mv)
+            except (BlockingIOError, InterruptedError):
+                return "ok"
+            except OSError:
+                return "fail"
+            self.pending_bytes -= n
+            if n == len(mv):
+                self.pending.pop(0)
+            else:
+                self.pending[0] = mv[n:]
+                return "ok"
+        return "ok"
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _ZmqSink:
+    """One worker-owned connect-back PUSH socket (outgoing.rs:95-118
+    ownership moved into the shard)."""
+
+    kind = "zmq"
+    __slots__ = ("sock",)
+
+    def __init__(self, ctx, endpoint: str):
+        import zmq
+
+        self.sock = ctx.socket(zmq.PUSH)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        # deep HWM: the reference's relay channel is unbounded below
+        # failure; hitting this is treated as a failed send (evict)
+        self.sock.setsockopt(zmq.SNDHWM, 65536)
+        self.sock.connect(endpoint)
+
+    def send(self, payload: bytes) -> str:
+        import zmq
+
+        try:
+            self.sock.send(payload, zmq.NOBLOCK)
+        except zmq.Again:
+            return "overflow"
+        except Exception:
+            return "fail"
+        return "ok"
+
+    def flush(self) -> str:
+        return "ok"
+
+    def close(self) -> None:
+        try:
+            self.sock.close(linger=0)
+        except Exception:
+            pass
+
+
+def _ctl_send(ctl: socket.socket, msg: dict, critical: bool = True) -> None:
+    """One control packet to the parent. Stats packets are best-effort
+    (a full buffer drops the sample); fail/ready packets retry briefly
+    — losing one would leak a dead peer from the map until the
+    staleness sweep."""
+    data = json.dumps(msg).encode()
+    deadline = time.monotonic() + (1.0 if critical else 0.0)
+    while True:
+        try:
+            ctl.send(data)
+            return
+        except (BlockingIOError, InterruptedError):
+            if time.monotonic() >= deadline:
+                return
+            select.select([], [ctl], [], 0.01)
+        except OSError:
+            return
+
+
+def worker_main(worker_id: int, control_path: str, ring_name: str) -> None:
+    """Process entry (multiprocessing spawn target)."""
+    # the parent owns lifecycle: SIGINT storms (Ctrl-C to the group)
+    # must not kill a worker mid-drain; SIGTERM requests a clean stop
+    stopping = {"flag": False}
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    signal.signal(signal.SIGTERM, lambda *_: stopping.__setitem__("flag", True))
+
+    ctl = socket.socket(socket.AF_UNIX, socket.SOCK_SEQPACKET)
+    ctl.connect(control_path)
+    ctl.setblocking(False)
+    ring = Ring.attach(ring_name)
+    sinks: dict[int, object] = {}
+    zmq_ctx = None
+    stats = {
+        "records": 0,      # ring records consumed
+        "deliveries": 0,   # frame×peer sends attempted
+        "sends_ok": 0,
+        "send_errors": 0,
+        "bytes": 0,
+        "evictions": 0,    # peers this worker reported as failed
+        "drain_ms": 0.0,   # wall of the last non-empty drain burst
+    }
+    _ctl_send(ctl, {"op": "ready", "pid": os.getpid(), "worker": worker_id})
+    last_stats = time.monotonic()
+
+    def drop_sink(slot: int, reason: str) -> None:
+        sink = sinks.pop(slot, None)
+        if sink is not None:
+            sink.close()
+        stats["evictions"] += 1
+        _ctl_send(ctl, {"op": "fail", "slot": slot, "reason": reason})
+
+    def handle_control(data: bytes, fds: list[int]) -> bool:
+        """One parent packet; False = stop requested."""
+        nonlocal zmq_ctx
+        try:
+            msg = json.loads(data)
+        except ValueError:
+            return True
+        op = msg.get("op")
+        if op == "add":
+            slot = msg["slot"]
+            try:
+                if msg["kind"] == "ws" and fds:
+                    sinks[slot] = _WsSink(fds[0])
+                    fds.clear()  # consumed
+                elif msg["kind"] == "zmq":
+                    if zmq_ctx is None:
+                        import zmq
+
+                        zmq_ctx = zmq.Context()
+                    sinks[slot] = _ZmqSink(zmq_ctx, msg["endpoint"])
+            except Exception:
+                # an unconnectable sink is a failed peer, not a dead
+                # worker: report it and keep the shard serving
+                stats["evictions"] += 1
+                _ctl_send(
+                    ctl, {"op": "fail", "slot": slot,
+                          "reason": "send_failed"},
+                )
+        elif op == "remove":
+            sink = sinks.pop(msg["slot"], None)
+            if sink is not None:
+                sink.close()
+        elif op == "stop":
+            return False
+        return True
+
+    try:
+        while True:
+            progressed = False
+            # 1. drain the ring (bounded burst keeps control responsive)
+            t0 = time.perf_counter()
+            for _ in range(512):
+                rec = ring.read()
+                if rec is None:
+                    break
+                progressed = True
+                frame, slots = rec
+                stats["records"] += 1
+                ws_frame = None
+                for slot in slots:
+                    sink = sinks.get(slot)
+                    if sink is None:
+                        continue  # removed while the record was in flight
+                    stats["deliveries"] += 1
+                    if sink.kind == "ws":
+                        if ws_frame is None:
+                            # framed ONCE per record, shared by every
+                            # WS recipient in the slot list
+                            ws_frame = ws_binary_frame(frame)
+                        status = sink.send(ws_frame)
+                        stats["bytes"] += len(ws_frame)
+                    else:
+                        status = sink.send(frame)
+                        stats["bytes"] += len(frame)
+                    if status == "ok":
+                        stats["sends_ok"] += 1
+                    else:
+                        stats["send_errors"] += 1
+                        drop_sink(
+                            slot,
+                            "overflow" if status == "overflow"
+                            else "send_failed",
+                        )
+            if progressed:
+                stats["drain_ms"] = (time.perf_counter() - t0) * 1e3
+            # 2. flush partial-write backlogs
+            for slot, sink in list(sinks.items()):
+                if sink.kind == "ws" and sink.pending:
+                    if sink.flush() == "fail":
+                        stats["send_errors"] += 1
+                        drop_sink(slot, "send_failed")
+            # 3. control packets
+            stop_req = stopping["flag"]
+            while True:
+                try:
+                    data, fds, _flags, _addr = socket.recv_fds(ctl, 65536, 8)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    data, fds = b"", []
+                if not data:
+                    return  # parent gone — nothing left to serve
+                if not handle_control(data, list(fds)):
+                    stop_req = True
+            # 4. periodic cumulative stats
+            now = time.monotonic()
+            if now - last_stats >= STATS_INTERVAL:
+                last_stats = now
+                _ctl_send(
+                    ctl,
+                    {"op": "stats", "worker": worker_id, "peers": len(sinks),
+                     "ring_pending": ring.pending_bytes(), **stats},
+                    critical=False,
+                )
+            if stop_req:
+                stopping["flag"] = True
+                # stop once the ring is drained and backlogs flushed
+                # (bounded below by the parent's join timeout)
+                if ring.pending_bytes() == 0 and not any(
+                    s.kind == "ws" and s.pending for s in sinks.values()
+                ):
+                    break
+                continue
+            # 5. idle wait: the ring is empty — sleep on control
+            # traffic / writability instead of spinning
+            if not progressed:
+                wlist = [
+                    s.sock for s in sinks.values()
+                    if s.kind == "ws" and s.pending
+                ]
+                try:
+                    select.select([ctl], wlist, [], 0.002)
+                except OSError:
+                    pass
+    finally:
+        _ctl_send(
+            ctl,
+            {"op": "stats", "worker": worker_id, "peers": len(sinks),
+             "ring_pending": ring.pending_bytes(), **stats},
+            critical=False,
+        )
+        for sink in sinks.values():
+            sink.close()
+        if zmq_ctx is not None:
+            zmq_ctx.term()
+        ring.close()
+        ctl.close()

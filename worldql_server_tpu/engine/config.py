@@ -167,6 +167,24 @@ class Config:
     checkpoint_interval: float = field(
         default_factory=lambda: float(_env("WQL_CHECKPOINT_INTERVAL", "60"))
     )
+    # Multi-core delivery plane (worldql_server_tpu/delivery): shard
+    # outbound fan-out across this many sender WORKER PROCESSES, each
+    # draining a shared-memory ring of serialized frames and owning a
+    # disjoint slice of the live sockets (WS via fd handoff at
+    # handshake, ZMQ via worker-connected PUSH). 0 (the default) keeps
+    # the single-process in-process pump byte-for-byte.
+    delivery_workers: int = field(
+        default_factory=lambda: int(_env("WQL_DELIVERY_WORKERS", "0"))
+    )
+    # Per-worker fan-out ring capacity in bytes (rounded up to a power
+    # of two). Sizing rule of thumb: >= one tick's worth of frames per
+    # shard at peak — a full ring degrades (bounded wait then drop,
+    # counted in delivery.ring_full_drops), it never wedges the tick.
+    delivery_ring_bytes: int = field(
+        default_factory=lambda: int(
+            _env("WQL_DELIVERY_RING_BYTES", str(4 * 1024 * 1024))
+        )
+    )
     # Fault-injection failpoints (robustness/failpoints.py): a spec
     # like "store.insert=error:0.2,wal.fsync=delay:5ms" arms named
     # failure sites process-wide. Empty (the default) arms nothing and
@@ -298,6 +316,15 @@ class Config:
             errors.append("tick_interval must be >= 0")
         if self.tick_pipeline < 1:
             errors.append("tick_pipeline must be >= 1 (1 = no overlap)")
+        if self.delivery_workers < 0:
+            errors.append("delivery_workers must be >= 0 (0 = in-process)")
+        if self.delivery_workers:
+            from ..delivery.ring import RING_MIN_BYTES
+
+            if self.delivery_ring_bytes < RING_MIN_BYTES:
+                errors.append(
+                    f"delivery_ring_bytes must be >= {RING_MIN_BYTES}"
+                )
         if self.durability not in ("off", "wal", "sync"):
             errors.append("durability must be 'off', 'wal' or 'sync'")
         elif self.durability != "off" and not self.wal_dir:

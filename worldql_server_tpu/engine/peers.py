@@ -63,7 +63,7 @@ class Peer:
 
     __slots__ = ("uuid", "addr", "kind", "_send_raw", "_try_write",
                  "_try_write_many", "tracks_heartbeat", "last_heartbeat",
-                 "closed")
+                 "closed", "shard", "slot")
 
     def __init__(
         self,
@@ -84,6 +84,13 @@ class Peer:
         self.tracks_heartbeat = tracks_heartbeat
         self.last_heartbeat = time.monotonic()
         self.closed = False
+        # Delivery-plane ownership (delivery/plane.py adopt): the
+        # sender-worker shard and per-shard socket slot this peer's
+        # frames route to. None = parent-owned (single-process mode,
+        # or degraded fallback) — the write paths above are then the
+        # transport's own.
+        self.shard: int | None = None
+        self.slot: int | None = None
 
     def update_last_heartbeat(self) -> None:
         self.last_heartbeat = time.monotonic()
@@ -135,10 +142,17 @@ class PeerMap:
     when a peer disconnects.
     """
 
-    def __init__(self, on_remove: OnRemove | None = None, metrics=None):
+    def __init__(self, on_remove: OnRemove | None = None, metrics=None,
+                 plane=None):
         self._map: dict[uuid_mod.UUID, Peer] = {}
         self._on_remove = on_remove
         self.metrics = metrics
+        # Optional delivery plane (delivery/plane.py): when present,
+        # deliver_batch groups worker-owned targets per shard and
+        # writes each frame ONCE per shard ring; parent-owned peers
+        # (and the whole map when plane is None — the default) take
+        # the byte-for-byte in-process path below.
+        self._plane = plane
 
     # region: lookups
 
@@ -244,6 +258,68 @@ class PeerMap:
         Peers whose transport can't take the sync write (saturated, or
         no fast path) fall back to awaited sends in one gather at the
         end. Returns the number of sends attempted."""
+        if self._plane is not None:
+            return await self._deliver_batch_planed(pairs)
+        return await self._deliver_batch_local(pairs)
+
+    async def _deliver_batch_planed(
+        self,
+        pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+    ) -> int:
+        """Sharded delivery (delivery plane enabled): each message's
+        wire bytes are written ONCE into every owning shard's ring with
+        the full slot list — no per-peer framing, no per-frame pickling
+        — and the worker processes fan out from there. Targets not
+        adopted by a worker (degraded shards, exotic transports) drain
+        through the unchanged in-process path afterwards, preserving
+        per-peer arrival order within this batch."""
+        from array import array
+
+        plane = self._plane
+        worker_sends = n_msgs = 0
+        local_pairs: list[tuple[Message, list[uuid_mod.UUID]]] = []
+        with plane.tracer.span("delivery.fanout") as span:
+            for message, uuids in pairs:
+                n_msgs += 1
+                data = message.wire
+                if data is None:
+                    data = serialize_message(message)
+                groups: dict[int, tuple[bytes, array]] = {}
+                local_targets: list[uuid_mod.UUID] = []
+                for u in uuids:
+                    p = self._map.get(u)
+                    if p is None:
+                        continue
+                    if p.shard is not None:
+                        group = groups.get(p.shard)
+                        if group is None:
+                            groups[p.shard] = (data, array("I", (p.slot,)))
+                        else:
+                            group[1].append(p.slot)
+                    else:
+                        local_targets.append(u)
+                if groups:
+                    worker_sends += await plane.deliver(groups)
+                if local_targets:
+                    local_pairs.append((message, local_targets))
+            span.tag(messages=n_msgs, worker_sends=worker_sends)
+        n = worker_sends
+        if local_pairs:
+            # counts its own broadcast.messages/sends for these pairs
+            n += await self._deliver_batch_local(local_pairs)
+        if self.metrics is not None:
+            if n_msgs > len(local_pairs):
+                self.metrics.inc(
+                    "broadcast.messages", n_msgs - len(local_pairs)
+                )
+            if worker_sends:
+                self.metrics.inc("broadcast.sends", worker_sends)
+        return n
+
+    async def _deliver_batch_local(
+        self,
+        pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+    ) -> int:
         outbox: dict[Peer, list[FramedPayload]] = {}
         n = n_msgs = 0
         for message, uuids in pairs:

@@ -124,8 +124,25 @@ class WorldQLServer:
             backoff_base=config.supervisor_backoff,
             budget=config.supervisor_budget,
         )
+        # Multi-core delivery plane (delivery/plane.py): sender worker
+        # processes owning disjoint socket shards, fed by per-worker
+        # shared-memory rings. None with --delivery-workers 0 (the
+        # default) — the PeerMap then takes its unchanged in-process
+        # path and no plane machinery is constructed.
+        self.delivery_plane = None
+        if config.delivery_workers > 0:
+            from ..delivery import DeliveryPlane
+
+            self.delivery_plane = DeliveryPlane(
+                config,
+                metrics=self.metrics,
+                tracer=self.tracer,
+                on_peer_lost=self._on_delivery_peer_lost,
+            )
+        self._delivery_evictions: set = set()
         self.peer_map = PeerMap(
-            on_remove=self._on_peer_remove, metrics=self.metrics
+            on_remove=self._on_peer_remove, metrics=self.metrics,
+            plane=self.delivery_plane,
         )
         self.ticker = None
         if config.tick_interval > 0:
@@ -207,6 +224,17 @@ class WorldQLServer:
         self.metrics.gauge(
             "failpoints", failpoints.registry.fired_counts
         )
+        if self.delivery_plane is not None:
+            # aggregate + per-worker delivery counters: the workers'
+            # cumulative stats ride the control channel into these
+            # gauges (and diff into delivery.* counters), so /metrics
+            # exposes the whole plane from the parent
+            self.metrics.gauge("delivery", self.delivery_plane.stats)
+            for i in range(self.config.delivery_workers):
+                self.metrics.gauge(
+                    f"delivery.worker.{i}",
+                    lambda i=i: self.delivery_plane.worker_stats(i),
+                )
         if self.recorder is not None:
             self.metrics.gauge("flight_recorder", self.recorder.stats)
         if self.loop_monitor is not None:
@@ -236,6 +264,15 @@ class WorldQLServer:
         self.metrics.inc("server.escalations")
         self.shutdown_requested.set()
 
+    def delivery_status(self) -> dict | None:
+        """Delivery-plane state for /healthz (worker liveness, restart
+        and drop counts); None with --delivery-workers 0."""
+        if self.delivery_plane is None:
+            return None
+        status = self.delivery_plane.stats()
+        status["degraded"] = self.delivery_plane.degraded()
+        return status
+
     def durability_status(self) -> dict | None:
         """Queue depth, WAL state, and last recovery for /healthz and
         the ``durability`` gauge; None when durability is off."""
@@ -250,10 +287,27 @@ class WorldQLServer:
         """Disconnect cleanup: purge the spatial index (the remove_rx
         path, thread.rs:124-126) and let transports drop socket state."""
         self.backend.remove_peer(uuid)
+        if self.delivery_plane is not None:
+            # worker-owned socket: the owning shard closes its end
+            self.delivery_plane.release(uuid)
         for transport in self._transports:
             hook = getattr(transport, "on_peer_removed", None)
             if hook is not None:
                 hook(uuid)
+
+    def _on_delivery_peer_lost(self, uuid, reason: str) -> None:
+        """Delivery-plane eviction hook: a sender worker reported a
+        failed/overflowing peer, or died with peers on its shard. The
+        PARENT stays authoritative — eviction goes through the normal
+        ``PeerMap.remove`` (PeerDisconnect broadcast, removal hooks,
+        ``peers.evicted_*`` accounting), exactly like the in-process
+        failed-send path."""
+        self.metrics.inc(f"peers.evicted_{reason}")
+        task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
+            self.peer_map.remove(uuid)
+        )
+        self._delivery_evictions.add(task)
+        task.add_done_callback(self._delivery_evictions.discard)
 
     async def start(self) -> None:
         """Bring up the store and all enabled transports (main.rs:106-207)."""
@@ -279,6 +333,11 @@ class WorldQLServer:
             # lag samples must never take the broker down
             self.loop_monitor.install()
             self.supervisor.spawn("loop-monitor", self.loop_monitor.run)
+
+        if self.delivery_plane is not None:
+            # before any transport: workers must be ready to adopt the
+            # first handshake
+            await self.delivery_plane.start()
 
         if self.config.ws_enabled:
             from ..transports.websocket import WebSocketTransport
@@ -496,6 +555,12 @@ class WorldQLServer:
         for transport in reversed(self._transports):
             await transport.stop()
         self._transports.clear()
+        if self.delivery_plane is not None:
+            # after the ticker drain (frames are already in the rings)
+            # and transport teardown: workers own their sockets
+            # independently, so they flush their rings to the clients
+            # and exit clean
+            await self.delivery_plane.stop()
         if self.durability is not None:
             # Drain the write-behind queue, then truncate the WAL only
             # on a CLEAN drain with no batch ever dropped — a wedged

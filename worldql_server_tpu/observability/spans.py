@@ -55,6 +55,11 @@ class Span:
         self.tags = tags
         self.thread = thread
 
+    def tag(self, **tags) -> None:
+        """Late tags (values known only at stage end) — same surface
+        as ``_NullSpan.tag`` so callers never branch on enablement."""
+        self.tags.update(tags)
+
     def as_dict(self, perf_start: float) -> dict:
         return {
             "id": self.id,

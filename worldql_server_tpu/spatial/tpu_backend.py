@@ -94,8 +94,13 @@ PROBE_E = 8
 #: correctness never depends on the table fitting (oflow routes the
 #: segment to binary search)
 PROBE_MAX_BUCKETS = 1 << 21
-#: seed folding the bucket hash away from both key hash families
-_PROBE_SEED = jnp.uint64(0xA0761D6478BD642F)
+#: seed folding the bucket hash away from both key hash families.
+#: np.uint64, NOT jnp: a module-level jnp scalar executes a device
+#: computation at import time, which breaks jax.distributed.initialize
+#: ("must be called before any JAX computations") for every process
+#: that imports the backend before joining the runtime — the exact
+#: boot order of a multi-host server (parallel/mesh.py).
+_PROBE_SEED = np.uint64(0xA0761D6478BD642F)
 
 SEG_ARRAYS = 6  # (key, key2, peer, run_rem, tbl, oflow)
 

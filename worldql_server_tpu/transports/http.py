@@ -97,6 +97,16 @@ class HttpTransport:
             body["resilience"] = resilience
             if resilience["degraded"]:
                 body["status"] = "degraded"
+        # Delivery-plane state (worker liveness + drop counters): a
+        # retired or dead sender worker is a capacity loss the
+        # orchestrator should see without scraping /metrics. Absent
+        # with --delivery-workers 0 (reference-shaped body).
+        dlv_fn = getattr(self.server, "delivery_status", None)
+        delivery = dlv_fn() if dlv_fn is not None else None
+        if delivery is not None:
+            body["delivery"] = delivery
+            if delivery["degraded"]:
+                body["status"] = "degraded"
         # Flight-recorder state (slow-tick count front and center): an
         # operator probing a limping node sees HOW MANY ticks blew the
         # threshold before scraping anything. Absent when tracing is

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import struct
 import uuid as uuid_mod
 
 from websockets.asyncio.server import serve
@@ -33,6 +32,7 @@ from ..protocol import (
 from ..engine.peers import FramedPayload, Peer
 from ..robustness import failpoints
 from ..robustness.failpoints import FailpointError
+from .ws_framing import ws_binary_frame
 
 logger = logging.getLogger(__name__)
 
@@ -47,16 +47,9 @@ logger = logging.getLogger(__name__)
 _WRITE_HARD_LIMIT = 8 << 20
 
 
-def ws_binary_frame(payload: bytes) -> bytes:
-    """A complete server→client binary frame (FIN, unmasked — RFC 6455
-    §5.2; servers MUST NOT mask). Identical bytes for every recipient,
-    which is what lets a broadcast frame once for all targets."""
-    n = len(payload)
-    if n < 126:
-        return struct.pack(">BB", 0x82, n) + payload
-    if n < 1 << 16:
-        return struct.pack(">BBH", 0x82, 126, n) + payload
-    return struct.pack(">BBQ", 0x82, 127, n) + payload
+# ws_binary_frame moved to transports/ws_framing.py (dependency-free
+# so delivery workers can frame without the websockets import); the
+# re-export above keeps this module's historical import surface.
 
 
 class WebSocketTransport:
@@ -66,6 +59,10 @@ class WebSocketTransport:
         # strong refs to eviction tasks: the loop keeps only weak ones,
         # and a GC'd task would silently skip the peer_map removal
         self._evictions: set = set()
+        # uuid → connection for peers handed off to delivery workers:
+        # on_peer_removed aborts the parent-side connection (the worker
+        # owns the write half; the parent only reads)
+        self._handed_off: dict = {}
 
     async def start(self) -> None:
         config = self.server.config
@@ -73,12 +70,20 @@ class WebSocketTransport:
         # below (uncompressed frames are always legal, but negotiating
         # deflate would buy nothing and cost per-frame state), and
         # FlatBuffers payloads don't compress usefully anyway
+        extra = {}
+        if getattr(self.server, "delivery_plane", None) is not None:
+            # worker-owned writes: the parent must never interleave
+            # bytes on a handed-off socket, so the library's keepalive
+            # pings are disabled — liveness is the read half (stream
+            # EOF), same as a plain WS peer's
+            extra["ping_interval"] = None
         self._ws_server = await serve(
             self._handle_connection,
             config.ws_host,
             config.ws_port,
             max_size=config.max_message_size,
             compression=None,
+            **extra,
         )
         logger.info(
             "WebSocket server listening on %s:%s", config.ws_host, config.ws_port
@@ -183,6 +188,24 @@ class WebSocketTransport:
                 try_write=try_write,
                 try_write_many=try_write_many,
             )
+            # Delivery-plane handoff (delivery/plane.py): pass the raw
+            # TCP fd to a sender worker, which owns ALL writes from
+            # here (adopt rebinds the peer's write paths onto its
+            # ring). Safe at this point in the handshake: the client's
+            # echo frame above proves our Handshake bytes already
+            # reached it, so the parent's write buffer is empty and
+            # nothing else has been queued (the peer is not yet in the
+            # map, so no broadcast has targeted it). The parent keeps
+            # the READ half — inbound frames still flow through this
+            # loop. Degraded plane (no live worker) falls back to the
+            # parent-owned fast path above.
+            plane = getattr(self.server, "delivery_plane", None)
+            if plane is not None:
+                raw_sock = connection.transport.get_extra_info("socket")
+                if raw_sock is not None and plane.adopt(
+                    peer, fd=raw_sock.fileno()
+                ):
+                    self._handed_off[peer_uuid] = connection
             await self.server.peer_map.insert(peer)
             registered = True
 
@@ -218,8 +241,19 @@ class WebSocketTransport:
         except Exception:
             logger.exception("websocket connection error: %s", addr)
         finally:
+            self._handed_off.pop(peer_uuid, None)
             if registered:
                 await self.server.peer_map.remove(peer_uuid)
+
+    def on_peer_removed(self, peer_uuid: uuid_mod.UUID) -> None:
+        """PeerMap removal hook: for a peer handed off to a delivery
+        worker, abort the parent-side connection (no close frame — the
+        worker owns the write half and closes its fd on the shard's
+        ``remove``; a library close here could interleave bytes
+        mid-frame). The recv loop's finally does the map removal."""
+        connection = self._handed_off.pop(peer_uuid, None)
+        if connection is not None and connection.transport is not None:
+            connection.transport.abort()
 
     async def _next_message(
         self,

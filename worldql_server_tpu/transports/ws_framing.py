@@ -1,0 +1,23 @@
+"""Server→client WebSocket frame assembly — dependency-free.
+
+Split out of ``transports/websocket.py`` so the delivery-plane sender
+workers (worldql_server_tpu/delivery/worker.py) can frame WS payloads
+without importing the ``websockets`` library (absent in minimal
+containers) or any of the parent's asyncio transport machinery.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+def ws_binary_frame(payload: bytes) -> bytes:
+    """A complete server→client binary frame (FIN, unmasked — RFC 6455
+    §5.2; servers MUST NOT mask). Identical bytes for every recipient,
+    which is what lets a broadcast frame once for all targets."""
+    n = len(payload)
+    if n < 126:
+        return struct.pack(">BB", 0x82, n) + payload
+    if n < 1 << 16:
+        return struct.pack(">BBH", 0x82, 126, n) + payload
+    return struct.pack(">BBQ", 0x82, 127, n) + payload

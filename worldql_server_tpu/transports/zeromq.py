@@ -213,7 +213,6 @@ class ZmqTransport:
         )
 
         peer_uuid = message.sender_uuid
-        self._push_sockets[peer_uuid] = push
 
         async def send_raw(data: bytes) -> None:
             sock = self._push_sockets.get(peer_uuid)
@@ -240,6 +239,18 @@ class ZmqTransport:
             kind="zeromq",
             tracks_heartbeat=True,
         )
+        plane = getattr(self.server, "delivery_plane", None)
+        adopted = plane is not None and plane.adopt(peer, endpoint=endpoint)
+        if adopted:
+            # the owning sender worker connects its OWN PUSH to the
+            # peer's PULL; the parent's echo socket closes once the
+            # handshake echo flushes (bounded linger) — from here on
+            # every frame for this peer rides the worker's shard
+            push.close(linger=2000)
+        else:
+            # single-process mode (or degraded plane): the parent owns
+            # the socket, reference semantics unchanged
+            self._push_sockets[peer_uuid] = push
         await self.server.peer_map.insert(peer)
 
     def _drop_socket(self, peer_uuid: uuid_mod.UUID) -> None:
