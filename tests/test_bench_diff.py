@@ -1,0 +1,69 @@
+"""tools/bench_diff.py: record loading, direction scoring, regression
+flagging — the groundwork for a CI perf gate."""
+
+import json
+
+from tools.bench_diff import diff, direction, flatten, load_records, main
+
+
+def test_flatten_numeric_leaves_dotted():
+    rec = {
+        "value": 9.5, "unit": "ms", "nested": {"p99_ms": 14.4},
+        "list": [{"x_ms": 1.0}, {"x_ms": 2.0}], "flag": True,
+    }
+    flat = flatten(rec)
+    assert flat == {
+        "value": 9.5, "nested.p99_ms": 14.4,
+        "list.0.x_ms": 1.0, "list.1.x_ms": 2.0,
+    }
+
+
+def test_direction_heuristics():
+    assert direction("engine_p99_ms") == -1
+    assert direction("delivery.ring_full_drops") == -1
+    assert direction("workers.lost_frames") == -1
+    assert direction("deliveries_per_s") == 1
+    assert direction("vs_baseline") == 1
+    assert direction("zipf.occupied_cubes") == 0
+
+
+def test_diff_flags_only_bad_direction_beyond_threshold():
+    old = {"5": {"config": 5, "p99_ms": 10.0, "per_s": 100.0, "n": 7}}
+    new = {"5": {"config": 5, "p99_ms": 15.0, "per_s": 140.0, "n": 9}}
+    rows, regressions = diff(old, new, threshold_pct=10.0)
+    names = {r[1] for r in rows}
+    assert {"p99_ms", "per_s", "n"} <= names
+    assert [(c, n) for c, n, *_ in regressions] == [("5", "p99_ms")]
+    # an improvement past the threshold is NOT a regression
+    rows, regressions = diff(new, old, threshold_pct=10.0)
+    assert [(c, n) for c, n, *_ in regressions] == [("5", "per_s")]
+
+
+def test_load_records_accepts_wrapper_and_json_lines(tmp_path):
+    wrapper = tmp_path / "wrapped.json"
+    wrapper.write_text(json.dumps({
+        "cmd": "python bench.py", "rc": 0, "tail": "noise",
+        "parsed": {"config": 5, "value": 9.5},
+    }))
+    assert load_records(str(wrapper)) == {"5": {"config": 5, "value": 9.5}}
+    lines = tmp_path / "lines.json"
+    lines.write_text(
+        'diag noise\n{"config": 1, "value": 1.0}\n'
+        '{"config": 5, "value": 9.0}\n'
+    )
+    recs = load_records(str(lines))
+    assert set(recs) == {"1", "5"}
+
+
+def test_main_fail_flag_gates_on_regressions(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({"config": 5, "p99_ms": 10.0}))
+    new.write_text(json.dumps({"config": 5, "p99_ms": 20.0}))
+    assert main([str(old), str(new)]) == 0          # informational
+    assert main([str(old), str(new), "--fail"]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    # under threshold: clean even with --fail
+    new.write_text(json.dumps({"config": 5, "p99_ms": 10.5}))
+    assert main([str(old), str(new), "--fail", "--threshold", "10"]) == 0

@@ -1,0 +1,187 @@
+"""Device telemetry (ISSUE 7): retrace visibility, per-tick timing
+split, live-buffer gauge, and server wiring.
+
+The acceptance pin: a FORCED retrace (capacity-tier first hit) is
+visible as both a /metrics counter increment and a named loose span in
+the flight recorder.
+"""
+
+import uuid as uuid_mod
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from worldql_server_tpu.engine.config import Config          # noqa: E402
+from worldql_server_tpu.engine.metrics import Metrics        # noqa: E402
+from worldql_server_tpu.engine.server import WorldQLServer   # noqa: E402
+from worldql_server_tpu.observability import (               # noqa: E402
+    DeviceTelemetry, FlightRecorder, Tracer,
+)
+from worldql_server_tpu.observability.device import (        # noqa: E402
+    live_device_bytes,
+)
+from worldql_server_tpu.protocol.types import (              # noqa: E402
+    Replication, Vector3,
+)
+from worldql_server_tpu.spatial.backend import LocalQuery    # noqa: E402
+from worldql_server_tpu.spatial.tpu_backend import (         # noqa: E402
+    TpuSpatialBackend,
+)
+
+POS = Vector3(5.0, 5.0, 5.0)
+
+#: a capacity tier no other test dispatches at — the first hit MUST
+#: compile fresh kernel variants even inside a shared pytest process
+FRESH_TIER = 1 << 17
+
+
+def make_backend() -> TpuSpatialBackend:
+    backend = TpuSpatialBackend(16)
+    a, b = uuid_mod.uuid4(), uuid_mod.uuid4()
+    backend.add_subscription("w", a, POS)
+    backend.add_subscription("w", b, POS)
+    backend._sender = a
+    return backend
+
+
+def dispatch_collect(backend):
+    query = LocalQuery("w", POS, backend._sender, Replication.EXCEPT_SELF)
+    return backend.collect_local_batch(
+        backend.dispatch_local_batch([query])
+    )
+
+
+def make_telemetry(backend):
+    metrics = Metrics()
+    tracer = Tracer(enabled=True)
+    recorder = FlightRecorder(depth=8)
+    tracer.on_trace = recorder.record
+    tel = DeviceTelemetry(
+        metrics=metrics, tracer=tracer, backend=backend
+    ).install()
+    return tel, metrics, recorder
+
+
+def test_forced_retrace_is_counted_and_leaves_a_loose_span():
+    """ISSUE acceptance: a capacity-tier first hit increments
+    device.retraces in /metrics AND records a named device.retrace
+    loose span (kernel family, capacity tier, compile ms) in the
+    flight recorder — and a steady-state repeat emits NOTHING."""
+    backend = make_backend()
+    tel, metrics, recorder = make_telemetry(backend)
+    try:
+        backend._delivery_cap = FRESH_TIER
+        [targets] = dispatch_collect(backend)
+        assert targets  # the fan-out itself still resolved
+        delta = tel.poll_retraces()
+        assert delta, "tier first hit must grow a kernel family"
+        snap = metrics.snapshot()
+        assert snap["counters"]["device.retraces"] >= 1
+        assert snap["counters"].get("device.compiles", 0) >= 1
+        loose = recorder.loose_snapshot()
+        spans = [t for t in loose if t["name"] == "device.retrace"]
+        assert spans, "no device.retrace loose span recorded"
+        tagged = spans[-1]["tags"]
+        assert tagged["family"].startswith(("tpu_backend.", "sharded."))
+        assert tagged["new_variants"] >= 1
+        assert tagged["t_cap"] == FRESH_TIER
+        assert "compile_ms" in tagged
+        # steady state: same tier again — no retrace, no new span
+        before = len(recorder.loose_snapshot())
+        dispatch_collect(backend)
+        assert tel.poll_retraces() == {}
+        assert metrics.snapshot()["counters"]["device.retraces"] == \
+            snap["counters"]["device.retraces"]
+        assert len([
+            t for t in recorder.loose_snapshot()
+            if t["name"] == "device.retrace"
+        ]) == len([
+            t for t in loose if t["name"] == "device.retrace"
+        ])
+        assert len(recorder.loose_snapshot()) == before
+    finally:
+        tel.uninstall()
+
+
+def test_per_tick_device_timing_split_reaches_trace_and_metrics():
+    backend = make_backend()
+    tel, metrics, recorder = make_telemetry(backend)
+    try:
+        dispatch_collect(backend)
+        timing = backend.last_device_timing
+        for leg in ("encode_ms", "h2d_ms", "compute_ms", "d2h_ms"):
+            assert leg in timing, timing
+            assert timing[leg] >= 0.0 or leg == "h2d_ms"
+        assert "d2h_enqueue_ms" in timing
+        assert timing["path"] in ("csr", "dense", "overflow")
+        # the tick hook tags the trace and feeds the histograms
+        tracer = Tracer(enabled=True)
+        trace = tracer.begin("tick", tick=1)
+        tel.on_tick(trace)
+        trace.finish()
+        assert "device_timing" in trace.tags
+        assert set(trace.tags["device_timing"]) >= {
+            "encode_ms", "compute_ms", "d2h_ms",
+        }
+        lat = metrics.snapshot()["latency"]
+        for leg in ("encode_ms", "h2d_ms", "compute_ms", "d2h_ms"):
+            assert lat[f"device.{leg}"]["count"] >= 1
+    finally:
+        tel.uninstall()
+
+
+def test_timing_fifo_pairs_across_pipelined_dispatches():
+    """Two dispatches in flight (tick pipeline): each collect pops its
+    OWN dispatch's timing — the deque pairs FIFO."""
+    backend = make_backend()
+    q = LocalQuery("w", POS, backend._sender, Replication.EXCEPT_SELF)
+    h1 = backend.dispatch_local_batch([q])
+    h2 = backend.dispatch_local_batch([q, q])
+    assert len(backend._dispatch_timings) == 2
+    backend.collect_local_batch(h1)
+    assert len(backend._dispatch_timings) == 1
+    backend.collect_local_batch(h2)
+    assert len(backend._dispatch_timings) == 0
+    assert "compute_ms" in backend.last_device_timing
+
+
+def test_live_buffer_gauge_and_stats():
+    backend = make_backend()
+    tel, metrics, recorder = make_telemetry(backend)
+    try:
+        dispatch_collect(backend)
+        # the index's device twin is resident → live bytes are nonzero
+        assert live_device_bytes() > 0
+        stats = tel.stats()
+        assert stats["buffer_bytes"] > 0
+        assert stats["compiles"] >= 0 and stats["retraces"] >= 0
+    finally:
+        tel.uninstall()
+
+
+def test_server_wires_device_telemetry_only_for_device_backends():
+    config = Config(
+        store_url="memory://", http_enabled=False, ws_enabled=False,
+        zmq_enabled=False, tick_interval=0.05,
+    )
+    cpu_server = WorldQLServer(config)
+    assert cpu_server.device_telemetry is None  # CPU backend: no device
+
+    dev_server = WorldQLServer(config, backend=make_backend())
+    try:
+        assert dev_server.device_telemetry is not None
+        assert dev_server.ticker._device_telemetry is \
+            dev_server.device_telemetry
+        snap = dev_server.metrics.snapshot()
+        assert "device" in snap["gauges"]
+        assert "buffer_bytes" in snap["gauges"]["device"]
+    finally:
+        dev_server.device_telemetry.uninstall()
+
+    off = Config(
+        store_url="memory://", http_enabled=False, ws_enabled=False,
+        zmq_enabled=False, device_telemetry=False,
+    )
+    assert WorldQLServer(off, backend=make_backend()) \
+        .device_telemetry is None

@@ -176,8 +176,8 @@ def test_cancel_mid_flush_does_not_redeliver():
         # delivered — the drain flush must not double-send.
         real_deliver = h.peer_map.deliver_batch
 
-        async def deliver_then_cancel(pairs):
-            await real_deliver(pairs)
+        async def deliver_then_cancel(pairs, t_ingress_ns=0):
+            await real_deliver(pairs, t_ingress_ns)
             raise asyncio.CancelledError
 
         h.peer_map.deliver_batch = deliver_then_cancel
@@ -231,10 +231,10 @@ def test_second_cancel_still_completes_inflight_delivery():
         real_deliver = h.peer_map.deliver_batch
         delivered: list[int] = []
 
-        async def slow_deliver(pairs):
+        async def slow_deliver(pairs, t_ingress_ns=0):
             started.set()
             await release.wait()
-            await real_deliver(pairs)
+            await real_deliver(pairs, t_ingress_ns)
             delivered.append(len(pairs))
 
         h.peer_map.deliver_batch = slow_deliver

@@ -1,0 +1,447 @@
+"""Worker-plane telemetry (ISSUE 7): trace stitching, e2e histograms,
+stats freshness, restart monotonicity, and the new delivery failpoints.
+
+Everything here drives REAL sender-worker processes over real ZMQ
+sockets (the WS variants of the same plumbing ride the existing
+delivery-plane suite). The boot-and-scrape test is the substance of
+the CI "Observability smoke" extension: boot with
+``--delivery-workers 2 --trace --slow-tick-ms 0``-equivalent config,
+assert worker ``delivery.e2e_ms`` series appear in /metrics and
+stitched worker spans appear in /debug/ticks.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import time
+import urllib.request
+
+from tests.client_util import ZmqClient, free_port
+from tests.prom_parser import validate_exposition
+from worldql_server_tpu.delivery import worker as worker_mod
+from worldql_server_tpu.delivery.ring import Ring
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.metrics import LATENCY_BUCKETS_MS, Metrics
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.protocol import Instruction, Message, Vector3
+from worldql_server_tpu.robustness import failpoints
+
+import pytest
+
+POS = Vector3(5.0, 5.0, 5.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.registry.reset()
+    yield
+    failpoints.registry.reset()
+
+
+def make_server(**overrides) -> WorldQLServer:
+    config = Config()
+    config.store_url = "memory://"
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_server_port = free_port()
+    config.zmq_server_host = "127.0.0.1"
+    config.delivery_workers = 2
+    config.tick_interval = 0.02
+    config.supervisor_backoff = 0.05
+    for k, v in overrides.items():
+        setattr(config, k, v)
+    return WorldQLServer(config)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def connect_subscribed(port, n):
+    clients = [await ZmqClient.connect(port) for _ in range(n)]
+    for c in clients:
+        await c.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name="w", position=POS,
+        ))
+    await asyncio.sleep(0.25)
+    return clients
+
+
+async def close_all(clients):
+    for c in clients:
+        await c.close()
+
+
+async def drive_traffic(clients, rounds, prefix="m"):
+    for r in range(rounds):
+        for c in clients:
+            await c.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=POS, parameter=f"{prefix}{r}",
+            ))
+        await asyncio.sleep(0.01)
+    expected_each = (len(clients) - 1) * rounds
+    for c in clients:
+        for _ in range(expected_each):
+            await c.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+
+
+# region: unit surfaces
+
+
+def test_worker_buckets_mirror_registry_buckets():
+    """The worker's duplicated bucket ladder must stay in lockstep
+    with engine/metrics.py, or the plane's cumulative-count merge
+    would silently mis-bucket every worker observation."""
+    assert tuple(worker_mod.BUCKETS_MS) == tuple(LATENCY_BUCKETS_MS)
+
+
+def test_ring_record_carries_both_stamps():
+    ring = Ring.create(1 << 16)
+    try:
+        t_ing = time.monotonic_ns()
+        before = time.monotonic_ns()
+        assert ring.try_write(b"payload", b"\x01\x00\x00\x00", t_ing)
+        after = time.monotonic_ns()
+        frame, slots, got_ing, got_write = ring.read_record()
+        assert frame == b"payload" and slots == [1]
+        assert got_ing == t_ing
+        assert before <= got_write <= after
+        # unclocked writes stamp 0 ingress but still stamp the write
+        assert ring.try_write(b"x", b"")
+        _, _, got_ing, got_write = ring.read_record()
+        assert got_ing == 0 and got_write > 0
+        # the timestamp-free compatibility read stays a 2-tuple
+        assert ring.try_write(b"y", b"")
+        assert ring.read() == (b"y", [])
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_metrics_merge_histogram_and_batch_observe():
+    m = Metrics()
+    m.observe_ms_n("frame.e2e_ms", 3.0, 5)
+    snap = m.snapshot()["latency"]["frame.e2e_ms"]
+    assert snap["count"] == 5
+    assert abs(snap["mean_ms"] - 3.0) < 1e-9
+    # worker-style delta merge: counts land in the pushed buckets
+    counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+    counts[3] = 4   # the 2.5 ms bucket
+    m.merge_histogram("delivery.worker.0.e2e_ms", counts, 4, 8.0, 2.2)
+    snap = m.snapshot()["latency"]["delivery.worker.0.e2e_ms"]
+    assert snap["count"] == 4 and snap["max_ms"] == 2.2
+    # merges accumulate — monotone totals
+    m.merge_histogram("delivery.worker.0.e2e_ms", counts, 4, 8.0, 2.0)
+    assert m.snapshot()["latency"]["delivery.worker.0.e2e_ms"]["count"] == 8
+    validate_exposition(m.render_prometheus())
+
+
+# endregion
+
+# region: boot-and-scrape (the CI "Observability smoke" extension)
+
+
+def test_boot_scrape_worker_series_and_stitched_spans(tmp_path):
+    """Boot with 2 delivery workers + tracing (slow-tick 0, CI shape):
+    worker delivery.e2e_ms series and the frame clock reach /metrics
+    under the strict scrape grammar, /debug/ticks shows stitched
+    delivery.worker_flush spans under tick.deliver covering >= 90% of
+    the deliver wall (ISSUE acceptance), and /healthz carries
+    per-worker stats_age_s."""
+    async def scenario():
+        http_port = free_port()
+        server = make_server(
+            http_enabled=True, http_port=http_port,
+            trace=True, slow_tick_ms=0.0,
+            slow_tick_dir=str(tmp_path / "dumps"),
+        )
+        await server.start()
+        clients = []
+        try:
+            clients = await connect_subscribed(
+                server.config.zmq_server_port, 6
+            )
+            for c in clients:
+                assert server.peer_map.get(c.uuid).shard is not None
+            await drive_traffic(clients, 20)
+            await asyncio.sleep(0.6)  # >= two worker-stats intervals
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}"
+                ) as resp:
+                    return resp.read().decode()
+
+            # 1. strict-parse /metrics; worker + aggregate e2e series
+            text = await asyncio.to_thread(get, "/metrics")
+            types, samples = validate_exposition(text)
+            for name in (
+                "wql_delivery_worker_0_e2e_seconds",
+                "wql_delivery_worker_1_e2e_seconds",
+                "wql_delivery_e2e_seconds",
+                "wql_frame_e2e_seconds",
+            ):
+                assert types[name] == "histogram", name
+            counts = {
+                name: value for name, labels, value in samples
+                if name.endswith("_count")
+            }
+            assert counts["wql_delivery_e2e_seconds_count"] > 0
+            assert counts["wql_frame_e2e_seconds_count"] > 0
+            assert (
+                counts["wql_delivery_worker_0_e2e_seconds_count"]
+                + counts["wql_delivery_worker_1_e2e_seconds_count"]
+            ) > 0
+
+            # 2. /debug/ticks: stitched worker spans under tick.deliver
+            body = json.loads(await asyncio.to_thread(get, "/debug/ticks"))
+            best = 0.0
+            stitched_ticks = 0
+            for t in body["ticks"]:
+                deliver = [s for s in t["spans"]
+                           if s["name"] == "tick.deliver"]
+                flushes = [s for s in t["spans"]
+                           if s["name"] == "delivery.worker_flush"]
+                if not deliver or not flushes:
+                    continue
+                stitched_ticks += 1
+                d = deliver[0]
+                d0, d1 = d["t0_ms"], d["t0_ms"] + d["dur_ms"]
+                for s in flushes:
+                    assert s["parent"] == d["id"]
+                    assert s["thread"].startswith("delivery-worker-")
+                    assert "ring_dwell_ms" in s["tags"]
+                    assert "write_ms" in s["tags"]
+                    # segments anchor at their ring write, inside the
+                    # deliver window (the flush tail may extend past it)
+                    assert d0 - 0.2 <= s["t0_ms"] <= d1 + 0.2
+                # accounting: the stitched worker time explains the
+                # deliver wall (ring dwell + write across the tick's
+                # records; workers run in parallel with the parent, so
+                # the accounted time can exceed the wall)
+                accounted = sum(s["dur_ms"] for s in flushes)
+                if d1 > d0:
+                    best = max(best, accounted / (d1 - d0))
+            assert stitched_ticks > 0, "no tick carried stitched spans"
+            assert best >= 0.9, (
+                f"stitched worker spans account for only {best:.0%} of "
+                "the best tick.deliver wall"
+            )
+            # Chrome export carries them too (worker thread rows)
+            chrome = json.loads(
+                await asyncio.to_thread(get, "/debug/ticks?format=chrome")
+            )
+            assert any(
+                e["ph"] == "X" and e["name"] == "delivery.worker_flush"
+                for e in chrome["traceEvents"]
+            )
+
+            # 3. /healthz delivery block: per-worker stats freshness
+            health = json.loads(await asyncio.to_thread(get, "/healthz"))
+            ages = health["delivery"]["stats_age_s"]
+            assert set(ages) == {"0", "1"}
+            for age in ages.values():
+                assert age is not None and age < 0.75
+            assert health["delivery"]["stats_stale"] == 0
+
+        finally:
+            # close in finally: a leaked zmq context from an assertion
+            # failure otherwise wedges interpreter exit on ctx.term
+            await close_all(clients)
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion
+
+# region: restart monotonicity (ISSUE satellite)
+
+
+def test_worker_restart_keeps_merged_series_monotone():
+    """SIGKILL a worker mid-fan-out: the merged /metrics histograms
+    and counters never step backwards, and after the restart the
+    worker's series RESUME growing (no counter-reset regression) —
+    strict-parsed before and after."""
+    async def scenario():
+        server = make_server(trace=True)
+        await server.start()
+        clients, fresh = [], []
+        try:
+            clients = await connect_subscribed(
+                server.config.zmq_server_port, 6
+            )
+            await drive_traffic(clients, 10)
+            await asyncio.sleep(0.6)
+
+            def series_counts(text):
+                _, samples = validate_exposition(text)
+                return {
+                    name: value for name, labels, value in samples
+                    if name.endswith(("_count", "_total"))
+                }
+
+            before = series_counts(server.metrics.render_prometheus())
+            assert before.get("wql_delivery_e2e_seconds_count", 0) > 0
+
+            plane = server.delivery_plane
+            shard0 = plane._shards[0]
+            victims = set(shard0.peers)
+            # mid-fan-out: keep frames flowing while the worker dies
+            survivors = [c for c in clients if c.uuid not in victims]
+            os.kill(shard0.proc.pid, signal.SIGKILL)
+            for r in range(10):
+                await survivors[0].send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name="w", position=POS, parameter=f"k{r}",
+                ))
+                await asyncio.sleep(0.02)
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if plane.alive_workers() == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert plane.alive_workers() == 2
+
+            mid = series_counts(server.metrics.render_prometheus())
+            for name, value in before.items():
+                assert mid.get(name, 0) >= value, (
+                    f"{name} stepped backwards across the worker death"
+                )
+
+            # fresh peers adopt onto the restarted (emptiest) shard and
+            # its series resume
+            fresh = await connect_subscribed(
+                server.config.zmq_server_port, 2
+            )
+            assert any(
+                server.peer_map.get(c.uuid).shard == shard0.idx
+                for c in fresh
+            )
+            await drive_traffic(survivors + fresh, 10, prefix="p")
+            await asyncio.sleep(0.6)
+            after = series_counts(server.metrics.render_prometheus())
+            key = f"wql_delivery_worker_{shard0.idx}_e2e_seconds_count"
+            assert after[key] > before.get(key, 0), (
+                "restarted worker's histogram did not resume"
+            )
+            for name, value in mid.items():
+                assert after.get(name, 0) >= value
+        finally:
+            await close_all(clients + fresh)
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion
+
+# region: stats freshness + delivery failpoints (ISSUE satellites)
+
+
+def test_wedged_worker_marks_delivery_degraded():
+    """`delivery.worker_send=delay:...` wedges a worker's drain loop
+    without killing it: the stats push goes silent past 3 control
+    intervals, the /healthz delivery block degrades, and the worker's
+    fires reach the parent's failpoints audit gauge when it wakes."""
+    async def scenario():
+        server = make_server(
+            delivery_workers=1,
+            failpoints="delivery.worker_send=delay:1500ms",
+        )
+        await server.start()
+        clients = []
+        try:
+            clients = await connect_subscribed(
+                server.config.zmq_server_port, 2
+            )
+            status = server.delivery_status()
+            assert not status["degraded"]
+            await clients[0].send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=POS, parameter="wedge",
+            ))
+            deadline = asyncio.get_event_loop().time() + 10
+            degraded = False
+            while asyncio.get_event_loop().time() < deadline:
+                status = server.delivery_status()
+                if status["degraded"] and status["stats_stale"] >= 1:
+                    degraded = True
+                    break
+                await asyncio.sleep(0.05)
+            assert degraded, "wedged-but-alive worker never degraded"
+            assert status["stats_age_s"]["0"] > 0.75
+            # when the delay releases, the fire count reports back and
+            # the plane folds it into the parent registry (gauge audit)
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if failpoints.registry.fired("delivery.worker_send"):
+                    break
+                await asyncio.sleep(0.1)
+            assert failpoints.registry.fired("delivery.worker_send") >= 1
+            snap = server.metrics.snapshot()
+            assert snap["gauges"]["failpoints"][
+                "delivery.worker_send"
+            ] >= 1
+            # and the block recovers once pushes resume
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if not server.delivery_status()["degraded"]:
+                    break
+                await asyncio.sleep(0.1)
+            assert not server.delivery_status()["degraded"]
+        finally:
+            await close_all(clients)
+            await server.stop()
+
+    run(scenario())
+
+
+def test_ring_write_failpoint_forces_counted_drops():
+    """`delivery.ring_write=error` behaves as an instantly-full ring:
+    frames drop, the drops are COUNTED (delivery.ring_full_drops), the
+    fires are audited, and disarming restores delivery."""
+    async def scenario():
+        server = make_server(delivery_workers=1)
+        await server.start()
+        clients = []
+        try:
+            clients = await connect_subscribed(
+                server.config.zmq_server_port, 2
+            )
+            failpoints.registry.set("delivery.ring_write", "error")
+            await clients[0].send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=POS, parameter="dropped",
+            ))
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                snap = server.metrics.snapshot()
+                if snap["counters"].get("delivery.ring_full_drops", 0):
+                    break
+                await asyncio.sleep(0.05)
+            assert snap["counters"]["delivery.ring_full_drops"] >= 1
+            assert failpoints.registry.fired("delivery.ring_write") >= 1
+            assert snap["gauges"]["failpoints"][
+                "delivery.ring_write"
+            ] >= 1
+            failpoints.registry.clear("delivery.ring_write")
+            await clients[0].send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=POS, parameter="resumed",
+            ))
+            got = await clients[1].recv_until(
+                Instruction.LOCAL_MESSAGE, timeout=10
+            )
+            assert got.parameter == "resumed"
+        finally:
+            await close_all(clients)
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion

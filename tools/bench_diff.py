@@ -1,0 +1,177 @@
+"""Compare two bench result files and flag per-metric regressions.
+
+Groundwork for a CI perf gate (once hardware numbers exist): given two
+``BENCH_*.json`` files — either the round wrapper the trajectory keeps
+(``{"cmd": ..., "tail": ..., "parsed": {...}}``) or raw ``bench.py``
+stdout (one JSON line per config) — it pairs records by ``config``,
+flattens every numeric leaf to a dotted path, and prints old → new
+with the relative delta. Deltas beyond ``--threshold`` (default 10%)
+in the BAD direction are flagged as regressions; direction comes from
+the metric name (``*_ms``/``*drops``/``*errors``/``lost*`` are
+lower-is-better, ``*per_s``/``vs_baseline``/``speedup*`` higher-is-
+better; anything else is informational only).
+
+Usage::
+
+    python -m tools.bench_diff BENCH_r05.json BENCH_r06.json
+    python -m tools.bench_diff old.json new.json --threshold 5 --fail
+
+``--fail`` exits 1 when any regression is flagged — the CI-gate mode.
+Without it the tool always exits 0 (informational diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: substrings (suffix-ish) that mark a metric lower-is-better
+_LOWER_BETTER = (
+    "_ms", "_s", "drops", "errors", "lost", "retraces", "failures",
+    "evictions", "slow_ticks",
+)
+#: substrings that mark a metric higher-is-better
+_HIGHER_BETTER = (
+    "per_s", "vs_baseline", "speedup", "deliveries", "sends_ok",
+    "queries_per_s",
+)
+
+
+def load_records(path: str) -> dict:
+    """→ {config_key: record_dict}. Accepts the round wrapper, a bare
+    record, a list of records, or JSON-lines bench stdout."""
+    text = open(path, encoding="utf-8").read()
+    records: list[dict] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "parsed" in doc:
+        parsed = doc["parsed"]
+        records = parsed if isinstance(parsed, list) else [parsed]
+        if not records or not any(isinstance(r, dict) for r in records):
+            # wrapper without usable parsed output — fall back to tail
+            records = _json_lines(doc.get("tail", ""))
+    elif isinstance(doc, dict):
+        records = [doc]
+    elif isinstance(doc, list):
+        records = doc
+    else:
+        records = _json_lines(text)
+    out = {}
+    for rec in records:
+        if isinstance(rec, dict):
+            key = str(rec.get("config", rec.get("metric", len(out))))
+            out[key] = rec
+    if not out:
+        raise SystemExit(f"{path}: no bench records found")
+    return out
+
+
+def _json_lines(text: str) -> list[dict]:
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            continue
+    return records
+
+
+def flatten(rec: dict, prefix: str = "") -> dict:
+    """Numeric leaves only, dotted paths; lists index positionally."""
+    out: dict[str, float] = {}
+    items = (
+        rec.items() if isinstance(rec, dict)
+        else enumerate(rec) if isinstance(rec, list)
+        else ()
+    )
+    for key, value in items:
+        path = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[path] = float(value)
+        elif isinstance(value, (dict, list)):
+            out.update(flatten(value, path))
+    return out
+
+
+def direction(name: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = informational.
+    Higher-better wins ties ('deliveries_per_s' contains '_s')."""
+    leaf = name.rsplit(".", 1)[-1]
+    if any(tok in leaf for tok in _HIGHER_BETTER):
+        return 1
+    if any(leaf.endswith(tok) or tok in leaf for tok in _LOWER_BETTER):
+        return -1
+    return 0
+
+
+def diff(old: dict, new: dict, threshold_pct: float):
+    """→ (rows, regressions): every common numeric leaf with its
+    delta; regressions are the threshold-crossers in the bad
+    direction."""
+    rows, regressions = [], []
+    for config in sorted(set(old) & set(new)):
+        o_flat, n_flat = flatten(old[config]), flatten(new[config])
+        for name in sorted(set(o_flat) & set(n_flat)):
+            o, n = o_flat[name], n_flat[name]
+            if o == n:
+                continue
+            pct = ((n - o) / abs(o) * 100.0) if o else float("inf")
+            d = direction(name)
+            regressed = (
+                d != 0
+                and abs(pct) > threshold_pct
+                and (pct > 0) == (d < 0)   # moved in the bad direction
+            )
+            rows.append((config, name, o, n, pct, d, regressed))
+            if regressed:
+                regressions.append((config, name, o, n, pct))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.bench_diff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=10.0,
+                   help="flag deltas beyond this %% in the bad "
+                        "direction (default 10)")
+    p.add_argument("--fail", action="store_true",
+                   help="exit 1 when any regression is flagged "
+                        "(CI-gate mode)")
+    p.add_argument("--all", action="store_true", dest="show_all",
+                   help="print every changed leaf, not just flagged "
+                        "and direction-scored ones")
+    args = p.parse_args(argv)
+
+    rows, regressions = diff(
+        load_records(args.old), load_records(args.new), args.threshold
+    )
+    for config, name, o, n, pct, d, regressed in rows:
+        if not args.show_all and d == 0 and not regressed:
+            continue
+        marker = "REGRESSION" if regressed else (
+            "improved" if d != 0 and abs(pct) > args.threshold else ""
+        )
+        print(f"[{config}] {name}: {o:g} -> {n:g} "
+              f"({pct:+.1f}%) {marker}".rstrip())
+    print(f"\n{len(rows)} changed metric(s), "
+          f"{len(regressions)} regression(s) beyond "
+          f"{args.threshold:g}%")
+    if regressions and args.fail:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,6 +149,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slow-tick-dir", dest="slow_tick_dir",
                    help="directory for slow-tick dump files "
                         "(default ./slow_ticks)")
+    p.add_argument("--no-device-telemetry", action="store_true",
+                   help="disable device telemetry (jit compile/retrace "
+                        "counters + loose spans, per-tick encode/h2d/"
+                        "compute/d2h split, live device-buffer gauge; "
+                        "default on for device backends)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -181,6 +186,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         config.failpoints_admin = True
     if args.trace:
         config.trace = True
+    if args.no_device_telemetry:
+        config.device_telemetry = False
     config.verbose = args.verbose
     return config
 

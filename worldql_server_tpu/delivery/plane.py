@@ -32,15 +32,29 @@ import tempfile
 import time
 import uuid as uuid_mod
 from array import array
+from collections import deque
 from typing import Callable
 
 from ..observability.spans import Tracer
+from ..robustness import failpoints
+from ..robustness.failpoints import FailpointError
 from .ring import Ring
-from .worker import worker_main
+from .worker import STATS_INTERVAL, worker_main
 
 logger = logging.getLogger(__name__)
 
 OnPeerLost = Callable[[uuid_mod.UUID, str], None]
+
+#: a worker whose stats push is older than this many control-channel
+#: intervals is wedged-but-alive: the process exists, the drain loop
+#: does not — the delivery /healthz block marks it degraded (before
+#: this, only a DEAD worker looked unhealthy)
+STATS_STALE_INTERVALS = 3
+
+#: worker span segments retained for flight-recorder stitching —
+#: enough for several ticks of fan-out detail at the segment cap,
+#: bounded so an idle /debug/ticks ring never pins stale history
+SEGMENT_RETENTION = 2048
 
 #: bounded waits before a frame is DROPPED (and counted) rather than
 #: wedging the caller: the sync fast path (event loop, per-broadcast)
@@ -56,7 +70,7 @@ class _Shard:
     __slots__ = (
         "idx", "gen", "ring", "proc", "ctl", "alive", "retired",
         "restarts", "born", "peers", "slots", "next_slot", "reader",
-        "stats",
+        "stats", "stats_at",
     )
 
     def __init__(self, idx: int):
@@ -74,6 +88,7 @@ class _Shard:
         self.next_slot = 0
         self.reader: asyncio.Task | None = None
         self.stats: dict = {}
+        self.stats_at = 0.0           # monotonic time of the last push
 
 
 class DeliveryPlane:
@@ -91,12 +106,21 @@ class DeliveryPlane:
         self.on_peer_lost = on_peer_lost
         self._budget = config.supervisor_budget
         self._backoff = config.supervisor_backoff
+        # worker processes arm their own failpoint registry from the
+        # same spec (spawn args) — worker-side sites fire there and
+        # report back for the plane-wide audit
+        self._failpoints_spec = getattr(config, "failpoints", "")
+        self._failpoints_seed = getattr(config, "failpoints_seed", None)
         self._shards: list[_Shard] = []
         self._dir: str | None = None
         self._ctx = multiprocessing.get_context("spawn")
         self._stopping = False
         self.ring_drops = 0
         self.frames_submitted = 0
+        #: worker-reported span segments awaiting flight-recorder
+        #: stitching: (worker, t_write_ns, dwell_ms, write_ms, slots,
+        #: slow_slot, slow_ms)
+        self._segments: deque = deque(maxlen=SEGMENT_RETENTION)
 
     # region: lifecycle
 
@@ -118,7 +142,8 @@ class DeliveryPlane:
         lsock.setblocking(False)
         proc = self._ctx.Process(
             target=worker_main,
-            args=(shard.idx, path, ring.name),
+            args=(shard.idx, path, ring.name,
+                  self._failpoints_spec, self._failpoints_seed),
             name=f"wql-delivery-{shard.idx}",
             daemon=True,
         )
@@ -148,6 +173,7 @@ class DeliveryPlane:
         shard.alive = True
         shard.born = time.monotonic()
         shard.stats = {}
+        shard.stats_at = shard.born   # freshness clock starts at birth
         # the reader IS the shard's monitor: its EOF-triggered exit path
         # performs eviction + restart, so it does not ride the
         # restart-the-task supervisor
@@ -261,6 +287,7 @@ class DeliveryPlane:
 
     def _note_stats(self, shard: _Shard, msg: dict) -> None:
         prev = shard.stats
+        shard.stats_at = time.monotonic()
         if self.metrics is not None:
             for key in ("deliveries", "sends_ok", "send_errors", "bytes"):
                 delta = int(msg.get(key, 0)) - int(prev.get(key, 0))
@@ -272,7 +299,64 @@ class DeliveryPlane:
                 self.metrics.observe_ms(
                     "delivery.worker_drain_ms", float(msg["drain_ms"])
                 )
+            # cumulative worker histograms → registry deltas: the
+            # per-worker series (delivery.worker.<i>.e2e_ms) plus the
+            # aggregates the SLO reads (delivery.e2e_ms, frame.e2e_ms).
+            # Restarted workers re-zero their cumulatives AND their
+            # prev packet (stats reset in _bring_up), so merged counts
+            # only ever grow — no counter-reset sawtooth in /metrics.
+            self._merge_hist(
+                msg.get("e2e"), prev.get("e2e"),
+                (f"delivery.worker.{shard.idx}.e2e_ms", "delivery.e2e_ms"),
+            )
+            self._merge_hist(
+                msg.get("frame_e2e"), prev.get("frame_e2e"),
+                ("frame.e2e_ms",),
+            )
+        for seg in msg.get("segments", ()):
+            try:
+                t_write, dwell_ms, write_ms, n_slots, slow_slot, slow_ms = seg
+            except (TypeError, ValueError):
+                continue
+            self._segments.append((
+                shard.idx, int(t_write), float(dwell_ms), float(write_ms),
+                int(n_slots), int(slow_slot), float(slow_ms),
+            ))
+        fp = msg.get("fp")
+        if fp:
+            prev_fp = prev.get("fp") or {}
+            deltas = {
+                name: int(n) - int(prev_fp.get(name, 0))
+                for name, n in fp.items()
+            }
+            failpoints.registry.note_remote_fires(deltas)
         shard.stats = msg
+
+    def _merge_hist(self, cur, prev, names: tuple) -> None:
+        """Diff one cumulative worker histogram against the previous
+        packet and merge the delta under every name in ``names``."""
+        if not isinstance(cur, dict) or "counts" not in cur:
+            return
+        prev_counts = (prev or {}).get("counts") or []
+        counts = cur["counts"]
+        deltas = [
+            int(c) - int(prev_counts[i]) if i < len(prev_counts) else int(c)
+            for i, c in enumerate(counts)
+        ]
+        if any(d < 0 for d in deltas):
+            # torn/restarted baseline — treat the packet as a fresh
+            # start rather than subtracting into negatives
+            deltas = [int(c) for c in counts]
+            prev = None
+        d_total = sum(deltas)
+        d_sum = float(cur.get("sum_ms", 0.0)) - float(
+            (prev or {}).get("sum_ms", 0.0)
+        )
+        max_ms = float(cur.get("max_ms", 0.0))
+        for name in names:
+            self.metrics.merge_histogram(
+                name, deltas, d_total, max(d_sum, 0.0), max_ms
+            )
 
     async def _worker_down(self, shard: _Shard) -> None:
         """Crash containment: evict the shard's peers (authoritative
@@ -406,37 +490,51 @@ class DeliveryPlane:
         if self.metrics is not None:
             self.metrics.inc("delivery.ring_full_drops", n)
 
-    def _submit(self, shard: _Shard, frame, slots_le: bytes) -> bool:
+    def _submit(self, shard: _Shard, frame, slots_le: bytes,
+                t_ingress_ns: int = 0) -> bool:
         """Sync fast path (PeerMap broadcast try_write): bounded spin
         then drop — the event loop must never wedge on a slow shard."""
         if not shard.alive or shard.ring is None:
+            return False
+        try:
+            # chaos site: `error` behaves as an instantly-full ring
+            # (caller falls back / drops, counted), `delay` models a
+            # congested producer
+            failpoints.fire("delivery.ring_write")
+        except FailpointError:
             return False
         ring = shard.ring
         if Ring.record_size(len(frame), len(slots_le) // 4) > ring.cap:
             self._count_drop()
             return True  # oversized for any retry — swallow, counted
-        if ring.try_write(frame, slots_le):
+        if ring.try_write(frame, slots_le, t_ingress_ns):
             self.frames_submitted += 1
             return True
         deadline = time.perf_counter() + SYNC_WAIT_S
         while time.perf_counter() < deadline:
             time.sleep(0.0002)
-            if ring.try_write(frame, slots_le):
+            if ring.try_write(frame, slots_le, t_ingress_ns):
                 self.frames_submitted += 1
                 return True
         return False  # caller falls back to the awaited path
 
-    async def _asubmit(self, shard: _Shard, frame, slots_le: bytes) -> bool:
+    async def _asubmit(self, shard: _Shard, frame, slots_le: bytes,
+                       t_ingress_ns: int = 0) -> bool:
         """Async batch path: yields to the loop while the ring drains;
         bounded so a wedged worker degrades (drop + count) instead of
         stalling the tick pipeline."""
         if not shard.alive or shard.ring is None:
             return False
+        try:
+            await failpoints.afire("delivery.ring_write")
+        except FailpointError:
+            self._count_drop()
+            return False
         ring = shard.ring
         if Ring.record_size(len(frame), len(slots_le) // 4) > ring.cap:
             self._count_drop()
             return True
-        if ring.try_write(frame, slots_le):
+        if ring.try_write(frame, slots_le, t_ingress_ns):
             self.frames_submitted += 1
             return True
         deadline = time.perf_counter() + ASYNC_WAIT_S
@@ -446,7 +544,7 @@ class DeliveryPlane:
                 # the worker died (or restarted onto a fresh ring)
                 # while we waited — the captured ring is torn down
                 return False
-            if ring.try_write(frame, slots_le):
+            if ring.try_write(frame, slots_le, t_ingress_ns):
                 self.frames_submitted += 1
                 return True
         self._count_drop()
@@ -454,16 +552,21 @@ class DeliveryPlane:
 
     async def deliver(
         self, groups: dict[int, tuple[bytes, array]],
+        t_ingress_ns: int = 0,
     ) -> int:
         """One message's fan-out: ``{shard_idx: (frame, slot_array)}``
         — the frame is written ONCE per shard regardless of the slot
         count (the serialize-once discipline extended across the
-        process boundary). Returns sends attempted."""
+        process boundary). ``t_ingress_ns`` is the frame clock the
+        owning worker closes at socket-write-complete. Returns sends
+        attempted."""
         n = 0
         for shard_idx, (frame, slots) in groups.items():
             shard = self._shards[shard_idx]
             n += len(slots)
-            if not await self._asubmit(shard, frame, slots.tobytes()):
+            if not await self._asubmit(
+                shard, frame, slots.tobytes(), t_ingress_ns
+            ):
                 self._count_drop(len(slots))
         return n
 
@@ -471,8 +574,30 @@ class DeliveryPlane:
 
     # region: introspection
 
+    def stats_age_s(self, idx: int) -> float | None:
+        """Seconds since the worker's last stats push (None when the
+        shard is down/retired — deadness is its own signal)."""
+        shard = self._shards[idx] if idx < len(self._shards) else None
+        if shard is None or not shard.alive:
+            return None
+        return max(0.0, time.monotonic() - shard.stats_at)
+
+    def _stale_workers(self) -> int:
+        """Alive-but-silent workers: the control-channel stats push
+        stopped for > STATS_STALE_INTERVALS intervals. A wedged drain
+        loop (e.g. a multi-second blocking send) looks exactly like
+        this — alive process, no progress."""
+        horizon = STATS_STALE_INTERVALS * STATS_INTERVAL
+        return sum(
+            1 for s in self._shards
+            if s.alive and time.monotonic() - s.stats_at > horizon
+        )
+
     def degraded(self) -> bool:
-        return any(s.retired or not s.alive for s in self._shards)
+        return (
+            any(s.retired or not s.alive for s in self._shards)
+            or self._stale_workers() > 0
+        )
 
     def alive_workers(self) -> int:
         return sum(1 for s in self._shards if s.alive)
@@ -486,6 +611,7 @@ class DeliveryPlane:
             "peers": sum(len(s.peers) for s in self._shards),
             "frames_submitted": self.frames_submitted,
             "ring_full_drops": self.ring_drops,
+            "stats_stale": self._stale_workers(),
         }
 
     def worker_stats(self, idx: int) -> dict:
@@ -494,6 +620,7 @@ class DeliveryPlane:
         shard = self._shards[idx] if idx < len(self._shards) else None
         if shard is None:
             return {}
+        age = self.stats_age_s(idx)
         out = {
             "alive": int(shard.alive),
             "retired": int(shard.retired),
@@ -503,11 +630,57 @@ class DeliveryPlane:
                 shard.ring.pending_bytes()
                 if shard.alive and shard.ring is not None else 0
             ),
+            "stats_age_s": round(age, 3) if age is not None else -1.0,
         }
         for key in ("records", "deliveries", "sends_ok", "send_errors",
                     "bytes", "evictions"):
             if key in shard.stats:
                 out[key] = int(shard.stats[key])
+        return out
+
+    def stitch(self, trace) -> list[dict]:
+        """Flight-recorder stitcher: synthesize ``delivery.worker_flush``
+        child spans under a tick trace's ``tick.deliver`` from the
+        worker-reported segments whose ring-write stamp falls inside
+        the deliver window. Ring-write stamps are CLOCK_MONOTONIC ns
+        and trace span clocks are ``perf_counter`` seconds — the same
+        clock on Linux, so the windows align without translation (on a
+        platform where they differ, segments simply fail to match and
+        the trace degrades to parent-side spans only)."""
+        with trace._lock:
+            deliver = [s for s in trace.spans if s.name == "tick.deliver"]
+        if not deliver or not self._segments:
+            return []
+        out: list[dict] = []
+        base = trace.perf_start
+        for ds in deliver:
+            w0 = ds.t0 - 1e-4
+            w1 = ds.t0 + ds.dur_ms / 1e3 + 1e-4
+            for (worker, t_write, dwell_ms, write_ms, n_slots,
+                 slow_slot, slow_ms) in self._segments:
+                t_write_s = t_write / 1e9
+                if not (w0 <= t_write_s <= w1):
+                    continue
+                tags = {
+                    "worker": worker,
+                    "ring_dwell_ms": dwell_ms,
+                    "write_ms": write_ms,
+                    "slots": n_slots,
+                }
+                if slow_slot >= 0:
+                    tags["slowest_slot"] = slow_slot
+                    tags["slowest_send_ms"] = slow_ms
+                out.append({
+                    # negative ids: synthetic spans can never collide
+                    # with the trace's own monotonically-positive ids
+                    "id": -(len(out) + 1),
+                    "parent": ds.id,
+                    "name": "delivery.worker_flush",
+                    "t0_ms": round((t_write_s - base) * 1e3, 3),
+                    "dur_ms": round(dwell_ms + write_ms, 3),
+                    "tags": tags,
+                    "thread": f"delivery-worker-{worker}",
+                })
         return out
 
     # endregion

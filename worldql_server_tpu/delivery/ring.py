@@ -5,7 +5,17 @@ single consumer: that worker), over one ``multiprocessing.
 shared_memory`` block. Records are raw struct frames —
 
     [u32 kind][u32 frame_len][u32 n_slots]
+    [u64 t_ingress_ns][u64 t_ring_write_ns]
     [frame bytes][n_slots × u32 slot ids]   (8-byte aligned)
+
+The two stamps are CLOCK_MONOTONIC nanoseconds (``time.monotonic_ns``
+— the same clock domain as ``time.perf_counter`` on Linux, so worker-
+side completions stitch directly into parent-side span traces):
+``t_ingress_ns`` is the frame clock opened at router dispatch / ticker
+flush start (0 = not frame-clocked, e.g. broadcasts), and
+``t_ring_write_ns`` is stamped by :meth:`Ring.try_write` itself — the
+moment the frame entered the delivery plane. Workers subtract both
+from their socket-write-complete time for the e2e histograms.
 
 — written in place with ``pack_into``/buffer slicing: there is no
 pickling and no intermediate frame copy on the write path (enforced by
@@ -26,13 +36,14 @@ worker can never stall the tick pipeline).
 from __future__ import annotations
 
 import struct
+import time
 from multiprocessing import shared_memory
 
 #: header layout: head u64 @0 (producer), tail u64 @8 (consumer),
 #: capacity u64 @16 (set once at create; SharedMemory rounds the block
 #: to page size so the true cap must ride in-band)
 _HDR = 64
-_REC = struct.Struct("<III")
+_REC = struct.Struct("<IIIQQ")
 _CUR = struct.Struct("<Q")
 
 KIND_FRAME = 1
@@ -107,11 +118,13 @@ class Ring:
     def record_size(frame_len: int, n_slots: int) -> int:
         return (_REC.size + frame_len + 4 * n_slots + 7) & ~7
 
-    def try_write(self, frame, slots_le: bytes) -> bool:
+    def try_write(self, frame, slots_le: bytes, t_ingress_ns: int = 0) -> bool:
         """Append one delivery record (``slots_le`` is the target slot
         ids already packed little-endian u32, e.g. ``array('I')``
-        bytes). False when the ring lacks space — the caller decides
-        whether to wait, drop, or spill."""
+        bytes). ``t_ingress_ns`` is the frame clock opened at router
+        dispatch / ticker flush start (0 = unclocked); the ring-write
+        stamp is taken here. False when the ring lacks space — the
+        caller decides whether to wait, drop, or spill."""
         n_slots = len(slots_le) // 4
         size = self.record_size(len(frame), n_slots)
         head, tail = self._head(), self._tail()
@@ -125,13 +138,16 @@ class Ring:
             if free < rem + size:
                 return False
             if rem >= _REC.size:
-                _REC.pack_into(self.buf, _HDR + pos, KIND_WRAP, 0, 0)
+                _REC.pack_into(self.buf, _HDR + pos, KIND_WRAP, 0, 0, 0, 0)
             head += rem
             pos = 0
         elif free < size:
             return False
         off = _HDR + pos
-        _REC.pack_into(self.buf, off, KIND_FRAME, len(frame), n_slots)
+        _REC.pack_into(
+            self.buf, off, KIND_FRAME, len(frame), n_slots,
+            t_ingress_ns, time.monotonic_ns(),
+        )
         off += _REC.size
         self.buf[off:off + len(frame)] = frame
         off += len(frame)
@@ -144,9 +160,16 @@ class Ring:
 
     def read(self):
         """Consume one record → ``(frame_bytes, slot_ids: list[int])``
-        or None when the ring is empty. The frame is COPIED out of the
-        block before the tail advances — the consumer may buffer it
-        past the slot's reuse."""
+        or None when the ring is empty (timestamp-free compatibility
+        surface; see :meth:`read_record`)."""
+        rec = self.read_record()
+        return None if rec is None else rec[:2]
+
+    def read_record(self):
+        """Consume one record → ``(frame_bytes, slot_ids, t_ingress_ns,
+        t_ring_write_ns)`` or None when the ring is empty. The frame is
+        COPIED out of the block before the tail advances — the consumer
+        may buffer it past the slot's reuse."""
         while True:
             head, tail = self._head(), self._tail()
             if tail >= head:
@@ -156,7 +179,9 @@ class Ring:
             if rem < _REC.size:
                 _CUR.pack_into(self.buf, 8, tail + rem)
                 continue
-            kind, frame_len, n_slots = _REC.unpack_from(self.buf, _HDR + pos)
+            kind, frame_len, n_slots, t_ingress, t_write = _REC.unpack_from(
+                self.buf, _HDR + pos
+            )
             if kind == KIND_WRAP:
                 _CUR.pack_into(self.buf, 8, tail + rem)
                 continue
@@ -168,4 +193,4 @@ class Ring:
                 struct.unpack_from(f"<{n_slots}I", self.buf, off)
             ) if n_slots else []
             _CUR.pack_into(self.buf, 8, tail + size)
-            return frame, slots
+            return frame, slots, t_ingress, t_write

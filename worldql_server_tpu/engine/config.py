@@ -249,6 +249,15 @@ class Config:
     slow_tick_dir: str = field(
         default_factory=lambda: _env("WQL_SLOW_TICK_DIR", "slow_ticks")
     )
+    # Device telemetry (observability/device.py): jit compile/retrace
+    # counters + flight-recorder loose spans, the per-tick
+    # encode/h2d/compute/d2h timing split, and the live
+    # device-buffer-bytes gauge. On by default — it only activates
+    # when the spatial backend exposes device stats (tpu/sharded), and
+    # its tick-path cost is one small dict diff per collect.
+    device_telemetry: bool = field(
+        default_factory=lambda: _env("WQL_DEVICE_TELEMETRY", "1") == "1"
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation

@@ -49,17 +49,38 @@ class Histogram:
         self.max_ms = 0.0
 
     def observe_ms(self, value_ms: float) -> None:
+        self.observe_ms_n(value_ms, 1)
+
+    def observe_ms_n(self, value_ms: float, n: int) -> None:
+        """``n`` identical observations in one bucket write — the
+        batched-delivery paths close one wall clock for a whole tick's
+        frames and must not pay a per-frame loop."""
         i = 0
         for i, bound in enumerate(self.buckets):  # noqa: B007
             if value_ms <= bound:
                 break
         else:
             i = len(self.buckets)
-        self.counts[i] += 1
-        self.total += 1
-        self.sum_ms += value_ms
+        self.counts[i] += n
+        self.total += n
+        self.sum_ms += value_ms * n
         if value_ms > self.max_ms:
             self.max_ms = value_ms
+
+    def merge_counts(self, counts, total: int, sum_ms: float,
+                     max_ms: float) -> None:
+        """Fold externally-accumulated bucket counts in (delivery
+        workers push cumulative histograms over the control channel;
+        the plane diffs consecutive packets and merges the deltas so
+        the series stay monotone across worker restarts). Bucket
+        bounds must match (delivery/worker.py BUCKETS_MS — pinned by
+        test); a shorter/longer list folds positionally."""
+        for i, c in enumerate(counts[: len(self.counts)]):
+            self.counts[i] += c
+        self.total += total
+        self.sum_ms += sum_ms
+        if max_ms > self.max_ms:
+            self.max_ms = max_ms
 
     def quantile(self, q: float) -> float:
         """Upper-bound estimate of the q-quantile from bucket counts.
@@ -116,6 +137,31 @@ class Metrics:
             if hist is None:
                 hist = self.histograms[name] = Histogram()
             hist.observe_ms(value_ms)
+
+    def observe_ms_n(self, name: str, value_ms: float, n: int) -> None:
+        """``n`` identical observations under ONE lock acquisition —
+        the frame clock closes a whole delivery batch at once (up to
+        ``max_batch`` frames); per-frame ``observe_ms`` calls would
+        put a 16K-iteration lock loop on the tick path."""
+        if n <= 0:
+            return
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram()
+            hist.observe_ms_n(value_ms, n)
+
+    def merge_histogram(self, name: str, counts, total: int,
+                        sum_ms: float, max_ms: float) -> None:
+        """Merge histogram DELTAS accumulated in another process (see
+        ``Histogram.merge_counts``). Creating-on-first-merge means a
+        worker's series appears in /metrics from its first stats
+        packet even before it carried traffic."""
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram()
+            hist.merge_counts(counts, total, sum_ms, max_ms)
 
     @contextmanager
     def time_ms(self, name: str):

@@ -242,6 +242,7 @@ class PeerMap:
     async def deliver_batch(
         self,
         pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+        t_ingress_ns: int = 0,
     ) -> int:
         """Deliver a tick's worth of resolved fan-outs.
 
@@ -257,14 +258,19 @@ class PeerMap:
           of one write per delivery.
         Peers whose transport can't take the sync write (saturated, or
         no fast path) fall back to awaited sends in one gather at the
-        end. Returns the number of sends attempted."""
+        end. ``t_ingress_ns`` is the batch's frame-clock stamp
+        (``time.monotonic_ns`` at ticker flush start, 0 = unclocked):
+        both paths close it at delivery completion into the
+        ``frame.e2e_ms`` histogram — the honest dispatch→socket-write
+        fan-out latency. Returns the number of sends attempted."""
         if self._plane is not None:
-            return await self._deliver_batch_planed(pairs)
-        return await self._deliver_batch_local(pairs)
+            return await self._deliver_batch_planed(pairs, t_ingress_ns)
+        return await self._deliver_batch_local(pairs, t_ingress_ns)
 
     async def _deliver_batch_planed(
         self,
         pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+        t_ingress_ns: int = 0,
     ) -> int:
         """Sharded delivery (delivery plane enabled): each message's
         wire bytes are written ONCE into every owning shard's ring with
@@ -299,14 +305,16 @@ class PeerMap:
                     else:
                         local_targets.append(u)
                 if groups:
-                    worker_sends += await plane.deliver(groups)
+                    worker_sends += await plane.deliver(
+                        groups, t_ingress_ns
+                    )
                 if local_targets:
                     local_pairs.append((message, local_targets))
             span.tag(messages=n_msgs, worker_sends=worker_sends)
         n = worker_sends
         if local_pairs:
             # counts its own broadcast.messages/sends for these pairs
-            n += await self._deliver_batch_local(local_pairs)
+            n += await self._deliver_batch_local(local_pairs, t_ingress_ns)
         if self.metrics is not None:
             if n_msgs > len(local_pairs):
                 self.metrics.inc(
@@ -319,7 +327,9 @@ class PeerMap:
     async def _deliver_batch_local(
         self,
         pairs: Iterable[tuple[Message, Iterable[uuid_mod.UUID]]],
+        t_ingress_ns: int = 0,
     ) -> int:
+        t_start_ns = time.monotonic_ns()
         outbox: dict[Peer, list[FramedPayload]] = {}
         n = n_msgs = 0
         for message, uuids in pairs:
@@ -361,6 +371,23 @@ class PeerMap:
             self.metrics.inc("broadcast.sends", n - errors)
             if errors:
                 self.metrics.inc("broadcast.send_errors", errors)
+            # e2e stamps, closed at batch completion (the slow-path
+            # drain included — fast-path frames already sat in their
+            # transport buffers by then, so this is the conservative
+            # close). One batched histogram write per series, not one
+            # per frame — the lock must not ride the 16K-frame loop.
+            # delivery.e2e_ms mirrors the worker-side ring-write→
+            # write-complete stamp so the two pump variants compare.
+            now_ns = time.monotonic_ns()
+            if n_msgs:
+                self.metrics.observe_ms_n(
+                    "delivery.e2e_ms", (now_ns - t_start_ns) / 1e6, n_msgs
+                )
+                if t_ingress_ns:
+                    self.metrics.observe_ms_n(
+                        "frame.e2e_ms", (now_ns - t_ingress_ns) / 1e6,
+                        n_msgs,
+                    )
         return n
 
     async def broadcast_all(self, message: Message) -> None:

@@ -19,6 +19,7 @@ message, never the server.
 from __future__ import annotations
 
 import logging
+import time
 import uuid as uuid_mod
 
 from ..durability.pipeline import DurabilityPipeline
@@ -222,11 +223,24 @@ class Router:
             replication=message.replication,
         )
         if self.ticker is not None:
+            # frame clock for batched mode opens at ticker flush start
+            # (engine/ticker.py) — the accumulation window is a config
+            # choice, not pipeline latency
             await self.ticker.enqueue(message, query)
             return
+        # Immediate mode: the frame clock spans this handler's own
+        # resolve + broadcast — the same dispatch→write-complete window
+        # the ticker path reports, so frame.e2e_ms is comparable across
+        # tick_interval settings.
+        t_ingress_ns = time.monotonic_ns()
         [targets] = self.backend.match_local_batch([query])
         if targets:
             await self.peer_map.broadcast_to(message, targets)
+            if self.metrics is not None:
+                self.metrics.observe_ms(
+                    "frame.e2e_ms",
+                    (time.monotonic_ns() - t_ingress_ns) / 1e6,
+                )
 
     async def _global_message(self, message: Message) -> None:
         sender = message.sender_uuid

@@ -112,6 +112,19 @@ class WorldQLServer:
             self.tracer.on_trace = self.recorder.record
         if hasattr(self.backend, "_note_failure"):  # ResilientBackend
             self.backend.metrics = self.metrics
+        # Device telemetry (observability/device.py): compile/retrace
+        # counters + loose spans, per-tick encode/h2d/compute/d2h
+        # split, live buffer gauge. Only for backends with a device
+        # side (device_stats); the CPU reference keeps its zero-cost
+        # path.
+        self.device_telemetry = None
+        if config.device_telemetry and hasattr(self.backend, "device_stats"):
+            from ..observability.device import DeviceTelemetry
+
+            self.device_telemetry = DeviceTelemetry(
+                metrics=self.metrics, tracer=self.tracer,
+                backend=self.backend,
+            ).install()
         # Escalation contract: when a CRITICAL supervised task (ticker
         # pump, ZMQ recv loop, durability applier) exhausts its restart
         # budget the server requests its own clean shutdown — a broker
@@ -139,6 +152,12 @@ class WorldQLServer:
                 tracer=self.tracer,
                 on_peer_lost=self._on_delivery_peer_lost,
             )
+            if self.recorder is not None:
+                # worker-plane trace stitching: /debug/ticks grafts the
+                # workers' ring-dwell + write-time spans under
+                # tick.deliver so one tick trace explains the fan-out
+                # tail end-to-end
+                self.recorder.stitcher = self.delivery_plane.stitch
         self._delivery_evictions: set = set()
         self.peer_map = PeerMap(
             on_remove=self._on_peer_remove, metrics=self.metrics,
@@ -152,6 +171,7 @@ class WorldQLServer:
                 self.backend, self.peer_map, config.tick_interval,
                 metrics=self.metrics, pipeline=config.tick_pipeline,
                 supervisor=self.supervisor, tracer=self.tracer,
+                device_telemetry=self.device_telemetry,
             )
         # Durability engine: WAL + write-behind pipeline. With
         # durability='off' (default) both stay None and the Router's
@@ -235,6 +255,8 @@ class WorldQLServer:
                     f"delivery.worker.{i}",
                     lambda i=i: self.delivery_plane.worker_stats(i),
                 )
+        if self.device_telemetry is not None:
+            self.metrics.gauge("device", self.device_telemetry.stats)
         if self.recorder is not None:
             self.metrics.gauge("flight_recorder", self.recorder.stats)
         if self.loop_monitor is not None:
@@ -266,11 +288,22 @@ class WorldQLServer:
 
     def delivery_status(self) -> dict | None:
         """Delivery-plane state for /healthz (worker liveness, restart
-        and drop counts); None with --delivery-workers 0."""
+        and drop counts, per-worker stats freshness); None with
+        --delivery-workers 0. A worker whose stats push went silent
+        for 3 control-channel intervals counts as degraded — a
+        wedged-but-alive drain loop must not look healthy."""
         if self.delivery_plane is None:
             return None
         status = self.delivery_plane.stats()
         status["degraded"] = self.delivery_plane.degraded()
+        status["stats_age_s"] = {
+            str(i): (
+                round(age, 3)
+                if (age := self.delivery_plane.stats_age_s(i)) is not None
+                else None
+            )
+            for i in range(self.config.delivery_workers)
+        }
         return status
 
     def durability_status(self) -> dict | None:
@@ -544,6 +577,8 @@ class WorldQLServer:
                 await handle.stop()
         if self.loop_monitor is not None:
             self.loop_monitor.uninstall()
+        if self.device_telemetry is not None:
+            self.device_telemetry.uninstall()
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:

@@ -56,12 +56,19 @@ class TickBatcher:
         pipeline: int = 1,
         supervisor=None,
         tracer: Tracer | None = None,
+        device_telemetry=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        # Optional observability.device.DeviceTelemetry: after each
+        # collect it tags the tick trace with the device timing split
+        # (encode/h2d/compute/d2h) and polls the retrace GUARD so a
+        # capacity-tier first hit surfaces as a counter + loose span
+        # the same tick it happened.
+        self._device_telemetry = device_telemetry
         # Span tracing (observability/): every flush opens a "tick"
         # trace whose stage spans the flight recorder ring-buffers.
         # A disabled (or absent) tracer hands back shared null objects
@@ -158,6 +165,10 @@ class TickBatcher:
             if batch:
                 trace = self._begin_trace(len(batch))
                 t0 = time.perf_counter()
+                # frame clock: opened at flush start (the accumulation
+                # window is a config choice, not pipeline latency),
+                # closed at delivery completion on whichever path
+                t_ingress_ns = time.monotonic_ns()
                 with trace.span("tick.dispatch"):
                     handle = self.backend.dispatch_local_batch(
                         [query for _, query in batch]
@@ -168,7 +179,7 @@ class TickBatcher:
                             "tick.dispatch_ms", self.last_dispatch_ms
                         )
                 stage = self._collect_deliver(
-                    batch, handle, self._tail, t0, trace
+                    batch, handle, self._tail, t0, trace, t_ingress_ns
                 )
                 if self._sup is not None:
                     task = self._sup.spawn_transient("tick-collect", stage)
@@ -187,19 +198,22 @@ class TickBatcher:
             await self._await_quiet(self._inflight[0])
             self._reap()
 
-    async def _collect_deliver(self, batch, handle, prev, t0, trace) -> None:
+    async def _collect_deliver(self, batch, handle, prev, t0, trace,
+                               t_ingress_ns: int = 0) -> None:
         """Stage 2 of a pipelined tick: device collect (worker thread),
         then — strictly after tick N-1's stage finished — the batched
         delivery. Handles its own errors (a failed collect drops only
         ITS batch; the next tick's stage runs untouched) and is never
         cancelled by stop(), which awaits the chain instead."""
         try:
-            await self._collect_deliver_inner(batch, handle, prev, t0, trace)
+            await self._collect_deliver_inner(
+                batch, handle, prev, t0, trace, t_ingress_ns
+            )
         finally:
             trace.finish()  # idempotent; seals drop/error paths too
 
     async def _collect_deliver_inner(
-        self, batch, handle, prev, t0, trace
+        self, batch, handle, prev, t0, trace, t_ingress_ns: int = 0
     ) -> None:
         targets = None
         try:
@@ -237,7 +251,7 @@ class TickBatcher:
                     (message, tgts)
                     for (message, _), tgts in zip(batch, targets)
                     if tgts
-                ])
+                ], t_ingress_ns)
             )
             td = time.perf_counter()
             # same shield-and-re-await discipline as the sequential
@@ -301,6 +315,7 @@ class TickBatcher:
                 return
             trace = self._begin_trace(len(batch))
             t0 = time.perf_counter()
+            t_ingress_ns = time.monotonic_ns()  # frame clock (see above)
 
             dispatched = False
             deliver_task = None
@@ -340,7 +355,7 @@ class TickBatcher:
                         (message, tgts)
                         for (message, _), tgts in zip(batch, targets)
                         if tgts
-                    ])
+                    ], t_ingress_ns)
                 )
                 with trace.span("tick.deliver"):
                     await asyncio.shield(deliver_task)
@@ -418,17 +433,25 @@ class TickBatcher:
         Backends without the stats (CPU reference) are silently
         skipped."""
         stats = getattr(self.backend, "last_collect_stats", None)
-        if not stats:
-            return
-        self.last_compaction_bucket = int(stats.get("compaction_bucket", 0))
-        if self.metrics is not None:
-            self.metrics.inc(
-                "tick.fetch_bytes", int(stats.get("fetch_bytes", 0))
+        if stats:
+            self.last_compaction_bucket = int(
+                stats.get("compaction_bucket", 0)
             )
-            self.metrics.set_gauge(
-                "tick.compaction_bucket", self.last_compaction_bucket
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "tick.fetch_bytes", int(stats.get("fetch_bytes", 0))
+                )
+                self.metrics.set_gauge(
+                    "tick.compaction_bucket", self.last_compaction_bucket
+                )
+            trace.tag(
+                fetch_bytes=int(stats.get("fetch_bytes", 0)),
+                compaction_bucket=self.last_compaction_bucket,
             )
-        trace.tag(
-            fetch_bytes=int(stats.get("fetch_bytes", 0)),
-            compaction_bucket=self.last_compaction_bucket,
-        )
+        if self._device_telemetry is not None:
+            # device timing split onto the tick root + retrace poll;
+            # diagnostics must never cost the tick
+            try:
+                self._device_telemetry.on_tick(trace)
+            except Exception:
+                logger.exception("device telemetry tick hook failed")

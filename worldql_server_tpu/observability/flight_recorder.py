@@ -60,6 +60,14 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self.ticks_recorded = 0
         self.slow_ticks = 0
+        #: optional cross-process stitcher: callable(trace) → list of
+        #: extra span dicts appended to the trace's snapshot. The
+        #: delivery plane hooks this (DeliveryPlane.stitch) to graft
+        #: worker-side ``delivery.worker_flush`` spans under
+        #: ``tick.deliver`` — worker segments arrive over the control
+        #: channel AFTER the trace seals, so stitching happens at
+        #: export time, not record time.
+        self.stitcher = None
 
     @property
     def dump_path(self) -> str:
@@ -120,9 +128,23 @@ class FlightRecorder:
     # region: introspection (HTTP debug surface + tests)
 
     def snapshot(self) -> list[dict]:
-        """Tick traces, oldest first."""
+        """Tick traces, oldest first — with any stitcher-provided
+        cross-process spans grafted in (a broken stitcher degrades the
+        snapshot to parent-side spans, never breaks the endpoint)."""
         with self._lock:
-            return [t.as_dict() for t in self._ticks]
+            ticks = list(self._ticks)
+        out = []
+        for t in ticks:
+            d = t.as_dict()
+            if self.stitcher is not None:
+                try:
+                    extra = self.stitcher(t)
+                    if extra:
+                        d["spans"] = d["spans"] + extra
+                except Exception:
+                    logger.exception("trace stitcher failed")
+            out.append(d)
+        return out
 
     def loose_snapshot(self) -> list[dict]:
         with self._lock:

@@ -242,6 +242,20 @@ class FailpointRegistry:
     def fired(self, name: str) -> int:
         return self._fired_total.get(name, 0)
 
+    def note_remote_fires(self, deltas: dict) -> None:
+        """Fold fire counts observed in ANOTHER process into the audit
+        total. Delivery workers arm their own per-process registry
+        (the spec rides the spawn args) and report cumulative fires
+        over the control channel; the plane diffs consecutive packets
+        and folds the deltas here, so the ``failpoints`` gauge audits
+        the whole plane — a fault injected in a sender worker is never
+        invisible to the parent's accounting."""
+        for name, n in deltas.items():
+            if isinstance(n, int) and n > 0:
+                self._fired_total[name] = (
+                    self._fired_total.get(name, 0) + n
+                )
+
     def fired_counts(self) -> dict[str, int]:
         """{failpoint: total fires} — the ``failpoints`` metrics gauge.
         Includes disarmed points that fired earlier, so a chaos run's

@@ -53,7 +53,7 @@ import logging
 import threading
 import time
 import uuid as uuid_mod
-from collections import Counter
+from collections import Counter, deque
 from functools import partial
 from typing import Sequence
 
@@ -921,6 +921,22 @@ class TpuSpatialBackend(SpatialBackend):
         self.last_collect_stats = {
             "fetch_slots": 0, "fetch_bytes": 0, "compaction_bucket": 0,
         }
+        # Per-tick device timing split (ISSUE 7): dispatch appends
+        # {encode, h2d-enqueue, d2h-prefetch} walls; collect pops in
+        # dispatch order (the tick pipeline chains its collect stages,
+        # so FIFO pairing holds even at depth > 1), adds the device
+        # wait + fetch walls, and publishes the merged dict as
+        # ``last_device_timing`` for DeviceTelemetry to tag onto the
+        # tick trace. These are HOST-side brackets of the existing
+        # instrumentation points, not profiler truth — on a tunneled
+        # device the "compute" wall includes the link.
+        self._dispatch_timings: deque = deque()
+        self._timing_lock = threading.Lock()
+        self._last_prefetch_ms = 0.0
+        self.last_device_timing: dict = {}
+        #: capacity tier of the LAST dispatch (retrace spans tag it —
+        #: a tier first-hit is the expected compile trigger)
+        self.last_dispatch_tier: dict = {}
 
         # pid → base rows: lazily built per base epoch (argsort of the
         # peer column, O(S log S) once), then each eviction is two
@@ -2134,10 +2150,15 @@ class TpuSpatialBackend(SpatialBackend):
             # would ship the O(cap) bytes the compaction exists to
             # avoid)
             prefetch = (result[0], result[2])
+        t_pf = time.perf_counter()
         for r in prefetch:
             copy = getattr(r, "copy_to_host_async", None)
             if copy is not None:
                 copy()
+        # D2H-prefetch enqueue wall, folded into the device timing
+        # split by dispatch_local_batch (the enqueue is async — the
+        # transfer itself lands inside the collect-side fetch wall)
+        self._last_prefetch_ms = (time.perf_counter() - t_pf) * 1e3
         return result
 
     def _query_cap(self, m: int) -> int:
@@ -2206,6 +2227,7 @@ class TpuSpatialBackend(SpatialBackend):
         m = len(queries)
         if m == 0:
             return (0, None)
+        t_start = time.perf_counter()
         world_ids = np.fromiter(
             (self._world_ids.get(q.world, -1) for q in queries),
             dtype=np.int32, count=m,
@@ -2227,6 +2249,9 @@ class TpuSpatialBackend(SpatialBackend):
         qtuple = self._prepare_queries(
             world_ids, positions, sender_ids, repls
         )
+        # host-encode wall: UUID/world interning + quantize/hash/pad
+        # (index flush included — it runs on this thread either way)
+        t_encoded = time.perf_counter()
         # CSR delivery: the result ships ~total ints instead of a dense
         # [M, K] table (K is set by the hottest cube). The capacity
         # hint adapts to the observed fan-out. m * sum(K) is the true
@@ -2241,11 +2266,38 @@ class TpuSpatialBackend(SpatialBackend):
             # zone-A floor: one identity row per (padded query, segment)
             CSR_ROW * self._query_cap(m) * len(segs) + 64,
         )), qtuple, segs)
+        self.last_dispatch_tier = {
+            "t_cap": t_cap, "query_cap": self._query_cap(m),
+            "segments": len(segs),
+        }
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
+            self._push_timing(t_start, t_encoded, path="dense")
             return (m, ("dense", tgt))
         result = self._launch(qtuple, segs, ks, kinds, csr_cap=t_cap)
+        self._push_timing(t_start, t_encoded, path="csr")
         return (m, ("csr", t_cap, result, (qtuple, segs, ks, kinds)))
+
+    def _push_timing(self, t_start: float, t_encoded: float,
+                     path: str) -> None:
+        """Record this dispatch's host-side timing legs for the collect
+        side to merge (FIFO — collects run in dispatch order)."""
+        now = time.perf_counter()
+        with self._timing_lock:
+            self._dispatch_timings.append({
+                "encode_ms": (t_encoded - t_start) * 1e3,
+                # launch wall: H2D enqueue + kernel dispatch (async on
+                # a real device, so this is queue time, not compute)
+                "h2d_ms": (now - t_encoded) * 1e3
+                - self._last_prefetch_ms,
+                "d2h_enqueue_ms": self._last_prefetch_ms,
+                "path": path,
+            })
+
+    def _pop_timing(self) -> dict:
+        with self._timing_lock:
+            return self._dispatch_timings.popleft() \
+                if self._dispatch_timings else {}
 
     def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
         """Wait for a dispatched batch and decode fan-out UUID lists.
@@ -2256,11 +2308,21 @@ class TpuSpatialBackend(SpatialBackend):
         m, payload = handle
         if payload is None:
             return [[] for _ in range(m)]
+        timing = self._pop_timing()
         if payload[0] == "dense":
             # collect_local_batch IS the tick's designated sync point:
             # it runs on the worker thread while the loop keeps serving
             # transports, so these converts block nothing but the tick.
+            t_wait = time.perf_counter()
             tgt = np.asarray(payload[1])[:m]  # wql: allow(jax-host-sync, full-fetch-on-tick) — dense ceiling path
+            # dense fetch = one blocking convert: device wait and D2H
+            # are indivisible here, so the whole wall lands in
+            # compute_ms (tagged by path so readers know)
+            timing.update(
+                compute_ms=(time.perf_counter() - t_wait) * 1e3,
+                d2h_ms=0.0,
+            )
+            self.last_device_timing = timing
             self._note_fetch(int(tgt.size), 0)
             counts, flat = _dense_to_csr(tgt)
             # the hint must keep adapting here too, or a flash-crowd
@@ -2269,7 +2331,12 @@ class TpuSpatialBackend(SpatialBackend):
             self._adapt_delivery_cap(counts, grow=False)
             return self._decode_csr(counts, flat, m)
         _, t_cap, (counts, flat, total), ctx = payload
+        t_wait = time.perf_counter()
         total = int(total)  # wql: allow(jax-host-sync) — collect point
+        # the total is the tick's designated device-wait point: the
+        # scalar is only readable once the batch finished, so this
+        # wall is the compute leg (plus the link, on tunneled devices)
+        timing["compute_ms"] = (time.perf_counter() - t_wait) * 1e3
         if total > t_cap:
             # Rare: the tick's fan-out outgrew the hint — re-resolve
             # dense against the same index snapshot and raise the hint
@@ -2282,27 +2349,35 @@ class TpuSpatialBackend(SpatialBackend):
                 self._delivery_cap,
             )
             qtuple, segs, ks, kinds = ctx
+            t_fetch = time.perf_counter()
             tgt = np.asarray(  # wql: allow(jax-host-sync, full-fetch-on-tick) — overflow re-resolve
                 self._dispatch(qtuple, segs, ks, kinds)
             )[:m]
+            timing.update(
+                d2h_ms=(time.perf_counter() - t_fetch) * 1e3,
+                path="overflow",
+            )
+            self.last_device_timing = timing
             self._note_fetch(int(tgt.size), 0)
             return self._decode_csr(*_dense_to_csr(tgt), m)
         # counts stays UNTRIMMED: padding queries resolve 0 rows, and
         # the sharded decode needs the full padded layout to locate
         # its per-batch-shard flat regions
+        t_fetch = time.perf_counter()
         counts = np.asarray(counts)  # wql: allow(jax-host-sync) — collect
         self._adapt_delivery_cap(counts, grow=True)
         packed = self._compact_fetch(
             payload[2][0], flat, total, t_cap
         )
         if packed is not None:
+            timing["d2h_ms"] = (time.perf_counter() - t_fetch) * 1e3
+            self.last_device_timing = timing
             return self._decode_packed(counts, packed, m)
         self._note_fetch(t_cap, 0)
-        return self._decode_csr(
-            counts,
-            np.asarray(flat),  # wql: allow(jax-host-sync, full-fetch-on-tick) — compaction fallback (small tick / no 2x win / shard imbalance)
-            m,
-        )
+        flat_host = np.asarray(flat)  # wql: allow(jax-host-sync, full-fetch-on-tick) — compaction fallback (small tick / no 2x win / shard imbalance)
+        timing["d2h_ms"] = (time.perf_counter() - t_fetch) * 1e3
+        self.last_device_timing = timing
+        return self._decode_csr(counts, flat_host, m)
 
     def _compact_applicable(self, t_cap: int) -> bool:
         """Whether a tick at this capacity tier is worth compacting:
