@@ -13,6 +13,17 @@
 //   wql_spatial_abi() -> 1
 //   wql_query_keys(pos[n*3] f64, world_ids[n] i32, n, cube_size,
 //                  seed1, seed2, keys1[n] i64 out, keys2[n] i64 out)
+//   wql_encode_queries(pos[n*3] f64, world_ids[n] i32, senders[n] i32,
+//                      repls[n] i8, n, cap, cube_size, seed1, seed2,
+//                      keys1[cap] i64 out, keys2[cap] i64 out,
+//                      senders_out[cap] i32, repls_out[cap] i8)
+//     — the fused batch encode: quantize + both hashes + capacity-tier
+//     padding straight into the dispatch-ready layout, one pass, no
+//     Python-side intermediates (ctypes releases the GIL for the call).
+//     Padding lanes mirror spatial/hashing.py: key1 = PAD_KEY
+//     (2^63 - 1), key2 = QUERY_PAD_KEY2 (1), sender = -1, repl = 0 —
+//     parity with the numpy twin is pinned lane-for-lane by
+//     tests/test_native_keys.py, padding included.
 
 #include <cmath>
 #include <cstdint>
@@ -101,6 +112,29 @@ void wql_query_keys(const double* pos, const int32_t* world_ids, int64_t n,
         static_cast<uint64_t>(static_cast<int64_t>(world_ids[i]));
     keys1[i] = chain(h1, w, cx, cy, cz);
     keys2[i] = chain(h2, w, cx, cy, cz);
+  }
+}
+
+// hashing.py twins: PAD_KEY / QUERY_PAD_KEY2 (see header comment)
+constexpr int64_t PAD_KEY = INT64_MAX;
+constexpr int64_t QUERY_PAD_KEY2 = 1;
+
+void wql_encode_queries(const double* pos, const int32_t* world_ids,
+                        const int32_t* senders, const int8_t* repls,
+                        int64_t n, int64_t cap, int64_t cube_size,
+                        uint64_t seed1, uint64_t seed2, int64_t* keys1,
+                        int64_t* keys2, int32_t* senders_out,
+                        int8_t* repls_out) {
+  wql_query_keys(pos, world_ids, n, cube_size, seed1, seed2, keys1, keys2);
+  for (int64_t i = 0; i < n; ++i) {
+    senders_out[i] = senders[i];
+    repls_out[i] = repls[i];
+  }
+  for (int64_t i = n; i < cap; ++i) {
+    keys1[i] = PAD_KEY;
+    keys2[i] = QUERY_PAD_KEY2;
+    senders_out[i] = -1;
+    repls_out[i] = 0;
   }
 }
 

@@ -67,3 +67,61 @@ def test_main_fail_flag_gates_on_regressions(tmp_path, capsys):
     # under threshold: clean even with --fail
     new.write_text(json.dumps({"config": 5, "p99_ms": 10.5}))
     assert main([str(old), str(new), "--fail", "--threshold", "10"]) == 0
+
+
+def test_min_abs_noise_floor(tmp_path):
+    """The CI perf gate's noise floor: sub-floor timing jitter never
+    flags, but a structural counter crossing the floor (retraces
+    0 → 1 is the canonical case) still does."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({
+        "config": 5, "encode_ms": 0.2, "device": {"retraces": 0},
+    }))
+    new.write_text(json.dumps({
+        "config": 5, "encode_ms": 0.8, "device": {"retraces": 2},
+    }))
+    # 0.2 → 0.8 ms is +300% but both sit under the 1.0 floor: noise
+    # retraces 0 → 2 crosses the floor: still a regression
+    assert main([
+        str(old), str(new), "--fail", "--threshold", "100",
+        "--min-abs", "1.0",
+    ]) == 1
+    new.write_text(json.dumps({
+        "config": 5, "encode_ms": 0.8, "device": {"retraces": 0},
+    }))
+    assert main([
+        str(old), str(new), "--fail", "--threshold", "100",
+        "--min-abs", "1.0",
+    ]) == 0
+    # no floor: the same timing jitter fails
+    assert main([
+        str(old), str(new), "--fail", "--threshold", "100",
+    ]) == 1
+
+
+def test_perf_gate_fails_on_regression_against_checked_in_baseline(
+    tmp_path,
+):
+    """The ISSUE 8 acceptance demo: the CI gate invocation (checked-in
+    smoke baseline + --fail --threshold --min-abs) goes red when a
+    bench round regresses a real metric, and stays green against
+    itself."""
+    import copy
+    from pathlib import Path
+
+    baseline = (
+        Path(__file__).resolve().parent.parent
+        / "tools" / "bench_smoke_baseline.json"
+    )
+    assert baseline.exists(), "checked-in smoke baseline missing"
+    gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
+    assert main([str(baseline), str(baseline), *gate]) == 0
+
+    rec = json.loads(baseline.read_text())
+    bad = copy.deepcopy(rec)
+    bad["engine_p99_ms"] = rec["engine_p99_ms"] * 3 + 10  # > 2x, > floor
+    bad["device"]["retraces"] = 1
+    regressed = tmp_path / "regressed.json"
+    regressed.write_text(json.dumps(bad))
+    assert main([str(baseline), str(regressed), *gate]) == 1

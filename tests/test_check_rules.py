@@ -824,7 +824,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 12
+    assert len(names) >= 13
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -834,6 +834,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-jit-in-loop",
         "jax-traced-branch",
         "full-fetch-on-tick",
+        "per-query-python-loop",
         "store-on-loop",
         "unspanned-stage",
         "wire-mutable-buffer",
@@ -852,3 +853,101 @@ def test_cli_exit_codes(tmp_path):
     assert main([str(bad)]) == 1
     assert main(["--select", "no-such-rule", str(good)]) == 2
     assert main(["--list-rules"]) == 0
+
+
+# region: per-query-python-loop
+
+
+_SPATIAL = "worldql_server_tpu/spatial/somebackend.py"
+
+
+def test_per_query_loop_fires_on_for_loop_over_queries():
+    src = """
+    class B:
+        def dispatch_local_batch(self, queries):
+            out = []
+            for q in queries:
+                out.append(q.world)
+            return out
+    """
+    assert violations(
+        src, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == [("per-query-python-loop", 5)]
+
+
+def test_per_query_loop_fires_on_fromiter_generator_and_enumerate():
+    src = """
+    import numpy as np
+
+    class B:
+        def dispatch_local_batch(self, queries):
+            wids = np.fromiter(
+                (self._world_ids.get(q.world, -1) for q in queries),
+                dtype=np.int32,
+            )
+            for i, q in enumerate(queries):
+                self._pos[i] = q.position
+            return wids
+    """
+    got = violations(src, relpath=_SPATIAL, select="per-query-python-loop")
+    assert len(got) == 2  # the genexp AND the enumerate loop
+
+
+def test_per_query_loop_fires_on_list_comprehension():
+    src = """
+    class B:
+        def match_local_batch(self, queries):
+            return [self._one(q) for q in queries]
+    """
+    assert rules_fired(
+        src, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == {"per-query-python-loop"}
+
+
+def test_per_query_loop_quiet_outside_dispatch_path_and_spatial():
+    decode_loop = """
+    class B:
+        def _decode_csr(self, queries):
+            return [q for q in queries]
+    """
+    # same file, non-dispatch function: fine (decode walks RESULTS)
+    assert violations(
+        decode_loop, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == []
+    dispatch_elsewhere = """
+    class B:
+        def dispatch_local_batch(self, queries):
+            return [q for q in queries]
+    """
+    # dispatch-path function OUTSIDE spatial/*: other rules' turf
+    assert violations(
+        dispatch_elsewhere,
+        relpath="worldql_server_tpu/engine/router.py",
+        select="per-query-python-loop",
+    ) == []
+    other_iterable = """
+    class B:
+        def dispatch_local_batch(self, queries):
+            return [s for s in self._segments()]
+    """
+    # iterating something that isn't the query batch: fine
+    assert violations(
+        other_iterable, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == []
+
+
+def test_per_query_loop_pragma_allows_designated_paths():
+    src = """
+    class B:
+        def match_local_batch(self, queries):
+            out = []
+            for q in queries:  # wql: allow(per-query-python-loop)
+                out.append(self._one(q))
+            return out
+    """
+    assert violations(
+        src, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == []
+
+
+# endregion

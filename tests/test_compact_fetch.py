@@ -206,7 +206,7 @@ def test_overflow_still_falls_back_dense_with_compaction_on():
 
     b._delivery_cap = 1
     handle = b.dispatch_local_batch(queries)
-    _, (kind, t_cap, (_, _, total), _) = handle
+    _, (kind, t_cap, (_, _, total), _), _ = handle
     assert kind == "csr" and int(total) > t_cap
     assert [sorted(g, key=str) for g in b.collect_local_batch(handle)] == want
 
@@ -305,7 +305,7 @@ def test_sharded_imbalance_past_headroom_falls_back_full_fetch():
         for p in qpos
     ]
     handle = b.dispatch_local_batch(queries)
-    _, payload = handle
+    _, payload, _ = handle
     assert payload[0] == "csr"
     _, t_cap, (counts, flat, total), _ = payload
     total = int(total)

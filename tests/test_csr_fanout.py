@@ -242,7 +242,7 @@ def test_delivery_path_uses_csr_and_falls_back_dense_on_overflow():
     # dense fallback at collect time
     b._delivery_cap = 1
     handle = b.dispatch_local_batch(queries)
-    _, (kind, t_cap, (_, _, total), _) = handle
+    _, (kind, t_cap, (_, _, total), _), _ = handle
     assert kind == "csr"
     assert int(total) > t_cap  # really overflowed
     got = got_lists(b.collect_local_batch(handle))
@@ -473,7 +473,7 @@ def test_sharded_between_caps_total_decodes_without_dense_reresolve():
     ]
 
     handle = b.dispatch_local_batch(queries)
-    _, payload = handle
+    _, payload, _ = handle
     assert payload[0] == "csr", "floors must not reach the dense ceiling"
     recorded_cap = payload[1]
     total = int(payload[2][2])

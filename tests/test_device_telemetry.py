@@ -131,19 +131,53 @@ def test_per_tick_device_timing_split_reaches_trace_and_metrics():
         tel.uninstall()
 
 
-def test_timing_fifo_pairs_across_pipelined_dispatches():
-    """Two dispatches in flight (tick pipeline): each collect pops its
-    OWN dispatch's timing — the deque pairs FIFO."""
+def test_timing_pairs_across_pipelined_dispatches():
+    """Two dispatches in flight (tick pipeline): each collect merges
+    its OWN dispatch's timing — the dict rides the handle, so pairing
+    is structural at any depth. query_cap tags make the pairing
+    observable (1 query → tier 8; 9 queries → tier 16)."""
     backend = make_backend()
     q = LocalQuery("w", POS, backend._sender, Replication.EXCEPT_SELF)
     h1 = backend.dispatch_local_batch([q])
-    h2 = backend.dispatch_local_batch([q, q])
-    assert len(backend._dispatch_timings) == 2
-    backend.collect_local_batch(h1)
-    assert len(backend._dispatch_timings) == 1
+    h2 = backend.dispatch_local_batch([q] * 9)
+    # out-of-order collect: attribution must still be per-handle
     backend.collect_local_batch(h2)
-    assert len(backend._dispatch_timings) == 0
+    assert backend.last_device_timing["query_cap"] == 16
+    backend.collect_local_batch(h1)
+    assert backend.last_device_timing["query_cap"] == 8
     assert "compute_ms" in backend.last_device_timing
+    assert backend.last_device_timing["staged"] is False
+
+
+def test_timing_stays_paired_when_a_collect_errors_and_drops_its_tick():
+    """ISSUE 8 satellite regression: under pipeline depth > 1, a
+    collect that errors (its tick dropped) must NOT desync the
+    dispatch-timing pairing — the old FIFO deque silently attributed
+    tick N's encode/h2d split to tick N+1 after an error fired before
+    the pop (e.g. a backend.collect failpoint in ResilientBackend)."""
+    from worldql_server_tpu.robustness import failpoints
+    from worldql_server_tpu.robustness.resilient import ResilientBackend
+
+    inner = TpuSpatialBackend(16)
+    backend = ResilientBackend(inner, failover_after=100)
+    a, b = uuid_mod.uuid4(), uuid_mod.uuid4()
+    # mutations through the wrapper so the mirror can degrade-resolve
+    backend.add_subscription("w", a, POS)
+    backend.add_subscription("w", b, POS)
+    q = LocalQuery("w", POS, a, Replication.EXCEPT_SELF)
+    h1 = backend.dispatch_local_batch([q])
+    h2 = backend.dispatch_local_batch([q] * 9)
+    failpoints.registry.configure("backend.collect=error:1:x1")
+    try:
+        # h1's collect dies at the failpoint BEFORE the inner collect —
+        # its timing must die with its handle, not leak to h2
+        out1 = backend.collect_local_batch(h1)
+        assert out1 == [[b]]  # mirror-degraded result, still correct
+        backend.collect_local_batch(h2)
+        assert inner.last_device_timing["query_cap"] == 16, \
+            "collect error desynced dispatch-timing attribution"
+    finally:
+        failpoints.registry.clear()
 
 
 def test_live_buffer_gauge_and_stats():

@@ -82,3 +82,78 @@ def test_query_keys_dispatches_to_native(native):
     got = native_keys.query_keys(wid, pos, 16, 1)
     want = numpy_query_keys(wid, pos, 16, 1)
     assert (got[0] == want[0]).all() and (got[1] == want[1]).all()
+
+
+# region: fused batch encode (ISSUE 8 — wql_encode_queries)
+
+
+def _pure_numpy_encode(world_ids, pos, senders, repls, cap, cube_size,
+                       seed):
+    """Twin of native_keys.numpy_encode_queries that NEVER touches the
+    native lib (numpy_query_keys, then pad) — the parity oracle."""
+    from worldql_server_tpu.spatial.hashing import (
+        PAD_KEY, QUERY_PAD_KEY2, pad_to,
+    )
+
+    k1, k2 = numpy_query_keys(world_ids, pos, cube_size, seed)
+    return (
+        pad_to(k1, cap, PAD_KEY),
+        pad_to(k2, cap, QUERY_PAD_KEY2),
+        pad_to(np.asarray(senders, np.int32), cap, np.int32(-1)),
+        pad_to(np.asarray(repls, np.int8), cap, np.int8(0)),
+    )
+
+
+@pytest.mark.parametrize("cube_size", [10, 16])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_encode_queries_matches_numpy_lane_for_lane(native, cube_size,
+                                                    seed):
+    """The fused batch encode (quantize + hash + capacity-tier pad in
+    one GIL-releasing pass) is bit-exact with the composed numpy path
+    on EVERY lane — encoded and padding alike — across the quantizer
+    edge cases."""
+    rng = np.random.default_rng(5)
+    for world_ids, pos in batches():
+        n = len(world_ids)
+        senders = rng.integers(-1, 1000, n).astype(np.int32)
+        repls = rng.integers(0, 3, n).astype(np.int8)
+        for cap in (n, 1 << (n - 1).bit_length() if n > 1 else 1,
+                    2 * n + 3):
+            got = native.encode(
+                world_ids, pos, senders, repls, cap, cube_size, seed
+            )
+            assert got is not None, "fused encode symbol missing"
+            want = _pure_numpy_encode(
+                world_ids, pos, senders, repls, cap, cube_size, seed
+            )
+            for g, w, name in zip(
+                got, want, ("keys1", "keys2", "senders", "repls")
+            ):
+                assert g.dtype == w.dtype, name
+                bad = np.flatnonzero(g != w)
+                assert bad.size == 0, (
+                    f"{name} diverges at lanes {bad[:5]} (cap={cap})"
+                )
+
+
+def test_encode_queries_public_path_and_fallback(native):
+    """encode_queries dispatches to the fused kernel when present and
+    the composed path agrees; column-length mismatches fail loudly
+    instead of reading past the buffer."""
+    rng = np.random.default_rng(11)
+    pos = rng.uniform(-500, 500, (37, 3))
+    wid = rng.integers(0, 4, 37).astype(np.int32)
+    sid = rng.integers(-1, 64, 37).astype(np.int32)
+    rep = rng.integers(0, 3, 37).astype(np.int8)
+    got = native_keys.encode_queries(wid, pos, sid, rep, 64, 16, 1)
+    want = native_keys.numpy_encode_queries(wid, pos, sid, rep, 64, 16, 1)
+    for g, w in zip(got, want):
+        assert (g == w).all()
+    assert len(got[0]) == 64 and got[0][-1] == np.iinfo(np.int64).max
+    with pytest.raises(ValueError):
+        native.encode(wid, pos, sid[:5], rep, 64, 16, 1)
+    with pytest.raises(ValueError):
+        native.encode(wid, pos, sid, rep, 10, 16, 1)  # cap < n
+
+
+# endregion

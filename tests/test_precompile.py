@@ -1,0 +1,113 @@
+"""Boot-time tier precompilation (spatial/precompile.py, ISSUE 8):
+the warmup must cover every kernel shape serving can reach, so the
+retrace GUARD sees ZERO new variants afterward — and the server wiring
+must run it for device backends only."""
+
+import uuid
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from worldql_server_tpu.engine.config import Config              # noqa: E402
+from worldql_server_tpu.spatial.precompile import (              # noqa: E402
+    precompile_tiers, query_cap_ladder,
+)
+from worldql_server_tpu.spatial.tpu_backend import (             # noqa: E402
+    TpuSpatialBackend,
+)
+from worldql_server_tpu.utils.retrace import GUARD               # noqa: E402
+
+#: distinct sub-count from every other suite, so this module's segment
+#: shapes compile fresh inside a shared pytest process
+SUBS = 700
+
+
+def make_backend() -> TpuSpatialBackend:
+    backend = TpuSpatialBackend(16)
+    rng = np.random.default_rng(21)
+    peers = [uuid.uuid4() for _ in range(64)]
+    cubes = rng.integers(-40, 40, (SUBS, 3)) * 16
+    backend.bulk_add_subscriptions(
+        "w", [peers[i % 64] for i in range(SUBS)], cubes
+    )
+    backend.flush()
+    backend.wait_compaction()
+    return backend
+
+
+def test_query_cap_ladder_descends_deduped():
+    backend = TpuSpatialBackend(16)
+    ladder = query_cap_ladder(backend, max_batch=1024, min_batch=100)
+    caps = [cap for _, cap in ladder]
+    assert caps == sorted(set(caps), reverse=True)
+    assert caps[0] == 1024
+    assert caps[-1] >= 128  # floored near min_batch
+
+
+def test_precompiled_tiers_serve_without_retraces():
+    """The acceptance pin: after precompile_tiers, dispatch+collect at
+    every batch size inside the covered ladder (including non-pow2
+    sizes that round into covered tiers) grows NO kernel family."""
+    backend = make_backend()
+    stats = precompile_tiers(
+        backend, max_batch=128, min_batch=16, t_tiers=3, max_compiles=64
+    )
+    assert stats["dispatches"] > 0
+    assert stats["new_variants"] > 0  # cold caches really were traced
+
+    rng = np.random.default_rng(3)
+    before = GUARD.snapshot()
+    for m in (16, 32, 64, 100, 128):
+        handle = backend.dispatch_staged_batch(
+            np.zeros(m, np.int32),
+            rng.uniform(-600, 600, (m, 3)),
+            np.full(m, -1, np.int32),
+            np.zeros(m, np.int8),
+        )
+        out = backend.collect_local_batch(handle)
+        assert len(out) == m
+    delta = GUARD.delta(before)
+    assert delta == {}, (
+        f"serving re-traced after precompilation: {delta}"
+    )
+
+
+def test_precompile_budget_bounds_the_walk():
+    backend = make_backend()
+    stats = precompile_tiers(
+        backend, max_batch=256, min_batch=8, t_tiers=4, max_compiles=2
+    )
+    assert stats["dispatches"] + stats["pack_calls"] <= 2
+    assert stats["skipped_by_budget"] > 0
+
+
+def test_empty_index_skips_cleanly():
+    backend = TpuSpatialBackend(16)
+    stats = precompile_tiers(backend, max_batch=1024)
+    assert stats["skipped"] == "empty-index"
+    assert stats["new_variants"] == 0
+
+
+def test_server_precompiles_device_backends_only():
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    base = dict(
+        store_url="memory://", http_enabled=False, ws_enabled=False,
+        zmq_enabled=False, tick_interval=0.05,
+    )
+    server = WorldQLServer(Config(**base), backend=make_backend())
+    server._precompile_tiers()
+    assert server.precompile_stats is not None
+    assert server.precompile_stats["dispatches"] >= 0
+
+    cpu = WorldQLServer(Config(**base))
+    cpu._precompile_tiers()           # CPU backend: clean no-op
+    assert cpu.precompile_stats is None
+
+    off = WorldQLServer(
+        Config(**base, precompile_tiers=False), backend=make_backend()
+    )
+    off._precompile_tiers()
+    assert off.precompile_stats is None

@@ -1,0 +1,338 @@
+"""Columnar query staging (engine/staging.py + ISSUE 8 tentpole):
+enqueue-time encode, double-buffered swap, grow/shrink hysteresis,
+ticker integration parity with the object-list path, and the
+desync/epoch fallbacks that keep staging an optimization rather than a
+correctness dependency."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.peers import Peer, PeerMap
+from worldql_server_tpu.engine.router import Router
+from worldql_server_tpu.engine.staging import (
+    MIN_CAP, SHRINK_AFTER, QueryStaging,
+)
+from worldql_server_tpu.engine.ticker import TickBatcher
+from worldql_server_tpu.protocol import deserialize_message
+from worldql_server_tpu.protocol.types import (
+    Instruction, Message, Replication, Vector3,
+)
+from worldql_server_tpu.spatial.backend import LocalQuery
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_query(world="w", pos=(5.0, 5.0, 5.0), sender=None):
+    return LocalQuery(
+        world, Vector3(*pos), sender or uuid.uuid4(),
+        Replication.EXCEPT_SELF,
+    )
+
+
+# region: QueryStaging unit behavior
+
+
+def make_staging(initial_cap=MIN_CAP):
+    backend = TpuSpatialBackend(16)
+    return QueryStaging(backend, initial_cap=initial_cap), backend
+
+
+def test_append_interns_at_enqueue_and_swap_returns_trimmed_views():
+    staging, backend = make_staging()
+    peer = uuid.uuid4()
+    backend.add_subscription("w", peer, Vector3(5, 5, 5))
+    staging.append(make_query(sender=peer))
+    staging.append(make_query(world="unknown"))  # never interned → -1
+    assert staging.count == 2
+    wid, pos, sid, repl = staging.swap()
+    assert len(wid) == len(pos) == len(sid) == len(repl) == 2
+    assert wid[0] == backend._world_ids["w"]
+    assert sid[0] == backend._peer_ids[peer]
+    assert (wid[1], sid[1]) == (-1, -1)
+    assert list(pos[0]) == [5.0, 5.0, 5.0]
+    assert repl[0] == int(Replication.EXCEPT_SELF)
+    assert staging.count == 0  # back buffer starts empty
+
+
+def test_buffer_grows_pow2_and_preserves_rows():
+    staging, _ = make_staging()
+    n = MIN_CAP + 7  # force one doubling
+    for i in range(n):
+        staging.append(make_query(pos=(float(i), 0.0, 0.0)))
+    assert staging.capacity == 2 * MIN_CAP
+    wid, pos, sid, repl = staging.swap()
+    assert len(pos) == n
+    assert [p[0] for p in pos[:3]] == [0.0, 1.0, 2.0]
+    assert pos[n - 1][0] == float(n - 1)
+
+
+def test_double_buffer_front_views_survive_back_fill():
+    """Tick N's dispatched views must stay intact while tick N+1's
+    messages stage into the other buffer — the structural
+    encode/compute overlap the ISSUE names."""
+    staging, _ = make_staging()
+    staging.append(make_query(pos=(1.0, 2.0, 3.0)))
+    front = staging.swap()
+    for i in range(5):  # tick N+1 filling the back buffer
+        staging.append(make_query(pos=(9.0, 9.0, 9.0)))
+    assert list(front[1][0]) == [1.0, 2.0, 3.0]
+    assert staging.count == 5
+
+
+def test_shrink_hysteresis_halves_only_after_sustained_underuse():
+    staging, _ = make_staging()
+    # grow to 4x MIN_CAP
+    for _ in range(2 * MIN_CAP + 1):
+        staging.append(make_query())
+    staging.swap()
+    assert staging.capacity == 4 * MIN_CAP
+    big = 4 * MIN_CAP
+    # under-quarter fills: one flush short of the threshold — no shrink
+    for _ in range(SHRINK_AFTER - 1):
+        staging.append(make_query())
+        staging.swap()
+    assert staging.capacity == big
+    # the threshold flush shrinks; a full flush in between resets
+    staging.append(make_query())
+    staging.swap()
+    assert staging.capacity == big // 2
+    # never below MIN_CAP
+    for _ in range(20 * SHRINK_AFTER):
+        staging.append(make_query())
+        staging.swap()
+    assert staging.capacity >= MIN_CAP
+
+
+def test_full_buffer_resets_shrink_streak():
+    staging, _ = make_staging()
+    for _ in range(MIN_CAP + 1):
+        staging.append(make_query())
+    staging.swap()
+    cap = staging.capacity
+    for _ in range(SHRINK_AFTER - 1):
+        staging.append(make_query())
+        staging.swap()
+    # a crowd tick above a quarter fill resets the under-use streak
+    for _ in range(cap // 2):
+        staging.append(make_query())
+    staging.swap()
+    for _ in range(SHRINK_AFTER - 1):
+        staging.append(make_query())
+        staging.swap()
+    assert staging.capacity == cap
+
+
+# endregion
+
+# region: ticker integration
+
+
+class Harness:
+    def __init__(self, interval=60.0, max_batch=16_384, staged=True,
+                 backend=None):
+        config = Config()
+        self.backend = backend if backend is not None \
+            else TpuSpatialBackend(config.sub_region_size)
+        self.store = MemoryRecordStore(config)
+        self.peer_map = PeerMap(on_remove=self.backend.remove_peer)
+        self.staging = (
+            QueryStaging(self.backend) if staged else None
+        )
+        self.ticker = TickBatcher(
+            self.backend, self.peer_map, interval, max_batch=max_batch,
+            staging=self.staging,
+        )
+        self.router = Router(
+            self.peer_map, self.backend, self.store, ticker=self.ticker
+        )
+        self.inboxes: dict[uuid.UUID, list[Message]] = {}
+
+    async def add_peer(self) -> uuid.UUID:
+        peer_uuid = uuid.uuid4()
+        inbox: list[Message] = []
+        self.inboxes[peer_uuid] = inbox
+
+        async def send_raw(data: bytes) -> None:
+            inbox.append(deserialize_message(data))
+
+        await self.peer_map.insert(
+            Peer(peer_uuid, "loopback", send_raw, "test")
+        )
+        return peer_uuid
+
+    def locals_for(self, peer_uuid):
+        return [
+            m for m in self.inboxes[peer_uuid]
+            if m.instruction == Instruction.LOCAL_MESSAGE
+        ]
+
+    async def subscribe(self, peer, pos):
+        await self.router.handle_message(Message(
+            instruction=Instruction.AREA_SUBSCRIBE, sender_uuid=peer,
+            world_name="world", position=pos,
+        ))
+
+    async def local(self, sender, pos, parameter=None):
+        await self.router.handle_message(Message(
+            instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+            world_name="world", position=pos, parameter=parameter,
+        ))
+
+
+def test_staged_flush_matches_list_path_lane_for_lane():
+    """The tentpole parity pin: identical traffic through a staged
+    ticker and a list-path ticker delivers identical frames in
+    identical order."""
+    async def drive(staged: bool):
+        h = Harness(staged=staged)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        c = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        far = Vector3(500, 500, 500)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.subscribe(c, far)
+        await h.local(a, pos, "m1")
+        await h.local(b, pos, "m2")
+        await h.local(a, far, "m3")
+        await h.ticker.flush()
+        return h
+
+    async def scenario():
+        staged_h = await drive(True)
+        list_h = await drive(False)
+        for h in (staged_h, list_h):
+            # same delivery shape on both paths (per-inbox parameters)
+            got = sorted(
+                tuple(m.parameter for m in h.locals_for(peer))
+                for peer in h.inboxes
+            )
+            assert got == [("m1",), ("m2",), ("m3",)], got
+        assert staged_h.ticker.staged_flushes == 1
+        assert staged_h.ticker.staging_fallbacks == 0
+        assert staged_h.backend.staged_dispatches == 1
+        assert staged_h.backend.list_dispatches == 0
+        assert list_h.backend.staged_dispatches == 0
+        assert list_h.backend.list_dispatches == 1
+
+    run(scenario())
+
+
+def test_desynced_window_falls_back_to_list_path_then_resyncs():
+    async def scenario():
+        h = Harness()
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "m1")
+        # simulate the requeue desync: one column row without a queued
+        # message (exactly what a cancelled flush's requeue produces,
+        # direction-inverted)
+        h.staging.append(make_query())
+        await h.ticker.flush()
+        assert [m.parameter for m in h.locals_for(b)] == ["m1"]
+        assert h.ticker.staging_fallbacks == 1
+        assert h.ticker.staged_flushes == 0
+        # resynced: the next window stages again
+        await h.local(a, pos, "m2")
+        await h.ticker.flush()
+        assert [m.parameter for m in h.locals_for(b)] == ["m1", "m2"]
+        assert h.ticker.staged_flushes == 1
+
+    run(scenario())
+
+
+def test_stale_epoch_falls_back_to_list_path():
+    class EpochBackend(TpuSpatialBackend):
+        epoch = 0
+
+        def staging_epoch(self) -> int:
+            return self.epoch
+
+    async def scenario():
+        backend = EpochBackend(16)
+        h = Harness(backend=backend)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "m1")
+        backend.epoch += 1  # a rebuild invalidated interned ids
+        await h.ticker.flush()
+        assert [m.parameter for m in h.locals_for(b)] == ["m1"]
+        assert h.ticker.staging_fallbacks == 1
+        await h.local(a, pos, "m2")  # fresh window under the new epoch
+        await h.ticker.flush()
+        assert h.ticker.staged_flushes == 1
+
+    run(scenario())
+
+
+def test_resilient_staged_dispatch_degrades_through_fallback_pairs():
+    """A failed staged dispatch re-resolves through the CPU mirror
+    using the ticker's retained (message, query) pairs — fan-out
+    degrades, never flatlines (robustness/resilient.py)."""
+    from worldql_server_tpu.robustness import failpoints
+    from worldql_server_tpu.robustness.resilient import ResilientBackend
+
+    backend = ResilientBackend(TpuSpatialBackend(16), failover_after=100)
+
+    async def scenario():
+        h = Harness(backend=backend)
+        a = await h.add_peer()
+        b = await h.add_peer()
+        pos = Vector3(5, 5, 5)
+        await h.subscribe(a, pos)
+        await h.subscribe(b, pos)
+        await h.local(a, pos, "m1")
+        failpoints.registry.configure("backend.dispatch=error:1:x1")
+        try:
+            await h.ticker.flush()
+        finally:
+            failpoints.registry.clear()
+        # the mirror (fed every mutation) resolved the batch
+        assert [m.parameter for m in h.locals_for(b)] == ["m1"]
+        assert backend.degraded_batches == 1
+
+    run(scenario())
+
+
+def test_server_wires_staging_by_backend_capability():
+    from worldql_server_tpu.engine.server import WorldQLServer
+
+    base = dict(
+        store_url="memory://", http_enabled=False, ws_enabled=False,
+        zmq_enabled=False, tick_interval=0.05,
+    )
+    cpu = WorldQLServer(Config(**base))
+    assert cpu.staging is None  # CPU backend: no staged dispatch
+
+    tpu = WorldQLServer(Config(**base), backend=TpuSpatialBackend(16))
+    assert tpu.staging is not None
+    assert tpu.ticker._staging is tpu.staging
+
+    off = WorldQLServer(
+        Config(**base, query_staging="off"),
+        backend=TpuSpatialBackend(16),
+    )
+    assert off.staging is None
+
+    with pytest.raises(ValueError, match="query_staging"):
+        Config(**base, query_staging="on", spatial_backend="cpu") \
+            .validate()
+    with pytest.raises(ValueError, match="query_staging"):
+        Config(**base, query_staging="bogus").validate()
+
+
+# endregion

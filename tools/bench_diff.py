@@ -112,10 +112,15 @@ def direction(name: str) -> int:
     return 0
 
 
-def diff(old: dict, new: dict, threshold_pct: float):
+def diff(old: dict, new: dict, threshold_pct: float,
+         min_abs: float = 0.0):
     """→ (rows, regressions): every common numeric leaf with its
     delta; regressions are the threshold-crossers in the bad
-    direction."""
+    direction. ``min_abs`` is the noise floor for the CI gate: a leaf
+    where BOTH values sit below it can't regress — sub-floor timings
+    on a shared runner are scheduler noise, not a code change (counts
+    like ``retraces`` 0 → 1 still flag: the new value crosses the
+    floor)."""
     rows, regressions = [], []
     for config in sorted(set(old) & set(new)):
         o_flat, n_flat = flatten(old[config]), flatten(new[config])
@@ -129,6 +134,7 @@ def diff(old: dict, new: dict, threshold_pct: float):
                 d != 0
                 and abs(pct) > threshold_pct
                 and (pct > 0) == (d < 0)   # moved in the bad direction
+                and max(abs(o), abs(n)) >= min_abs
             )
             rows.append((config, name, o, n, pct, d, regressed))
             if regressed:
@@ -149,13 +155,19 @@ def main(argv=None) -> int:
     p.add_argument("--fail", action="store_true",
                    help="exit 1 when any regression is flagged "
                         "(CI-gate mode)")
+    p.add_argument("--min-abs", type=float, default=0.0,
+                   help="noise floor: never flag a leaf whose old AND "
+                        "new values are both below this magnitude "
+                        "(sub-floor timings on shared CI runners are "
+                        "scheduler noise; default 0 = no floor)")
     p.add_argument("--all", action="store_true", dest="show_all",
                    help="print every changed leaf, not just flagged "
                         "and direction-scored ones")
     args = p.parse_args(argv)
 
     rows, regressions = diff(
-        load_records(args.old), load_records(args.new), args.threshold
+        load_records(args.old), load_records(args.new), args.threshold,
+        min_abs=args.min_abs,
     )
     for config, name, o, n, pct, d, regressed in rows:
         if not args.show_all and d == 0 and not regressed:

@@ -183,6 +183,99 @@ def _check_full_fetch(ctx: FileContext) -> Iterator[Violation]:
                 )
 
 
+#: dispatch-path functions of spatial/*.py — between a tick's flush and
+#: the device launch; per-element Python iteration over the query batch
+#: here is the O(m) host-encode wall the staged columnar path exists to
+#: kill (ISSUE 8 / BENCH_r05: dispatch p99 10 ms of a 14.5 ms engine
+#: p99 was this loop)
+_DISPATCH_FUNCS = {
+    "dispatch_local_batch",
+    "dispatch_staged_batch",
+    "match_local_batch",
+    "_dispatch_encoded",
+    "_prepare_queries",
+}
+#: parameter names that carry the per-tick query batch
+_QUERY_PARAMS = {"queries"}
+#: call wrappers whose argument is still iterated per element
+_ITER_WRAPPERS = {"enumerate", "zip", "reversed", "map", "iter"}
+
+
+def _iterated_names(iter_node: ast.AST) -> set[str]:
+    names: set[str] = set()
+    if isinstance(iter_node, ast.Name):
+        names.add(iter_node.id)
+    elif (
+        isinstance(iter_node, ast.Call)
+        and dotted_name(iter_node.func) in _ITER_WRAPPERS
+    ):
+        for arg in iter_node.args:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+    return names
+
+
+def _check_per_query_loop(ctx: FileContext) -> Iterator[Violation]:
+    """Flag per-element Python iteration over the query batch inside
+    dispatch-path functions of ``spatial/*.py``: ``for q in queries``
+    loops, comprehensions, and ``np.fromiter`` over per-object
+    generator expressions. The CPU-backend reference path and the
+    legacy object-list encode are the designated exceptions — they
+    carry ``# wql: allow(per-query-python-loop)`` pragmas so every
+    per-query loop on the dispatch path stays auditable."""
+    if "spatial/" not in ctx.relpath:
+        return
+    scopes = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _DISPATCH_FUNCS
+    ]
+    for scope in scopes:
+        args = scope.args
+        params = {
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        } & _QUERY_PARAMS
+        if not params:
+            continue
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                hot = sorted(_iterated_names(node.iter) & params)
+                if hot:
+                    yield from ctx.flag(
+                        PER_QUERY_LOOP,
+                        node,
+                        f"Python loop over query batch ({', '.join(hot)}) "
+                        "in a dispatch-path function — O(m) host work "
+                        "before the kernel launches; stage the batch as "
+                        "columnar arrays at enqueue time "
+                        "(engine/staging.py + dispatch_staged_batch), or "
+                        "mark the designated CPU/fallback path with "
+                        "`# wql: allow(per-query-python-loop)`",
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+            ):
+                hot = sorted({
+                    name
+                    for gen in node.generators
+                    for name in _iterated_names(gen.iter)
+                } & params)
+                if hot:
+                    yield from ctx.flag(
+                        PER_QUERY_LOOP,
+                        node,
+                        "per-object comprehension/generator over query "
+                        f"batch ({', '.join(hot)}) in a dispatch-path "
+                        "function (np.fromiter over a generator is still "
+                        "a per-element Python loop); use the staged "
+                        "columnar path, or mark the designated "
+                        "CPU/fallback site with "
+                        "`# wql: allow(per-query-python-loop)`",
+                    )
+
+
 def _is_jax_jit_ref(node: ast.AST) -> bool:
     return dotted_name(node) in ("jax.jit", "jit")
 
@@ -316,5 +409,12 @@ FULL_FETCH = Rule(
     "bytes — use the on-device compaction, or pragma the fallback)",
     _check_full_fetch,
 )
+PER_QUERY_LOOP = Rule(
+    "per-query-python-loop",
+    "per-element Python iteration over the query batch in a "
+    "dispatch-path function of spatial/*.py (the host-encode wall — "
+    "stage columns at enqueue instead, or pragma the CPU/fallback path)",
+    _check_per_query_loop,
+)
 
-RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH]
+RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH, PER_QUERY_LOOP]

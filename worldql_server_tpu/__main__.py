@@ -68,6 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default) = sequential flush; 2 overlaps tick "
                         "N's collect+delivery with tick N+1's "
                         "accumulation and dispatch")
+    p.add_argument("--query-staging", choices=["auto", "on", "off"],
+                   dest="query_staging",
+                   help="columnar query staging: enqueue-time encode "
+                        "into double-buffered arrays so the tick flush "
+                        "dispatches with zero per-query Python (auto = "
+                        "on for staging-capable backends, the default; "
+                        "off = object-list path everywhere)")
+    p.add_argument("--precompile-tiers", action="store_true",
+                   default=None, dest="precompile_tiers_flag",
+                   help="trace every reachable device-kernel capacity "
+                        "tier at boot so no first-occurrence tier pays "
+                        "a jit trace mid-serving (default on for "
+                        "device backends)")
+    p.add_argument("--no-precompile-tiers", action="store_true",
+                   help="skip boot-time tier precompilation")
     p.add_argument("--mesh-batch", type=int,
                    help="sharded backend: data-parallel query axis size")
     p.add_argument("--mesh-space", type=int,
@@ -163,8 +178,8 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
-    "tick_pipeline", "mesh_batch", "mesh_space", "index_snapshot",
-    "max_message_size",
+    "tick_pipeline", "query_staging", "mesh_batch", "mesh_space",
+    "index_snapshot", "max_message_size",
     "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
     "checkpoint_interval", "delivery_workers", "delivery_ring_bytes",
     "failpoints", "failpoints_seed", "resilience", "failover_after",
@@ -188,6 +203,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         config.trace = True
     if args.no_device_telemetry:
         config.device_telemetry = False
+    if args.precompile_tiers_flag:
+        config.precompile_tiers = True
+    if args.no_precompile_tiers:
+        config.precompile_tiers = False
     config.verbose = args.verbose
     return config
 

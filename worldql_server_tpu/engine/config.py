@@ -249,6 +249,24 @@ class Config:
     slow_tick_dir: str = field(
         default_factory=lambda: _env("WQL_SLOW_TICK_DIR", "slow_ticks")
     )
+    # Columnar query staging (engine/staging.py): enqueue-time encode
+    # of the tick batch into double-buffered columnar arrays, so flush
+    # dispatches with zero per-query Python. 'auto' (default) enables
+    # it exactly when the spatial backend supports staged dispatch
+    # (tpu/sharded); 'off' forces the object-list path everywhere
+    # (reference-equivalent); 'on' is auto plus a config error if the
+    # backend can't stage (a silent fallback would hide a perf cliff).
+    query_staging: str = field(
+        default_factory=lambda: _env("WQL_QUERY_STAGING", "auto")
+    )
+    # Boot-time capacity-tier precompilation (spatial/precompile.py):
+    # trace every reachable CSR capacity tier, pack bucket and
+    # query-cap shape against the boot index BEFORE serving, so no
+    # first-occurrence tier pays a jit trace mid-serving. On by
+    # default; only device backends (tpu/sharded) act on it.
+    precompile_tiers: bool = field(
+        default_factory=lambda: _env("WQL_PRECOMPILE_TIERS", "1") == "1"
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -323,6 +341,15 @@ class Config:
             )
         if self.tick_interval < 0:
             errors.append("tick_interval must be >= 0")
+        if self.query_staging not in ("auto", "on", "off"):
+            errors.append("query_staging must be 'auto', 'on' or 'off'")
+        if self.query_staging == "on" and self.spatial_backend == "cpu":
+            errors.append(
+                "query_staging='on' requires a staging-capable spatial "
+                "backend ('tpu' or 'sharded'); the CPU backend resolves "
+                "per query — use 'auto' to enable staging only when "
+                "supported"
+            )
         if self.tick_pipeline < 1:
             errors.append("tick_pipeline must be >= 1 (1 = no overlap)")
         if self.delivery_workers < 0:

@@ -164,15 +164,30 @@ class WorldQLServer:
             plane=self.delivery_plane,
         )
         self.ticker = None
+        self.staging = None
         if config.tick_interval > 0:
             from .ticker import TickBatcher
 
+            # Columnar query staging (engine/staging.py): enqueue-time
+            # encode into double-buffered arrays, so flush dispatches
+            # with zero per-query Python. 'auto' binds it exactly when
+            # the backend can stage; 'off' keeps the object-list path
+            # byte for byte (config.validate rejects 'on' + cpu).
+            if (
+                config.query_staging != "off"
+                and self.backend.supports_staged_dispatch()
+            ):
+                from .staging import QueryStaging
+
+                self.staging = QueryStaging(self.backend)
             self.ticker = TickBatcher(
                 self.backend, self.peer_map, config.tick_interval,
                 metrics=self.metrics, pipeline=config.tick_pipeline,
                 supervisor=self.supervisor, tracer=self.tracer,
                 device_telemetry=self.device_telemetry,
+                staging=self.staging,
             )
+        self.precompile_stats: dict | None = None
         # Durability engine: WAL + write-behind pipeline. With
         # durability='off' (default) both stay None and the Router's
         # internal pass-through keeps reference-equivalent inline-store
@@ -233,7 +248,19 @@ class WorldQLServer:
                         round(self.ticker.last_collect_ms, 3),
                     "compaction_bucket":
                         self.ticker.last_compaction_bucket,
+                    "staged_flushes": self.ticker.staged_flushes,
+                    "staging_fallbacks": self.ticker.staging_fallbacks,
+                    **(
+                        {"staging": self.staging.stats()}
+                        if self.staging is not None else {}
+                    ),
                 },
+            )
+        if self.config.precompile_tiers and hasattr(
+            self.backend, "_segments"
+        ):
+            self.metrics.gauge(
+                "precompile", lambda: self.precompile_stats
             )
         if self.durability is not None:
             self.metrics.gauge("durability", self.durability_status)
@@ -359,6 +386,7 @@ class WorldQLServer:
             if self.config.checkpoint_interval > 0:
                 self.supervisor.spawn("checkpoint", self._checkpoint_loop)
         self._restore_index_snapshot()
+        self._precompile_tiers()
 
         if self.loop_monitor is not None:
             # loop-health probe: supervised (a dead probe restarts, and
@@ -406,6 +434,33 @@ class WorldQLServer:
 
         self._started.set()
         logger.info("worldql-server-tpu started")
+
+    def _precompile_tiers(self) -> None:
+        """Boot-time tier precompilation (spatial/precompile.py): runs
+        after the snapshot restore (the restored index IS the serving
+        index — its segment shapes are what the kernels key on) and
+        before any transport accepts traffic. Device backends only; an
+        empty index skips inside the module with a log line. Failures
+        are non-fatal — a server that serves with cold caches beats one
+        that won't boot."""
+        if not self.config.precompile_tiers:
+            return
+        if not hasattr(self.backend, "_segments"):
+            return  # CPU backend: nothing jitted to warm
+        from ..spatial.precompile import precompile_tiers
+
+        max_batch = (
+            self.ticker.max_batch if self.ticker is not None else 16_384
+        )
+        try:
+            self.precompile_stats = precompile_tiers(
+                self.backend, max_batch=max_batch
+            )
+        except Exception:
+            logger.exception(
+                "boot-time tier precompilation failed — serving with "
+                "cold kernel caches"
+            )
 
     async def _sweep_stale_once(self) -> int:
         """One staleness pass: evict every silent heartbeat-tracked

@@ -1,0 +1,195 @@
+"""Columnar query staging: the enqueue-time encode for the tick batch.
+
+The dispatch wall at BENCH_r05 was the host encode: ``dispatch_local_
+batch`` re-walked every LocalQuery object in Python (interning dict
+probes, row-by-row position fills) before the kernel ever launched —
+~10 ms of the 14.5 ms engine p99 against a 5 ms budget. This module
+moves that per-query work to MESSAGE-ARRIVAL time, amortized across
+the tick window: the router's enqueue writes one row of preallocated
+columnar staging arrays (``world_id i32 | pos f64[·,3] | sender_id i32
+| repl i8``, already interned through the backend's dicts), and
+``flush()`` just flips the double buffer and hands the filled column
+views to :meth:`SpatialBackend.dispatch_staged_batch` — zero per-query
+Python at flush time. The back buffer fills for tick N+1 while tick N
+runs on device, so encode/compute overlap is structural rather than
+incidental (TPU-KNN's host-side discipline, arXiv:2206.14286).
+
+Interning contract: the backend's ``(world → id, peer → id)`` dicts
+are owned by the event-loop thread (enqueue, subscription mutations and
+dispatch all run there) and are append-only for a backend's lifetime,
+so an id interned at arrival is still valid at flush. A world or peer
+first interned AFTER a message arrived (but inside the same tick
+window) resolves to ``-1`` for that message — the same
+message-before-subscription race the object-list path has across
+ticks, narrowed to one window. Wrappers that can invalidate ids
+(robustness/resilient.py rebuilds swap the inner backend, and its
+dicts, wholesale) bump :meth:`SpatialBackend.staging_epoch`; the
+ticker compares epochs at flush and falls back to the retained
+object-list path for that one window.
+
+Buffers grow by power-of-two on demand and shrink with hysteresis: a
+capacity is halved only after ``SHRINK_AFTER`` consecutive flushes
+used under a quarter of it, so one quiet tick never thrashes a crowd-
+sized allocation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: initial (and minimum) rows per buffer
+MIN_CAP = 1024
+#: consecutive under-quarter-full flushes before a buffer halves
+SHRINK_AFTER = 32
+
+
+class _Buffer:
+    __slots__ = ("wid", "pos", "sid", "repl", "n", "cap", "epoch")
+
+    def __init__(self, cap: int):
+        self.alloc(cap)
+        self.n = 0
+        self.epoch = 0
+
+    def alloc(self, cap: int) -> None:
+        self.cap = cap
+        self.wid = np.empty(cap, np.int32)
+        self.pos = np.empty((cap, 3), np.float64)
+        self.sid = np.empty(cap, np.int32)
+        self.repl = np.empty(cap, np.int8)
+
+    def grow(self) -> None:
+        n, cap = self.n, self.cap * 2
+        wid, pos, sid, repl = self.wid, self.pos, self.sid, self.repl
+        self.alloc(cap)
+        self.wid[:n] = wid[:n]
+        self.pos[:n] = pos[:n]
+        self.sid[:n] = sid[:n]
+        self.repl[:n] = repl[:n]
+
+    def views(self):
+        n = self.n
+        return self.wid[:n], self.pos[:n], self.sid[:n], self.repl[:n]
+
+
+class QueryStaging:
+    """Double-buffered columnar staging for one TickBatcher.
+
+    Not thread-safe by design: append (router enqueue), swap (ticker
+    flush) and the backend's interning all run on the event loop.
+    """
+
+    def __init__(self, backend, initial_cap: int = MIN_CAP):
+        self._backend = backend
+        self._world_ids, self._peer_ids = backend.interning_maps()
+        cap = max(MIN_CAP, int(initial_cap))
+        self._bufs = [_Buffer(cap), _Buffer(cap)]
+        self._active = 0
+        self._under = 0  # consecutive under-quarter-full swaps
+        self.swaps = 0
+        self.resyncs = 0
+
+    @property
+    def count(self) -> int:
+        """Rows staged in the active buffer (must equal the ticker's
+        queued-message count; a mismatch means a requeue desynced the
+        window and the ticker takes the object-list path)."""
+        return self._bufs[self._active].n
+
+    @property
+    def capacity(self) -> int:
+        return self._bufs[self._active].cap
+
+    def append(self, query) -> None:
+        """Stage one LocalQuery: intern + write one row of each column.
+        This is the per-query work the flush no longer does — paid at
+        message-arrival time, on the event loop."""
+        buf = self._bufs[self._active]
+        if buf.n == 0:
+            # ids written into this window are valid for this epoch
+            # only; the ticker re-checks at flush
+            buf.epoch = self._backend.staging_epoch()
+        if buf.n == buf.cap:
+            buf.grow()
+        i = buf.n
+        buf.wid[i] = self._world_ids.get(query.world, -1)
+        p = query.position
+        buf.pos[i, 0] = p.x
+        buf.pos[i, 1] = p.y
+        buf.pos[i, 2] = p.z
+        buf.sid[i] = self._peer_ids.get(query.sender, -1)
+        buf.repl[i] = int(query.replication)
+        buf.n = i + 1
+
+    def epoch_ok(self) -> bool:
+        """Every id in the active window was interned under the
+        backend's CURRENT epoch (no resilience rebuild swapped the
+        dicts mid-window)."""
+        return (
+            self._bufs[self._active].epoch
+            == self._backend.staging_epoch()
+        )
+
+    def swap(self):
+        """Flip buffers: returns the filled front buffer's trimmed
+        column views for dispatch; the (cleared) back buffer starts
+        filling for the next tick. The front views stay untouched until
+        the next swap — the dispatch consumes them synchronously, the
+        double buffer covers any retained references."""
+        front = self._bufs[self._active]
+        self._active ^= 1
+        back = self._bufs[self._active]
+        back.n = 0
+        if back.cap < front.cap:
+            # keep both buffers on the same capacity tier: tick N+1's
+            # crowd is tick N's crowd — pre-sizing the back buffer
+            # avoids re-growing through copy-doublings mid-window
+            back.alloc(front.cap)
+        self.swaps += 1
+        self._note_fill(front)
+        return front.views()
+
+    def resync(self) -> None:
+        """Drop the active window (the ticker is taking the object-list
+        path for it) and refresh the interning-map references — after a
+        resilience rebuild the maps are NEW dicts on a NEW inner
+        backend."""
+        self._bufs[self._active].n = 0
+        self._world_ids, self._peer_ids = self._backend.interning_maps()
+        self.resyncs += 1
+
+    def _note_fill(self, buf: _Buffer) -> None:
+        """Shrink hysteresis: both buffers track the shared streak (the
+        workload is one stream; the buffers alternate serving it)."""
+        if buf.cap > MIN_CAP and buf.n <= buf.cap // 4:
+            self._under += 1
+            if self._under >= SHRINK_AFTER:
+                self._under = 0
+                for b in self._bufs:
+                    if b.cap > MIN_CAP:
+                        # active buffer may already hold rows; never
+                        # shrink below them (pow2 tier preserved)
+                        floor = max(MIN_CAP, _next_pow2(b.n))
+                        if b.cap // 2 >= floor:
+                            n, wid, pos, sid, repl = (
+                                b.n, b.wid, b.pos, b.sid, b.repl
+                            )
+                            b.alloc(b.cap // 2)
+                            b.wid[:n] = wid[:n]
+                            b.pos[:n] = pos[:n]
+                            b.sid[:n] = sid[:n]
+                            b.repl[:n] = repl[:n]
+        else:
+            self._under = 0
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "staged": self.count,
+            "swaps": self.swaps,
+            "resyncs": self.resyncs,
+        }
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(n, 1) - 1).bit_length()

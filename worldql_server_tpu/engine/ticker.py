@@ -57,12 +57,23 @@ class TickBatcher:
         supervisor=None,
         tracer: Tracer | None = None,
         device_telemetry=None,
+        staging=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        # Optional engine.staging.QueryStaging: enqueue writes each
+        # query into preallocated columnar arrays (interned at arrival
+        # time), and flush dispatches the flipped buffer through
+        # backend.dispatch_staged_batch with ZERO per-query Python —
+        # the encode leg moves off the tick's critical path. None (the
+        # default, and always for backends without staged dispatch)
+        # keeps the object-list path byte for byte.
+        self._staging = staging
+        self.staged_flushes = 0
+        self.staging_fallbacks = 0
         # Optional observability.device.DeviceTelemetry: after each
         # collect it tags the tick trace with the device timing split
         # (encode/h2d/compute/d2h) and polls the retrace GUARD so a
@@ -129,6 +140,11 @@ class TickBatcher:
 
     async def enqueue(self, message: Message, query: LocalQuery) -> None:
         self._queue.append((message, query))
+        if self._staging is not None:
+            # enqueue-time encode: intern + write one staging row NOW,
+            # amortized across the tick window; the query object rides
+            # the queue purely as the fallback/requeue safety net
+            self._staging.append(query)
         if len(self._queue) >= self.max_batch:
             if self.pipeline > 1:
                 await self.flush_pipelined()
@@ -170,9 +186,7 @@ class TickBatcher:
                 # closed at delivery completion on whichever path
                 t_ingress_ns = time.monotonic_ns()
                 with trace.span("tick.dispatch"):
-                    handle = self.backend.dispatch_local_batch(
-                        [query for _, query in batch]
-                    )
+                    handle = self._dispatch_batch(batch)
                     self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
                     if self.metrics is not None:
                         self.metrics.observe_ms(
@@ -274,6 +288,34 @@ class TickBatcher:
         except Exception:
             logger.exception("tick delivery failed — batch dropped")
 
+    def _dispatch_batch(self, batch):
+        """Launch one tick's batch: the staged columnar path when the
+        staging window is intact (zero per-query Python at flush —
+        interning already happened at enqueue), the object-list path
+        otherwise. A desynced window (a cancelled flush re-queued its
+        batch, so queue and columns disagree) or a stale interning
+        epoch (a resilience rebuild swapped the backend's dicts
+        mid-window) takes ONE list-path dispatch from the retained
+        query objects and resyncs — staging is an optimization, never
+        a correctness dependency."""
+        st = self._staging
+        if st is not None:
+            if st.count == len(batch) and st.epoch_ok():
+                cols = st.swap()
+                self.staged_flushes += 1
+                if self.metrics is not None:
+                    self.metrics.inc("tick.staged_flushes")
+                return self.backend.dispatch_staged_batch(
+                    *cols, fallback=batch
+                )
+            st.resync()
+            self.staging_fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.inc("tick.staging_fallbacks")
+        return self.backend.dispatch_local_batch(
+            [query for _, query in batch]
+        )
+
     def _reap(self) -> None:
         while self._inflight and self._inflight[0].done():
             self._inflight.popleft()
@@ -322,9 +364,7 @@ class TickBatcher:
             try:
                 td = time.perf_counter()
                 with trace.span("tick.dispatch"):
-                    handle = self.backend.dispatch_local_batch(
-                        [query for _, query in batch]
-                    )
+                    handle = self._dispatch_batch(batch)
                     self.last_dispatch_ms = (time.perf_counter() - td) * 1e3
                     if self.metrics is not None:
                         self.metrics.observe_ms(

@@ -49,6 +49,18 @@ from . import failpoints
 logger = logging.getLogger(__name__)
 
 
+def _fallback_queries(fallback) -> list[LocalQuery] | None:
+    """Normalize a re-resolve source to LocalQuery objects: the list
+    path stores the queries themselves, the staged path the ticker's
+    retained ``(message, query)`` pairs. None when there is nothing to
+    re-resolve from."""
+    if fallback is None:
+        return None
+    return [
+        pair[1] if isinstance(pair, tuple) else pair for pair in fallback
+    ]
+
+
 class _Resolved:
     """Dispatch handle for a batch already resolved by the mirror."""
 
@@ -277,6 +289,58 @@ class ResilientBackend(SpatialBackend):
                 self.degraded_batches += 1
         return _Resolved(self._mirror_match(queries))
 
+    # region: staged columnar dispatch (engine/staging.py)
+
+    def supports_staged_dispatch(self) -> bool:
+        # even failed-over: the staged call degrades through the
+        # fallback pairs below, so the ticker need not re-probe
+        return self.inner.supports_staged_dispatch()
+
+    def interning_maps(self):
+        return self.inner.interning_maps()
+
+    def staging_epoch(self) -> int:
+        """Rebuilds replace ``inner`` (and its interning dicts)
+        wholesale — ids staged before the swap are meaningless after
+        it. Folding the rebuild/failover counters into the epoch makes
+        the ticker fall back to the object-list path for exactly the
+        windows that straddle a swap."""
+        return (
+            self.inner.staging_epoch()
+            + 2 * self.rebuilds
+            + int(self.failed_over)
+        )
+
+    def dispatch_staged_batch(
+        self, world_ids, positions, sender_ids, repls, fallback=None,
+    ):
+        """Staged dispatch with the same containment as the list path.
+        The mirror fallback needs LocalQuery objects — the staged
+        columns carry interned ids that die with a failed inner
+        backend — so the ticker's retained ``(message, query)`` pairs
+        (``fallback``) are the re-resolve source; extracting them is
+        O(m) Python paid ONLY on the failure path."""
+        if not self.failed_over:
+            try:
+                failpoints.fire("backend.dispatch")
+                return _Inflight(
+                    self.inner.dispatch_staged_batch(
+                        world_ids, positions, sender_ids, repls
+                    ),
+                    fallback,
+                )
+            except Exception:
+                self._note_failure("dispatch")
+                self.degraded_batches += 1
+        queries = _fallback_queries(fallback)
+        if queries is None:
+            # no fallback pairs: still contained — an empty fan-out
+            # per query beats a propagated dispatch error
+            return _Resolved([[] for _ in range(len(world_ids))])
+        return _Resolved(self._mirror_match(queries))
+
+    # endregion
+
     def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
         if isinstance(handle, _Resolved):
             return handle.targets
@@ -286,7 +350,7 @@ class ResilientBackend(SpatialBackend):
         except Exception:
             self._note_failure("collect")
             self.degraded_batches += 1
-            return self._mirror_match(handle.queries)
+            return self._mirror_match(_fallback_queries(handle.queries) or [])
         self.failures = 0  # a full dispatch→collect proves health
         return out
 

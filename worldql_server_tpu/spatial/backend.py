@@ -112,7 +112,7 @@ class SpatialBackend(abc.ABC):
         override with one fused device batch.
         """
         out: list[list[uuid_mod.UUID]] = []
-        for q in queries:
+        for q in queries:  # wql: allow(per-query-python-loop) — the CPU reference path IS per-query
             peers = self.query_cube(q.world, q.position)
             out.append(_apply_replication(peers, q.sender, q.replication))
         return out
@@ -141,6 +141,44 @@ class SpatialBackend(abc.ABC):
 
     def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
         return handle
+
+    # Columnar staged dispatch (engine/staging.py): backends that can
+    # launch a batch straight from preallocated columnar arrays
+    # (world_id i32, pos f64[·,3], sender_id i32, repl i8 — interned at
+    # enqueue time by the ticker's staging buffers) advertise it here,
+    # killing the per-query Python encode loop at flush time. The
+    # object-list API above remains the default path (CPU backend,
+    # staging off) byte for byte.
+    def supports_staged_dispatch(self) -> bool:
+        return False
+
+    def interning_maps(self):
+        """→ ``(world_name → id, peer_uuid → id)`` dicts the staging
+        buffers intern through at enqueue time. Only meaningful when
+        :meth:`supports_staged_dispatch` is True; the dicts are owned
+        (and only mutated) by the event-loop thread."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no interning tables"
+        )
+
+    def staging_epoch(self) -> int:
+        """Monotone counter that changes whenever previously interned
+        ids stop being valid (e.g. a resilience rebuild swapped the
+        inner backend). The ticker falls back to the object-list path
+        for any staged window whose epoch went stale."""
+        return 0
+
+    def dispatch_staged_batch(
+        self, world_ids, positions, sender_ids, repls, fallback=None,
+    ):
+        """Launch a batch from staged columnar arrays (already
+        interned). ``fallback`` is an opaque sequence of
+        ``(message, LocalQuery)`` pairs a degraded wrapper may use to
+        re-resolve the batch without the columns (robustness/
+        resilient.py); array backends ignore it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support staged dispatch"
+        )
 
     # endregion
 

@@ -6,6 +6,19 @@ batch in numpy, dominated by intermediate arrays the fused loop never
 materializes). Falls back to the numpy twins transparently; the
 property suite (tests/test_native_keys.py) pins bit-exact agreement
 including NaN/±inf/exact-multiple/saturation edge cases.
+
+Two entry points:
+
+* :func:`query_keys` — quantize + both hashes for an [N] batch
+  (``wql_query_keys``).
+* :func:`encode_queries` — the full dispatch-ready encode
+  (``wql_encode_queries``): quantize + hash + capacity-tier padding of
+  all four query columns straight from the ticker's staging arrays, one
+  GIL-releasing C call (ctypes drops the GIL for the duration), zero
+  numpy intermediates. Padding lanes match spatial/hashing.py
+  (PAD_KEY / QUERY_PAD_KEY2 / sender -1 / repl 0) — pinned by the
+  parity suite. A stale ``.so`` built before this symbol existed keeps
+  serving ``query_keys`` and the encode composes the two-step path.
 """
 
 from __future__ import annotations
@@ -16,7 +29,10 @@ import logging
 import numpy as np
 
 from ..protocol.native_codec import resolve_lib_path
-from .hashing import KEY2_OFFSET, spatial_keys, spatial_keys2
+from .hashing import (
+    KEY2_OFFSET, PAD_KEY, QUERY_PAD_KEY2, pad_to, spatial_keys,
+    spatial_keys2,
+)
 from .quantize import cube_coords_batch
 
 logger = logging.getLogger(__name__)
@@ -36,6 +52,24 @@ class _NativeKeys:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        # The fused batch encode is newer than wql_query_keys — probe
+        # it separately so a stale library degrades to the two-step
+        # path instead of losing the native keys entirely.
+        self._encode = getattr(lib, "wql_encode_queries", None)
+        if self._encode is not None:
+            self._encode.restype = None
+            self._encode.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int8),
+            ]
 
     def __call__(self, world_ids, positions, cube_size: int, seed: int):
         n = len(world_ids)
@@ -59,6 +93,38 @@ class _NativeKeys:
             k2.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         )
         return k1, k2
+
+    def encode(self, world_ids, positions, sender_ids, repls, cap: int,
+               cube_size: int, seed: int):
+        if self._encode is None:
+            return None
+        n = len(world_ids)
+        pos = np.ascontiguousarray(positions, dtype=np.float64)
+        wid = np.ascontiguousarray(world_ids, dtype=np.int32)
+        sid = np.ascontiguousarray(sender_ids, dtype=np.int32)
+        rep = np.ascontiguousarray(repls, dtype=np.int8)
+        if pos.shape != (n, 3):
+            raise ValueError(f"positions shape {pos.shape} != ({n}, 3)")
+        if len(sid) != n or len(rep) != n or cap < n:
+            raise ValueError("encode_queries column lengths disagree")
+        k1 = np.empty(cap, np.int64)
+        k2 = np.empty(cap, np.int64)
+        sid_out = np.empty(cap, np.int32)
+        rep_out = np.empty(cap, np.int8)
+        self._encode(
+            pos.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            wid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rep.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            n, cap, cube_size,
+            ctypes.c_uint64(seed & _U64_MASK),
+            ctypes.c_uint64((seed + KEY2_OFFSET) & _U64_MASK),
+            k1.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            k2.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sid_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rep_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        )
+        return k1, k2, sid_out, rep_out
 
 
 def load() -> _NativeKeys | None:
@@ -99,4 +165,42 @@ def numpy_query_keys(world_ids, positions, cube_size: int, seed: int):
     return (
         spatial_keys(world_ids, cubes, seed),
         spatial_keys2(world_ids, cubes, seed),
+    )
+
+
+def encode_queries(world_ids, positions, sender_ids, repls, cap: int,
+                   cube_size: int, seed: int):
+    """Full dispatch-ready query encode: → ``(keys1[cap], keys2[cap],
+    senders[cap] i32, repls[cap] i8)``, padded to the ``cap`` capacity
+    tier. One fused native pass when the kernel is built; the composed
+    query_keys + pad_to path otherwise (bit-identical, pinned by
+    tests/test_native_keys.py)."""
+    if _native is not None:
+        out = _native.encode(
+            world_ids, positions, sender_ids, repls, cap, cube_size, seed
+        )
+        if out is not None:
+            return out
+    return numpy_encode_queries(
+        world_ids, positions, sender_ids, repls, cap, cube_size, seed
+    )
+
+
+def numpy_encode_queries(world_ids, positions, sender_ids, repls,
+                         cap: int, cube_size: int, seed: int):
+    """The composed two-step encode, exposed for the parity suite (and
+    the fallback when the fused symbol is absent). Uses query_keys —
+    which may itself be native — so a stale library still accelerates
+    the hash leg."""
+    keys, keys2 = query_keys(world_ids, positions, cube_size, seed)
+    return (
+        pad_to(keys, cap, PAD_KEY),
+        pad_to(keys2, cap, QUERY_PAD_KEY2),
+        pad_to(
+            np.ascontiguousarray(sender_ids, dtype=np.int32), cap,
+            np.int32(-1),
+        ),
+        pad_to(
+            np.ascontiguousarray(repls, dtype=np.int8), cap, np.int8(0)
+        ),
     )

@@ -1,0 +1,187 @@
+"""Boot-time capacity-tier precompilation for the device fan-out engine.
+
+A first-occurrence capacity tier pays its jit trace MID-SERVING — tens
+of milliseconds to seconds inside a 5 ms tick budget (the BENCH_r05
+207 s outlier is this failure mode at its worst; utils/retrace.py is
+the tripwire). The engine's shapes are all power-of-two tiers, so the
+set a configuration can reach is small and enumerable: this module
+walks it BEFORE serving starts — every query-cap tier up to
+``max_batch``, the CSR slot-capacity ladder each of those can request
+(zone-A floor upward, below the dense ceiling), and the pack-bucket
+tiers of the on-device result compaction — dispatching each shape once
+against the backend's real device segments (shapes and dtypes are what
+jit keys on; the dummy query values match nothing and the results are
+discarded).
+
+Scope and honesty: precompilation covers the index PRESENT at boot
+(after a snapshot restore, that is the serving index; an empty-index
+boot has no segments to trace against and skips with a log line — the
+first subscription's delta tier still pays its first trace). The
+sustained bench run is the proof: with precompilation on, the PR 7
+retrace GUARD must report ``device.retraces == 0`` across the pass.
+
+Cost is bounded: ``max_compiles`` caps the walk (largest shapes first —
+peak traffic is where a mid-serving trace hurts), and every dispatch
+is synchronized so boot completes with the caches warm, not merely
+enqueued.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from ..utils.retrace import GUARD
+from .hashing import next_pow2
+
+logger = logging.getLogger(__name__)
+
+#: zone-A identity-row width (tpu_backend.CSR_ROW — imported lazily to
+#: keep this module importable without jax)
+_CSR_ROW = 8
+
+
+def query_cap_ladder(backend, max_batch: int, min_batch: int | None):
+    """Descending, deduped query-capacity tiers the ticker can reach:
+    ``next_pow2(m)`` for every batch size up to ``max_batch`` collapses
+    to a halving ladder; ``min_batch`` floors it (tiny tiers trace in
+    microseconds of traffic and are rarely worth boot time)."""
+    if min_batch is None:
+        min_batch = max(64, max_batch // 8)
+    ms, m = [], max(1, int(max_batch))
+    while m >= min_batch:
+        ms.append(m)
+        m //= 2
+    if not ms:
+        ms.append(max(1, int(max_batch)))
+    seen, out = set(), []
+    for m in ms:
+        cap = backend._query_cap(m)
+        if cap not in seen:
+            seen.add(cap)
+            out.append((m, cap))
+    return out
+
+
+def precompile_tiers(
+    backend,
+    *,
+    max_batch: int,
+    min_batch: int | None = None,
+    t_tiers: int = 4,
+    include_pack: bool = True,
+    max_compiles: int = 64,
+    delivery_cap: int | None = None,
+) -> dict:
+    """Trace every reachable hot-path kernel shape before serving.
+
+    ``t_tiers`` bounds the CSR slot-capacity doublings walked above
+    each query tier's zone-A floor (the adaptive ``_delivery_cap`` can
+    climb that ladder at runtime; covering a few doublings of headroom
+    keeps an overflow retry off the compile path too). Returns a stats
+    dict — ``new_variants`` is the retrace-GUARD delta this warmup
+    compiled, the same accounting serving retraces are measured by.
+    """
+    t0 = time.perf_counter()
+    flush = getattr(backend, "flush", None)
+    if flush is not None:
+        flush()
+    segs, ks, kinds = backend._segments()
+    if not segs:
+        logger.info(
+            "tier precompilation skipped: empty index (no device "
+            "segments to trace against)"
+        )
+        return {"skipped": "empty-index", "new_variants": 0,
+                "dispatches": 0, "pack_calls": 0, "wall_ms": 0.0}
+
+    before = GUARD.counts()
+    nseg = len(segs)
+    base_cap = (
+        delivery_cap if delivery_cap is not None
+        else getattr(backend, "_delivery_cap", 4096)
+    )
+    min_bucket = getattr(backend, "compact_min_bucket", 1 << 10)
+    dispatches = pack_calls = skipped = 0
+    budget = max(1, int(max_compiles))
+
+    #: dense [M, K] tables above this many lanes are a memory/compile
+    #: hazard to trace speculatively — serving only reaches them
+    #: through the rare overflow re-resolve, which pays its own trace
+    dense_lane_budget = 1 << 24
+
+    for m, qcap in query_cap_ladder(backend, max_batch, min_batch):
+        if dispatches + pack_calls >= budget:
+            skipped += 1
+            continue
+        qtuple = backend._prepare_queries(
+            np.full(m, -1, np.int32),
+            np.zeros((m, 3), np.float64),
+            np.full(m, -1, np.int32),
+            np.zeros(m, np.int8),
+        )
+        ceiling = next_pow2(m * sum(ks))
+        # serving's tier choice (tpu_backend._dispatch_encoded): the
+        # CSR path at max(adaptive delivery cap, zone-A floor), dense
+        # once that reaches the fan-out ceiling — and dense is ALSO the
+        # overflow re-resolve at any tier, so trace it whenever its
+        # table is sanely sized
+        zone_floor = next_pow2(_CSR_ROW * qcap * nseg + 64)
+        current = next_pow2(max(base_cap, zone_floor))
+        if qcap * sum(ks) <= dense_lane_budget:
+            tgt = backend._dispatch(qtuple, segs, ks, kinds)
+            getattr(tgt, "block_until_ready", lambda: None)()
+            dispatches += 1
+        # CSR slot-capacity ladder: from the zone-A floor (the tier a
+        # decayed delivery cap lands on) through the current cap plus
+        # headroom doublings (the tiers an overflow retry climbs to)
+        top = max(current, zone_floor) << max(0, int(t_tiers) - 1)
+        seen_caps: set[int] = set()
+        t_cap = zone_floor
+        while t_cap < ceiling and t_cap <= top:
+            eff = backend._csr_effective_cap(t_cap, qtuple, segs)
+            t_cap *= 2
+            if eff in seen_caps:
+                continue
+            seen_caps.add(eff)
+            if dispatches + pack_calls >= budget:
+                skipped += 1
+                break
+            result = backend._dispatch_csr(qtuple, segs, ks, kinds, eff)
+            # synchronize: boot must end with the cache WARM, not with
+            # a compile still in flight behind an async dispatch
+            int(np.asarray(result[2]))
+            dispatches += 1
+            if not include_pack:
+                continue
+            # pack-bucket ladder for this capacity tier: feed the tier's
+            # own device result through the compaction at each bucket
+            # total the runtime can request (the call is the serving
+            # path — _compact_fetch no-ops below its min-cap gate)
+            bucket = min_bucket
+            while bucket * 2 <= eff:
+                if dispatches + pack_calls >= budget:
+                    skipped += 1
+                    break
+                backend._compact_fetch(result[0], result[1], bucket, eff)
+                pack_calls += 1
+                bucket *= 2
+
+    delta = GUARD.delta(before)
+    stats = {
+        "dispatches": dispatches,
+        "pack_calls": pack_calls,
+        "skipped_by_budget": skipped,
+        "new_variants": sum(delta.values()),
+        "families": delta,
+        "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+    }
+    logger.info(
+        "tier precompilation: %d dispatch + %d pack shapes walked, "
+        "%d new kernel variants compiled in %.0f ms%s",
+        dispatches, pack_calls, stats["new_variants"], stats["wall_ms"],
+        f" ({skipped} skipped by budget)" if skipped else "",
+    )
+    return stats

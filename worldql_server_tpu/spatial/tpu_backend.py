@@ -53,7 +53,7 @@ import logging
 import threading
 import time
 import uuid as uuid_mod
-from collections import Counter, deque
+from collections import Counter
 from functools import partial
 from typing import Sequence
 
@@ -67,10 +67,10 @@ from ..protocol.types import Replication, Vector3
 from ..utils import retrace
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
 from .hashing import (
-    MIX_M1, MIX_M2, NO_WORLD, PAD_KEY, QUERY_PAD_KEY2, n_distinct,
-    next_pow2, pad_to, spatial_keys, spatial_keys2,
+    MIX_M1, MIX_M2, NO_WORLD, PAD_KEY, n_distinct, next_pow2, pad_to,
+    spatial_keys, spatial_keys2,
 )
-from .native_keys import query_keys
+from .native_keys import encode_queries
 
 _log = logging.getLogger(__name__)
 
@@ -921,22 +921,28 @@ class TpuSpatialBackend(SpatialBackend):
         self.last_collect_stats = {
             "fetch_slots": 0, "fetch_bytes": 0, "compaction_bucket": 0,
         }
-        # Per-tick device timing split (ISSUE 7): dispatch appends
-        # {encode, h2d-enqueue, d2h-prefetch} walls; collect pops in
-        # dispatch order (the tick pipeline chains its collect stages,
-        # so FIFO pairing holds even at depth > 1), adds the device
-        # wait + fetch walls, and publishes the merged dict as
-        # ``last_device_timing`` for DeviceTelemetry to tag onto the
-        # tick trace. These are HOST-side brackets of the existing
-        # instrumentation points, not profiler truth — on a tunneled
-        # device the "compute" wall includes the link.
-        self._dispatch_timings: deque = deque()
-        self._timing_lock = threading.Lock()
+        # Per-tick device timing split (ISSUE 7): dispatch brackets
+        # {encode, h2d-enqueue, d2h-prefetch} walls into a dict that
+        # RIDES THE HANDLE (a FIFO deque was the previous design — it
+        # desynced when a collect errored before reaching its pop,
+        # silently mis-attributing every later tick's split; handle-
+        # carried timing makes pairing structural at any pipeline
+        # depth). Collect adds the device wait + fetch walls and
+        # publishes the merged dict as ``last_device_timing`` for
+        # DeviceTelemetry to tag onto the tick trace. These are
+        # HOST-side brackets of the existing instrumentation points,
+        # not profiler truth — on a tunneled device the "compute" wall
+        # includes the link.
         self._last_prefetch_ms = 0.0
         self.last_device_timing: dict = {}
         #: capacity tier of the LAST dispatch (retrace spans tag it —
         #: a tier first-hit is the expected compile trigger)
         self.last_dispatch_tier: dict = {}
+        #: dispatches that arrived pre-encoded as staged columnar
+        #: arrays (engine/staging.py) vs. as LocalQuery object lists —
+        #: the bench smoke gate asserts the staged path actually fired
+        self.staged_dispatches = 0
+        self.list_dispatches = 0
 
         # pid → base rows: lazily built per base epoch (argsort of the
         # peer column, O(S log S) once), then each eviction is two
@@ -970,6 +976,17 @@ class TpuSpatialBackend(SpatialBackend):
             np.array([cube], np.int64),
             self._seed,
         )[0])
+
+    def supports_staged_dispatch(self) -> bool:
+        return True
+
+    def interning_maps(self):
+        """Enqueue-time interning contract (engine/staging.py): both
+        dicts are owned by the event-loop thread — router enqueue,
+        subscription mutations and dispatch all run there — and are
+        append-only for the backend's lifetime, so ids interned at
+        message arrival stay valid at flush time."""
+        return self._world_ids, self._peer_ids
 
     # endregion
 
@@ -2169,18 +2186,15 @@ class TpuSpatialBackend(SpatialBackend):
     def _prepare_queries(self, world_ids, positions, sender_ids, repls):
         """Quantize + hash + pad one query batch into the device query
         tuple. 21 B/query on the wire (two keys + sender + replication)
-        — the raw (world, cube) identity stays on the host. Quantize +
-        both hashes run as one fused native pass when the C++ kernel is
-        built (spatial/native_keys.py; numpy twins otherwise)."""
-        keys, keys2 = query_keys(
-            world_ids, positions, self.cube_size, self._seed
-        )
+        — the raw (world, cube) identity stays on the host. Quantize,
+        both hashes AND the capacity-tier padding of all four columns
+        run as one fused GIL-releasing native pass when the C++ kernel
+        is built (spatial/native_keys.py wql_encode_queries; the
+        composed query_keys + pad_to path otherwise, bit-identical)."""
         cap = self._query_cap(len(world_ids))
-        return (
-            pad_to(keys, cap, PAD_KEY),
-            pad_to(keys2, cap, QUERY_PAD_KEY2),
-            pad_to(sender_ids.astype(np.int32), cap, np.int32(-1)),
-            pad_to(repls.astype(np.int8), cap, np.int8(0)),
+        return encode_queries(
+            world_ids, positions, sender_ids, repls, cap,
+            self.cube_size, self._seed,
         )
 
     def _dispatch(self, queries: tuple, segs, ks, kinds):
@@ -2219,6 +2233,13 @@ class TpuSpatialBackend(SpatialBackend):
     def dispatch_local_batch(self, queries: Sequence[LocalQuery]):
         """Encode + launch a query batch without waiting for results.
 
+        This is the OBJECT-LIST path: it re-walks every LocalQuery in
+        Python (interning dict probes, row-by-row position fills) —
+        the staged columnar path (:meth:`dispatch_staged_batch`) moves
+        that work to message-arrival time and is what the ticker uses
+        when staging is on; this path remains for the CPU-compat API,
+        immediate mode, and staging-desync fallbacks.
+
         Runs on the owning (event-loop) thread — it reads the interning
         dicts, which mutate there. The returned handle goes to
         ``collect_local_batch``, which only blocks on the device and may
@@ -2226,31 +2247,63 @@ class TpuSpatialBackend(SpatialBackend):
         """
         m = len(queries)
         if m == 0:
-            return (0, None)
+            return (0, None, {})
         t_start = time.perf_counter()
         world_ids = np.fromiter(
-            (self._world_ids.get(q.world, -1) for q in queries),
+            (self._world_ids.get(q.world, -1) for q in queries),  # wql: allow(per-query-python-loop) — the legacy list-path encode
             dtype=np.int32, count=m,
         )
         positions = np.empty((m, 3), dtype=np.float64)
-        for i, q in enumerate(queries):
+        for i, q in enumerate(queries):  # wql: allow(per-query-python-loop) — the legacy list-path encode
             positions[i] = (q.position.x, q.position.y, q.position.z)
         sender_ids = np.fromiter(
-            (self._peer_ids.get(q.sender, -1) for q in queries),
+            (self._peer_ids.get(q.sender, -1) for q in queries),  # wql: allow(per-query-python-loop) — the legacy list-path encode
             dtype=np.int32, count=m,
         )
         repls = np.fromiter(
-            (int(q.replication) for q in queries), dtype=np.int8, count=m
+            (int(q.replication) for q in queries), dtype=np.int8, count=m  # wql: allow(per-query-python-loop) — the legacy list-path encode
         )
+        self.list_dispatches += 1
+        return self._dispatch_encoded(
+            m, world_ids, positions, sender_ids, repls, t_start,
+            staged=False,
+        )
+
+    def dispatch_staged_batch(
+        self, world_ids, positions, sender_ids, repls, fallback=None,
+    ):
+        """Launch a batch straight from the ticker's staged columnar
+        arrays — world/peer interning already happened at enqueue time
+        (engine/staging.py), so this is zero per-query Python: one
+        fused vectorized encode (native when built) and the launch.
+        ``fallback`` is ignored here (see robustness/resilient.py)."""
+        m = len(world_ids)
+        if m == 0:
+            return (0, None, {})
+        t_start = time.perf_counter()
+        self.staged_dispatches += 1
+        return self._dispatch_encoded(
+            m, world_ids, positions, sender_ids, repls, t_start,
+            staged=True,
+        )
+
+    def _dispatch_encoded(
+        self, m, world_ids, positions, sender_ids, repls, t_start,
+        *, staged: bool,
+    ):
+        """Shared launch tail of both dispatch paths: flush, quantize/
+        hash/pad, pick the result layout, launch, enqueue the D2H
+        prefetch. Returns the ``(m, payload, timing)`` handle."""
         self.flush()
         segs, ks, kinds = self._segments()
         if not segs:
-            return (m, None)
+            return (m, None, {})
         qtuple = self._prepare_queries(
             world_ids, positions, sender_ids, repls
         )
-        # host-encode wall: UUID/world interning + quantize/hash/pad
-        # (index flush included — it runs on this thread either way)
+        # host-encode wall: quantize/hash/pad (+ the object-list
+        # interning loops when staged is False; index flush included —
+        # it runs on this thread either way)
         t_encoded = time.perf_counter()
         # CSR delivery: the result ships ~total ints instead of a dense
         # [M, K] table (K is set by the hottest cube). The capacity
@@ -2272,32 +2325,35 @@ class TpuSpatialBackend(SpatialBackend):
         }
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
-            self._push_timing(t_start, t_encoded, path="dense")
-            return (m, ("dense", tgt))
+            timing = self._dispatch_timing(
+                t_start, t_encoded, path="dense", staged=staged, m=m
+            )
+            return (m, ("dense", tgt), timing)
         result = self._launch(qtuple, segs, ks, kinds, csr_cap=t_cap)
-        self._push_timing(t_start, t_encoded, path="csr")
-        return (m, ("csr", t_cap, result, (qtuple, segs, ks, kinds)))
+        timing = self._dispatch_timing(
+            t_start, t_encoded, path="csr", staged=staged, m=m
+        )
+        return (m, ("csr", t_cap, result, (qtuple, segs, ks, kinds)),
+                timing)
 
-    def _push_timing(self, t_start: float, t_encoded: float,
-                     path: str) -> None:
-        """Record this dispatch's host-side timing legs for the collect
-        side to merge (FIFO — collects run in dispatch order)."""
+    def _dispatch_timing(self, t_start: float, t_encoded: float, *,
+                         path: str, staged: bool, m: int) -> dict:
+        """This dispatch's host-side timing legs. The dict RIDES THE
+        HANDLE to its own collect — pairing is structural, so an
+        errored/dropped collect can never desync attribution at
+        pipeline depth > 1 (the old FIFO deque could)."""
         now = time.perf_counter()
-        with self._timing_lock:
-            self._dispatch_timings.append({
-                "encode_ms": (t_encoded - t_start) * 1e3,
-                # launch wall: H2D enqueue + kernel dispatch (async on
-                # a real device, so this is queue time, not compute)
-                "h2d_ms": (now - t_encoded) * 1e3
-                - self._last_prefetch_ms,
-                "d2h_enqueue_ms": self._last_prefetch_ms,
-                "path": path,
-            })
-
-    def _pop_timing(self) -> dict:
-        with self._timing_lock:
-            return self._dispatch_timings.popleft() \
-                if self._dispatch_timings else {}
+        return {
+            "encode_ms": (t_encoded - t_start) * 1e3,
+            # launch wall: H2D enqueue + kernel dispatch (async on
+            # a real device, so this is queue time, not compute)
+            "h2d_ms": (now - t_encoded) * 1e3
+            - self._last_prefetch_ms,
+            "d2h_enqueue_ms": self._last_prefetch_ms,
+            "path": path,
+            "staged": staged,
+            "query_cap": self._query_cap(m),
+        }
 
     def collect_local_batch(self, handle) -> list[list[uuid_mod.UUID]]:
         """Wait for a dispatched batch and decode fan-out UUID lists.
@@ -2305,10 +2361,13 @@ class TpuSpatialBackend(SpatialBackend):
         stay valid), and the overflow fallback re-dispatches the device
         arrays CAPTURED at dispatch time — it never touches host state
         the owning thread could be mutating."""
-        m, payload = handle
+        m, payload, timing = handle
         if payload is None:
             return [[] for _ in range(m)]
-        timing = self._pop_timing()
+        # timing rides the handle (see _dispatch_timing): copy before
+        # merging so a re-collect of the same handle (drain after a
+        # cancelled collect) starts from the dispatch-side legs
+        timing = dict(timing)
         if payload[0] == "dense":
             # collect_local_batch IS the tick's designated sync point:
             # it runs on the worker thread while the loop keeps serving
@@ -2613,6 +2672,8 @@ class TpuSpatialBackend(SpatialBackend):
             "compaction_in_flight": self._compaction is not None,
             "compact_fetches": self.compact_fetches,
             "full_fetches": self.full_fetches,
+            "staged_dispatches": self.staged_dispatches,
+            "list_dispatches": self.list_dispatches,
             "last_fetch_bytes": self.last_collect_stats["fetch_bytes"],
             "last_compaction_bucket":
                 self.last_collect_stats["compaction_bucket"],
