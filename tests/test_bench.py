@@ -147,14 +147,34 @@ def test_bench_smoke_forces_compacted_collect():
 
 
 def test_bench_all_emits_one_line_per_config():
-    """--all: six configs, six JSON lines, in config order (config 7
-    re-execs with a forced device topology and runs standalone)."""
+    """--all: seven configs, seven JSON lines, in config order
+    (config 7 re-execs with a forced device topology and runs
+    standalone)."""
     records, _ = run_bench(
         "--all", "--quick", "--subs", "4000", "--queries", "256",
         "--ticks", "6", "--cpu-ticks", "2",
     )
-    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6]
-    assert len({rec["metric"] for rec in records}) == 6
+    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6, 8]
+    assert len({rec["metric"] for rec in records}) == 7
+
+
+def test_bench_config8_entity_sim():
+    """Config 8 (ISSUE 9): entity-sim workload — update ingest through
+    the delta path, device kNN tick, e2e frame latency over real ZMQ.
+    --smoke additionally asserts the device path fired, churn forced a
+    compaction, and frames were delivered."""
+    records, stderr = run_bench("--config", "8", "--smoke")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "entity_sim_knn_ms"
+    block = rec["entity_sim"]
+    assert block["updates_per_s"] > 0
+    assert block["knn_ms"] > 0
+    assert block["e2e_p99_ms"] > 0
+    assert block["e2e_frames"] > 0
+    assert block["compactions"] >= 1
+    assert block["sim_retraces_quiet"] == 0
+    assert "entity_sim:" in stderr
 
 
 @pytest.mark.slow   # two jax boots + per-mesh compiles: minutes on CPU

@@ -118,10 +118,27 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
     assert main([str(baseline), str(baseline), *gate]) == 0
 
-    rec = json.loads(baseline.read_text())
-    bad = copy.deepcopy(rec)
-    bad["engine_p99_ms"] = rec["engine_p99_ms"] * 3 + 10  # > 2x, > floor
-    bad["device"]["retraces"] = 1
+    # JSON-lines baseline: one record per smoke config (5 + 8)
+    records = [
+        json.loads(line)
+        for line in baseline.read_text().splitlines() if line.strip()
+    ]
+    by_config = {rec["config"]: rec for rec in records}
+    assert set(by_config) == {5, 8}
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 5:
+            rec["engine_p99_ms"] = (
+                rec["engine_p99_ms"] * 3 + 10  # > 2x, > floor
+            )
+            rec["device"]["retraces"] = 1
+        else:
+            # the entity-sim leaves gate too: a tripled device tick
+            rec["entity_sim"]["knn_ms"] = (
+                rec["entity_sim"]["knn_ms"] * 3 + 10
+            )
     regressed = tmp_path / "regressed.json"
-    regressed.write_text(json.dumps(bad))
+    regressed.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
     assert main([str(baseline), str(regressed), *gate]) == 1

@@ -824,7 +824,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 13
+    assert len(names) >= 14
     assert names == {
         "async-dangling-task",
         "async-suppress-await",
@@ -835,6 +835,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-traced-branch",
         "full-fetch-on-tick",
         "per-query-python-loop",
+        "host-sync-in-sim-tick",
         "store-on-loop",
         "unspanned-stage",
         "wire-mutable-buffer",
@@ -947,6 +948,119 @@ def test_per_query_loop_pragma_allows_designated_paths():
     """
     assert violations(
         src, relpath=_SPATIAL, select="per-query-python-loop"
+    ) == []
+
+
+# endregion
+
+
+# region: host-sync-in-sim-tick
+
+_ENTITIES = "worldql_server_tpu/entities/plane.py"
+_OPS_TICK = "worldql_server_tpu/ops/tick.py"
+
+
+def test_sim_tick_fires_on_host_sync_in_dispatch():
+    src = """
+    import numpy as np
+
+    class P:
+        def dispatch_tick(self):
+            state = self._state
+            return np.asarray(state.position)
+    """
+    assert rules_fired(
+        src, relpath=_ENTITIES, select="host-sync-in-sim-tick"
+    ) == {"host-sync-in-sim-tick"}
+
+
+def test_sim_tick_fires_on_item_and_per_entity_loop_in_collect():
+    src = """
+    class P:
+        def collect_tick(self, handle):
+            total = handle["counts"].item()
+            out = []
+            for row in handle["targets"]:
+                out.append(row)
+            return total, out
+    """
+    assert [r for r, _ in violations(
+        src, relpath=_ENTITIES, select="host-sync-in-sim-tick"
+    )] == ["host-sync-in-sim-tick", "host-sync-in-sim-tick"]
+
+
+def test_sim_tick_fires_on_population_comprehension_in_ops_tick():
+    src = """
+    def simulation_tick(state):
+        return [quantize(p) for p in state.position]
+    """
+    assert rules_fired(
+        src, relpath=_OPS_TICK, select="host-sync-in-sim-tick"
+    ) == {"host-sync-in-sim-tick"}
+
+
+def test_sim_tick_quiet_on_bounded_iteration():
+    src = """
+    import jax.numpy as jnp
+
+    def simulation_tick(state, w, n):
+        rid_w = jnp.stack([state.rid[s:s + n] for s in range(w)], axis=1)
+        return rid_w
+
+    class P:
+        def dispatch_tick(self):
+            out = self._fn(self._state)
+            for arr in (out[0], out[1], out[2]):
+                arr.copy_to_host_async()
+            return out
+    """
+    assert violations(
+        src, relpath=_ENTITIES, select="host-sync-in-sim-tick"
+    ) == []
+    assert violations(
+        src, relpath=_OPS_TICK, select="host-sync-in-sim-tick"
+    ) == []
+
+
+def test_sim_tick_quiet_outside_hot_functions_and_modules():
+    apply_loop = """
+    import numpy as np
+
+    class P:
+        def apply(self, result):
+            pos = np.asarray(result["pos"])
+            return [self._frame(r) for r in result["rows"]]
+    """
+    # apply/frame assembly is host delivery work — not in the hot set
+    assert violations(
+        apply_loop, relpath=_ENTITIES, select="host-sync-in-sim-tick"
+    ) == []
+    # same code in a module the rule does not scope: other rules' turf
+    dispatch_elsewhere = """
+    import numpy as np
+
+    class P:
+        def dispatch_tick(self):
+            return np.asarray(self._state)
+    """
+    assert violations(
+        dispatch_elsewhere,
+        relpath="worldql_server_tpu/engine/ticker.py",
+        select="host-sync-in-sim-tick",
+    ) == []
+
+
+def test_sim_tick_pragma_allows_designated_collect_points():
+    src = """
+    import numpy as np
+
+    class P:
+        def collect_tick(self, handle):
+            pos = np.asarray(handle["pos"])  # wql: allow(host-sync-in-sim-tick)
+            return pos
+    """
+    assert violations(
+        src, relpath=_ENTITIES, select="host-sync-in-sim-tick"
     ) == []
 
 

@@ -1,0 +1,550 @@
+"""Entity simulation plane (ISSUE 9): wire ingest, device tick, index
+coupling, and the end-to-end path — registration + position updates
+over a REAL transport, through a device tick, to delivered neighbor
+frames. The churn scenarios force the LSM base+delta index through at
+least one compaction mid-stream; the WS variant importorskips
+``websockets`` (minimal containers run the ZMQ legs only)."""
+
+import asyncio
+import struct
+import uuid
+
+import pytest
+
+from tests.client_util import ZmqClient, free_port
+from worldql_server_tpu.engine.config import (
+    Config,
+    apply_device_boot_defaults,
+)
+from worldql_server_tpu.engine.peers import PeerMap
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.entities import PARAM_FRAME, PARAM_REMOVE, EntityPlane
+from worldql_server_tpu.protocol import Instruction, Message
+from worldql_server_tpu.protocol.types import Entity, Vector3
+from worldql_server_tpu.spatial.quantize import cube_coords
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+from worldql_server_tpu.utils.retrace import GUARD
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def vel_flex(vx, vy=0.0, vz=0.0) -> bytes:
+    """Wire velocity encoding: 12 LE f32 bytes on Entity.flex."""
+    return struct.pack("<3f", vx, vy, vz)
+
+
+def make_plane(k=4, cube=16, dt=0.05, **backend_kw):
+    backend = TpuSpatialBackend(cube, **backend_kw)
+    plane = EntityPlane(
+        backend, PeerMap(), cube_size=cube, k=k, dt=dt, bounds=1000.0
+    )
+    return backend, plane
+
+
+def ent_msg(sender, entities, parameter=None, world="w"):
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+        world_name=world, parameter=parameter, entities=entities,
+    )
+
+
+def tick(plane):
+    handle = plane.dispatch_tick()
+    assert handle is not None
+    return plane.apply(plane.collect_tick(handle))
+
+
+def make_server(**overrides) -> WorldQLServer:
+    config = Config()
+    config.store_url = "memory://"
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_server_port = free_port()
+    config.zmq_server_host = "127.0.0.1"
+    config.spatial_backend = "tpu"
+    config.tick_interval = 0.03
+    config.entity_sim = True
+    config.entity_k = 4
+    backend = overrides.pop("backend", None)
+    for k, v in overrides.items():
+        setattr(config, k, v)
+    return WorldQLServer(config, backend=backend)
+
+
+# region: plane unit behavior
+
+
+def test_register_update_remove_and_refcounted_index_rows():
+    backend, plane = make_plane()
+    peer = uuid.uuid4()
+    e1, e2 = uuid.uuid4(), uuid.uuid4()
+    # two entities of ONE peer in the SAME cube share one index row
+    plane.ingest(ent_msg(peer, [
+        Entity(uuid=e1, position=Vector3(1, 1, 1), world_name="w"),
+        Entity(uuid=e2, position=Vector3(2, 2, 2), world_name="w"),
+    ]))
+    assert plane.entity_count == 2
+    assert backend.subscription_count() == 1
+    assert backend.query_cube("w", Vector3(1, 1, 1)) == {peer}
+    # removing one keeps the shared row; removing both drops it
+    plane.ingest(ent_msg(peer, [Entity(uuid=e1)], parameter=PARAM_REMOVE))
+    assert plane.entity_count == 1
+    assert backend.subscription_count() == 1
+    plane.ingest(ent_msg(peer, [Entity(uuid=e2)], parameter=PARAM_REMOVE))
+    assert plane.entity_count == 0
+    assert backend.subscription_count() == 0
+    # slots recycle
+    plane.ingest(ent_msg(peer, [
+        Entity(uuid=uuid.uuid4(), position=Vector3(5, 5, 5), world_name="w")
+    ]))
+    assert plane.entity_count == 1
+
+
+def test_update_keeps_velocity_and_rejects_foreign_owner():
+    backend, plane = make_plane()
+    owner, thief = uuid.uuid4(), uuid.uuid4()
+    ent = uuid.uuid4()
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=ent, position=Vector3(0.5, 0.5, 0.5), world_name="w",
+        flex=vel_flex(40.0),
+    )]))
+    slot = plane._slot_of[ent]
+    assert plane._vel[slot, 0] == pytest.approx(40.0)
+    # update without flex: position moves, velocity survives
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=ent, position=Vector3(3, 3, 3), world_name="w",
+    )]))
+    assert plane._vel[slot, 0] == pytest.approx(40.0)
+    assert plane._pos[slot, 0] == pytest.approx(3.0)
+    # a different peer cannot move or remove someone else's entity
+    assert plane.ingest(ent_msg(thief, [Entity(
+        uuid=ent, position=Vector3(9, 9, 9), world_name="w",
+    )])) == 0
+    assert plane.ingest(
+        ent_msg(thief, [Entity(uuid=ent)], parameter=PARAM_REMOVE)
+    ) == 0
+    assert plane._pos[slot, 0] == pytest.approx(3.0)
+
+
+def test_max_entities_cap_rejects_registrations():
+    backend, plane = make_plane()
+    plane.max_entities = 2
+    peer = uuid.uuid4()
+    ents = [Entity(uuid=uuid.uuid4(), position=Vector3(i, 0, 0),
+                   world_name="w") for i in range(3)]
+    plane.ingest(ent_msg(peer, ents))
+    assert plane.entity_count == 2
+    assert plane.rejected == 1
+
+
+def test_tick_resolves_neighbors_and_applies_except_self_per_peer():
+    backend, plane = make_plane()
+    pa, pb = uuid.uuid4(), uuid.uuid4()
+    ea, eb, ec = uuid.uuid4(), uuid.uuid4(), uuid.uuid4()
+    # ea (peer a) and eb (peer b) co-cube; ec (peer a) co-cube too —
+    # frames never target the entity's own peer
+    plane.ingest(ent_msg(pa, [
+        Entity(uuid=ea, position=Vector3(1, 1, 1), world_name="w"),
+        Entity(uuid=ec, position=Vector3(2, 1, 1), world_name="w"),
+    ]))
+    plane.ingest(ent_msg(pb, [
+        Entity(uuid=eb, position=Vector3(1, 2, 1), world_name="w"),
+    ]))
+    pairs = tick(plane)
+    by_entity = {m.entities[0].uuid: set(t) for m, t in pairs}
+    assert by_entity[ea] == {pb}
+    assert by_entity[ec] == {pb}
+    assert by_entity[eb] == {pa}
+    for message, _ in pairs:
+        assert message.parameter == PARAM_FRAME
+        assert message.instruction == Instruction.LOCAL_MESSAGE
+
+
+def test_bounded_staleness_index_follows_integrated_position():
+    """The documented contract: after an applied tick, the cube
+    registered in the authoritative index IS the (golden host f64)
+    quantization of the entity's last integrated position — queries
+    lag the device state by at most one applied tick."""
+    backend, plane = make_plane(dt=0.1)
+    peer = uuid.uuid4()
+    ent = uuid.uuid4()
+    plane.ingest(ent_msg(peer, [Entity(
+        uuid=ent, position=Vector3(1, 1, 1), world_name="w",
+        flex=vel_flex(50.0),
+    )]))
+    for _ in range(8):
+        tick(plane)
+        slot = plane._slot_of[ent]
+        pos = plane._pos[slot]
+        expected = cube_coords(
+            float(pos[0]), float(pos[1]), float(pos[2]), 16
+        )
+        assert tuple(int(c) for c in plane._cube[slot]) == expected
+        # and the index agrees: the owner is subscribed exactly there
+        assert peer in backend.query_cube("w", expected)
+    assert plane.index_moves > 0
+
+
+def test_churn_through_delta_path_forces_compaction():
+    """Sustained cube-crossing churn must flow through the index's
+    base+delta path and trigger at least one LSM compaction — the
+    moving-object regime ASH/1411.3212 describe (ROADMAP item 4)."""
+    backend, plane = make_plane(compact_threshold=8)
+    peers = [uuid.uuid4() for _ in range(4)]
+    ents = [uuid.uuid4() for _ in range(24)]
+    for i, ent in enumerate(ents):
+        plane.ingest(ent_msg(peers[i % 4], [Entity(
+            uuid=ent, position=Vector3(i * 40.0, 0.5, 0.5),
+            world_name="w", flex=vel_flex(170.0),
+        )]))
+    compactions_seen = 0
+    for _ in range(12):
+        tick(plane)
+        backend.wait_compaction()
+        compactions_seen = max(compactions_seen, backend.compactions)
+    assert compactions_seen >= 1
+    assert plane.index_moves > 0
+    # index integrity after the folds: every entity still queryable
+    # at its current position
+    for ent in ents:
+        slot = plane._slot_of[ent]
+        pos = plane._pos[slot]
+        owner = plane._peer_uuids[int(plane._pid[slot])]
+        assert owner in backend.query_cube(
+            "w", Vector3(float(pos[0]), float(pos[1]), float(pos[2]))
+        )
+
+
+def test_peer_removal_releases_slots_and_refcounts():
+    backend, plane = make_plane()
+    pa, pb = uuid.uuid4(), uuid.uuid4()
+    plane.ingest(ent_msg(pa, [
+        Entity(uuid=uuid.uuid4(), position=Vector3(1, 1, 1),
+               world_name="w") for _ in range(3)
+    ]))
+    plane.ingest(ent_msg(pb, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(2, 2, 2), world_name="w",
+    )]))
+    # the server purges index rows via backend.remove_peer first,
+    # then releases the plane's bookkeeping (same order as
+    # WorldQLServer._on_peer_remove)
+    backend.remove_peer(pa)
+    assert plane.on_peer_removed(pa) == 3
+    assert plane.entity_count == 1
+    assert backend.query_cube("w", Vector3(1, 1, 1)) == {pb}
+    tick(plane)  # survivors still tick
+
+
+def test_entity_churn_with_resilient_backend_keeps_mirror_consistent():
+    """Regression: bulk remove/move used to fall through the
+    ResilientBackend's ``__getattr__`` straight to the inner backend,
+    bypassing the CPU mirror — a rebuild would then resurrect rows
+    the churn had retired."""
+    from worldql_server_tpu.robustness.resilient import ResilientBackend
+
+    backend = ResilientBackend(
+        TpuSpatialBackend(16), factory=lambda: TpuSpatialBackend(16)
+    )
+    plane = EntityPlane(
+        backend, PeerMap(), cube_size=16, k=4, dt=0.1, bounds=1000.0
+    )
+    peer = uuid.uuid4()
+    ent = uuid.uuid4()
+    plane.ingest(ent_msg(peer, [Entity(
+        uuid=ent, position=Vector3(1, 1, 1), world_name="w",
+        flex=vel_flex(60.0),
+    )]))
+    for _ in range(5):
+        tick(plane)
+    assert plane.index_moves > 0
+    slot = plane._slot_of[ent]
+    pos = Vector3(*(float(c) for c in plane._pos[slot]))
+    # the mirror tracked every move: exactly one row, at the current
+    # cube, on BOTH sides
+    assert backend.mirror.query_cube("w", pos) == {peer}
+    assert backend.mirror.subscription_count() == 1
+    assert backend.query_cube("w", pos) == {peer}
+    # a rebuild from the mirror preserves exactly that state
+    backend._rebuild()
+    assert backend.query_cube("w", pos) == {peer}
+    assert backend.subscription_count() == 1
+
+
+def test_retrace_guard_steady_state_budget():
+    """Steady ticks at one capacity tier must not grow the sim
+    kernel's compile cache (entities.sim_tick family)."""
+    backend, plane = make_plane()
+    peer = uuid.uuid4()
+    plane.ingest(ent_msg(peer, [
+        Entity(uuid=uuid.uuid4(), position=Vector3(i, 1, 1),
+               world_name="w", flex=vel_flex(10.0)) for i in range(8)
+    ]))
+    tick(plane)  # first tick compiles the tier
+    since = GUARD.snapshot()
+    for _ in range(6):
+        tick(plane)
+    delta = GUARD.delta(since)
+    assert delta.get("entities.sim_tick", 0) == 0, delta
+
+
+# endregion
+
+# region: end-to-end over real transports
+
+
+async def _register(client, ent, pos, vel=None, world="w"):
+    await client.send(Message(
+        instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+        entities=[Entity(
+            uuid=ent, position=pos, world_name=world,
+            flex=vel_flex(*vel) if vel else None,
+        )],
+    ))
+
+
+async def _entity_sim_scenario(server):
+    """Shared ZMQ scenario: register two co-cube entities from two
+    peers, stream position updates, and assert neighbor frames arrive
+    through the delivery path with the device path provably firing."""
+    await server.start()
+    try:
+        a = await ZmqClient.connect(server.config.zmq_server_port)
+        b = await ZmqClient.connect(server.config.zmq_server_port)
+        ea, eb = uuid.uuid4(), uuid.uuid4()
+        await _register(a, ea, Vector3(1, 2, 3), vel=(25.0,))
+        await _register(b, eb, Vector3(2, 2, 3))
+
+        frame_b = await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+        assert frame_b.parameter == PARAM_FRAME
+        assert frame_b.entities[0].uuid == ea
+        assert frame_b.sender_uuid == a.uuid
+        frame_a = await a.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+        assert frame_a.entities[0].uuid == eb
+
+        # stream updates: the moving entity's frames keep arriving
+        # with advancing positions (device integration visible on the
+        # wire), and the device path provably fired
+        last_x = frame_b.entities[0].position.x
+        for i in range(3):
+            await _register(b, eb, Vector3(2, 2, 3))  # keep b co-cube
+            frame = await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+            assert frame.parameter == PARAM_FRAME
+        assert frame.entities[0].position.x > last_x
+
+        plane = server.entity_plane
+        assert plane.dispatches > 0
+        assert plane.applied_ticks > 0
+        assert plane.frames > 0
+        # steady-state retrace budget: more ticks, no new variants
+        since = GUARD.snapshot()
+        for _ in range(3):
+            await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+        assert GUARD.delta(since).get("entities.sim_tick", 0) == 0
+        stats = server.metrics.snapshot()
+        assert stats["counters"].get("sim.frames", 0) > 0
+        await a.close()
+        await b.close()
+    finally:
+        await server.stop()
+
+
+def test_entity_sim_e2e_over_zmq_in_process_delivery():
+    run(_entity_sim_scenario(make_server()))
+
+
+def test_entity_sim_e2e_over_zmq_with_delivery_workers():
+    run(_entity_sim_scenario(make_server(delivery_workers=1)))
+
+
+def test_entity_sim_e2e_churn_compaction_over_zmq():
+    """The acceptance churn pass: position updates streamed over the
+    wire force at least one delta compaction mid-stream, and frames
+    still arrive afterwards."""
+
+    async def scenario():
+        backend = TpuSpatialBackend(16, compact_threshold=8)
+        server = make_server(backend=backend)
+        await server.start()
+        try:
+            a = await ZmqClient.connect(server.config.zmq_server_port)
+            b = await ZmqClient.connect(server.config.zmq_server_port)
+            ents = [uuid.uuid4() for _ in range(16)]
+            for i, ent in enumerate(ents):
+                await _register(
+                    a if i % 2 else b, ent,
+                    Vector3(i * 40.0, 1, 1), vel=(200.0,),
+                )
+            # drive updates while the sim churns cubes every tick
+            deadline = asyncio.get_running_loop().time() + 20
+            while (backend.compactions < 1
+                   and asyncio.get_running_loop().time() < deadline):
+                for i, ent in enumerate(ents[:4]):
+                    await _register(
+                        a if i % 2 else b, ent,
+                        Vector3(i * 40.0, 1, 1), vel=(200.0,),
+                    )
+                await asyncio.sleep(0.1)
+            backend.wait_compaction()
+            assert backend.compactions >= 1, (
+                "no delta compaction fired mid-stream"
+            )
+            # frames still flow after the fold
+            frame = await a.recv_until(Instruction.LOCAL_MESSAGE,
+                                       timeout=15)
+            assert frame.parameter == PARAM_FRAME
+            await a.close()
+            await b.close()
+        finally:
+            await server.stop()
+
+    run(scenario(), timeout=120)
+
+
+def test_entity_sim_e2e_over_websocket():
+    pytest.importorskip("websockets")
+    from tests.client_util import WsClient
+
+    async def scenario():
+        config_port = free_port()
+        server = make_server()
+        server.config.ws_enabled = True
+        server.config.ws_port = config_port
+        server.config.ws_host = "127.0.0.1"
+        await server.start()
+        try:
+            a = await WsClient.connect(config_port)
+            b = await WsClient.connect(config_port)
+            ea, eb = uuid.uuid4(), uuid.uuid4()
+            await _register(a, ea, Vector3(1, 2, 3), vel=(25.0,))
+            await _register(b, eb, Vector3(2, 2, 3))
+            frame = await b.recv_until(Instruction.LOCAL_MESSAGE,
+                                       timeout=15)
+            assert frame.parameter == PARAM_FRAME
+            assert frame.entities[0].uuid == ea
+            await a.close()
+            await b.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_peer_disconnect_sweeps_entities_e2e():
+    async def scenario():
+        server = make_server()
+        await server.start()
+        try:
+            a = await ZmqClient.connect(server.config.zmq_server_port)
+            b = await ZmqClient.connect(server.config.zmq_server_port)
+            ea, eb = uuid.uuid4(), uuid.uuid4()
+            await _register(a, ea, Vector3(1, 2, 3))
+            await _register(b, eb, Vector3(2, 2, 3))
+            await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=15)
+            assert server.entity_plane.entity_count == 2
+            await server.peer_map.remove(a.uuid)
+            assert server.entity_plane.entity_count == 1
+            # the departed peer's entity (and index rows) are gone
+            assert server.backend.query_cube("w", Vector3(1, 2, 3)) \
+                == {b.uuid}
+            await a.close()
+            await b.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion
+
+# region: default-on device boot (ROADMAP item 5, first half)
+
+
+def test_device_boot_defaults_apply_when_accelerator_present():
+    config = Config()
+    config.store_url = "memory://"
+    applied = apply_device_boot_defaults(
+        config, backend_explicit=False, interval_explicit=False,
+        present=True,
+    )
+    assert applied
+    assert config.spatial_backend == "tpu"
+    assert config.tick_interval == 0.05
+
+
+def test_device_boot_defaults_cpu_fallback_is_byte_for_byte():
+    """On a host without an accelerator the config must come back
+    UNTOUCHED — field for field identical to a freshly built one."""
+    config = Config()
+    baseline = Config()
+    applied = apply_device_boot_defaults(
+        config, backend_explicit=False, interval_explicit=False,
+        present=False,
+    )
+    assert not applied
+    assert config == baseline
+
+
+def test_device_boot_defaults_respect_explicit_choice(monkeypatch):
+    # explicit flag wins outright
+    config = Config()
+    assert not apply_device_boot_defaults(
+        config, backend_explicit=True, interval_explicit=False,
+        present=True,
+    )
+    assert config.spatial_backend == "cpu"
+    # explicit env var wins too
+    monkeypatch.setenv("WQL_SPATIAL_BACKEND", "cpu")
+    config2 = Config()
+    assert not apply_device_boot_defaults(
+        config2, backend_explicit=False, interval_explicit=False,
+        present=True,
+    )
+    assert config2.spatial_backend == "cpu"
+    monkeypatch.delenv("WQL_SPATIAL_BACKEND")
+    # explicit interval survives the backend default
+    config3 = Config()
+    config3.tick_interval = 0.2
+    assert apply_device_boot_defaults(
+        config3, backend_explicit=False, interval_explicit=True,
+        present=True,
+    )
+    assert config3.spatial_backend == "tpu"
+    assert config3.tick_interval == 0.2
+
+
+def test_accelerator_probe_honors_opt_outs(monkeypatch, tmp_path):
+    from worldql_server_tpu.engine.config import accelerator_present
+
+    fake = tmp_path / "accel0"
+    fake.write_text("")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert accelerator_present(probe_paths=(str(fake),))
+    monkeypatch.setenv("WQL_DEVICE_DEFAULTS", "0")
+    assert not accelerator_present(probe_paths=(str(fake),))
+    monkeypatch.delenv("WQL_DEVICE_DEFAULTS")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not accelerator_present(probe_paths=(str(fake),))
+    assert not accelerator_present(probe_paths=("/nonexistent/accel",))
+
+
+def test_entity_sim_config_validation():
+    config = Config()
+    config.store_url = "memory://"
+    config.entity_sim = True
+    config.spatial_backend = "cpu"
+    config.tick_interval = 0
+    with pytest.raises(ValueError, match="device spatial backend"):
+        config.validate()
+    config.spatial_backend = "tpu"
+    with pytest.raises(ValueError, match="tick_interval"):
+        config.validate()
+    config.tick_interval = 0.05
+    config.validate()
+    config.entity_k = 0
+    with pytest.raises(ValueError, match="entity_k"):
+        config.validate()
+
+
+# endregion

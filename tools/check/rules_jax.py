@@ -276,6 +276,109 @@ def _check_per_query_loop(ctx: FileContext) -> Iterator[Violation]:
                     )
 
 
+#: sim-tick hot functions of the entity plane (entities/plane.py): the
+#: device dispatch/collect pair a simulation tick flows through. Frame
+#: assembly and index churn (`apply`, `_build_frames`) are host
+#: delivery/index work — O(fan-out)/O(churn) like the router — and
+#: deliberately NOT in this set.
+_SIM_TICK_FUNCS = {"dispatch_tick", "collect_tick"}
+
+
+def _is_entities_module(relpath: str) -> bool:
+    return "/entities/" in relpath or relpath.startswith("entities/")
+
+
+def _is_sim_ops_module(relpath: str) -> bool:
+    return relpath.endswith("ops/tick.py")
+
+
+def _is_bounded_iter(node: ast.AST) -> bool:
+    """Iterables that cannot scale with the entity population: range()
+    (static shift/window counts) and tuple/list/set literals (a fixed
+    handful of arrays, e.g. a prefetch over three result buffers)."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set, ast.Constant)):
+        return True
+    if isinstance(node, ast.Call) and dotted_name(node.func) == "range":
+        return True
+    if (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) in _ITER_WRAPPERS
+    ):
+        return all(_is_bounded_iter(a) for a in node.args)
+    return False
+
+
+def _check_sim_tick(ctx: FileContext) -> Iterator[Violation]:
+    """The entity-sim analog of jax-host-sync + per-query-python-loop:
+    inside sim-tick hot functions (``dispatch_tick``/``collect_tick``
+    in ``entities/`` and every function of ``ops/tick.py``), flag
+    (a) implicit device→host syncs — legal only at the designated
+    collect points, pragma'd ``# wql: allow(host-sync-in-sim-tick)`` —
+    and (b) Python loops/comprehensions over anything that scales with
+    the entity population (``range()`` windows and literal-tuple
+    iterations are the bounded exceptions). One stray ``.item()`` or
+    per-entity loop turns the one-kernel tick into an O(N) host crawl."""
+    ops = _is_sim_ops_module(ctx.relpath)
+    if not ops and not _is_entities_module(ctx.relpath):
+        return
+    if ops:
+        scopes = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+    else:
+        scopes = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in _SIM_TICK_FUNCS
+        ]
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                reason = _host_sync_reason(node)
+                if reason is not None:
+                    yield from ctx.flag(
+                        SIM_TICK_HAZARD,
+                        node,
+                        f"{reason} in sim-tick function "
+                        f"`{scope.name}` forces an implicit "
+                        "device→host sync mid-tick; keep the value on "
+                        "device, or mark the designated collect point "
+                        "with `# wql: allow(host-sync-in-sim-tick)`",
+                    )
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if not _is_bounded_iter(node.iter):
+                    yield from ctx.flag(
+                        SIM_TICK_HAZARD,
+                        node,
+                        "Python loop over a population-sized iterable "
+                        f"in sim-tick function `{scope.name}` — the "
+                        "tick must stay one fused kernel over the SoA "
+                        "columns; vectorize, move the work to "
+                        "apply()/frame assembly, or mark a deliberate "
+                        "bounded loop with "
+                        "`# wql: allow(host-sync-in-sim-tick)`",
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+            ):
+                if any(
+                    not _is_bounded_iter(gen.iter)
+                    for gen in node.generators
+                ):
+                    yield from ctx.flag(
+                        SIM_TICK_HAZARD,
+                        node,
+                        "per-element comprehension/generator over a "
+                        "population-sized iterable in sim-tick "
+                        f"function `{scope.name}` — still a Python "
+                        "loop; vectorize over the SoA columns or mark "
+                        "a deliberate bounded site with "
+                        "`# wql: allow(host-sync-in-sim-tick)`",
+                    )
+
+
 def _is_jax_jit_ref(node: ast.AST) -> bool:
     return dotted_name(node) in ("jax.jit", "jit")
 
@@ -416,5 +519,13 @@ PER_QUERY_LOOP = Rule(
     "stage columns at enqueue instead, or pragma the CPU/fallback path)",
     _check_per_query_loop,
 )
+SIM_TICK_HAZARD = Rule(
+    "host-sync-in-sim-tick",
+    "implicit host sync or per-entity Python loop in a sim-tick "
+    "function (entities/ dispatch/collect, ops/tick.py — the tick "
+    "must stay one fused kernel; pragma the designated collect points)",
+    _check_sim_tick,
+)
 
-RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH, PER_QUERY_LOOP]
+RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH,
+         PER_QUERY_LOOP, SIM_TICK_HAZARD]

@@ -164,6 +164,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slow-tick-dir", dest="slow_tick_dir",
                    help="directory for slow-tick dump files "
                         "(default ./slow_ticks)")
+    p.add_argument("--entity-sim", action="store_true",
+                   help="entity simulation plane: clients register/"
+                        "update entities over the wire (the entities "
+                        "list on Local/GlobalMessage) and every ticker "
+                        "flush integrates + resolves per-entity kNN on "
+                        "device, delivering neighbor frames through "
+                        "the fan-out path (requires a device backend "
+                        "and --tick-interval > 0; default off)")
+    p.add_argument("--entity-k", type=int, dest="entity_k",
+                   help="neighbors resolved per entity per tick "
+                        "(default 8)")
+    p.add_argument("--entity-bounds", type=float, dest="entity_bounds",
+                   help="world half-extent; positions reflect at "
+                        "±bounds (default 1000)")
+    p.add_argument("--entity-max", type=int, dest="entity_max",
+                   help="live-entity hard cap (default 65536)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -185,6 +201,7 @@ _OVERRIDES = [
     "failpoints", "failpoints_seed", "resilience", "failover_after",
     "supervisor_budget", "supervisor_backoff",
     "slow_tick_ms", "flight_recorder_depth", "slow_tick_dir",
+    "entity_k", "entity_bounds", "entity_max",
 ]
 
 
@@ -203,6 +220,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         config.trace = True
     if args.no_device_telemetry:
         config.device_telemetry = False
+    if args.entity_sim:
+        config.entity_sim = True
     if args.precompile_tiers_flag:
         config.precompile_tiers = True
     if args.no_precompile_tiers:
@@ -269,6 +288,16 @@ def main(argv: list[str] | None = None) -> int:
         trace.enable()
 
     config = config_from_args(args)
+    # Default-on device boot (ROADMAP 5): with an accelerator attached
+    # and no backend preference expressed, a bare invocation serves the
+    # batched device engine; a CPU-only host keeps the config untouched.
+    from .engine.config import apply_device_boot_defaults
+
+    apply_device_boot_defaults(
+        config,
+        backend_explicit=args.spatial_backend is not None,
+        interval_explicit=args.tick_interval is not None,
+    )
     try:
         config.validate()
     except ValueError as exc:

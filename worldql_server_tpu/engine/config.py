@@ -267,6 +267,31 @@ class Config:
     precompile_tiers: bool = field(
         default_factory=lambda: _env("WQL_PRECOMPILE_TIERS", "1") == "1"
     )
+    # Entity simulation plane (worldql_server_tpu/entities): clients
+    # register/update entities over the wire (the `entities` list on
+    # Local/GlobalMessage), and every ticker flush integrates positions
+    # + resolves per-entity kNN neighborhoods on device (ops/tick.py),
+    # delivering neighbor frames through the normal fan-out path. Off
+    # by default — the broker then never constructs the plane. Requires
+    # a device backend ('tpu'/'sharded') and tick_interval > 0.
+    entity_sim: bool = field(
+        default_factory=lambda: _env("WQL_ENTITY_SIM", "0") == "1"
+    )
+    # Neighbors resolved per entity per tick (the kNN degree; the
+    # stencil window is exact while cube occupancy <= k).
+    entity_k: int = field(
+        default_factory=lambda: int(_env("WQL_ENTITY_K", "8"))
+    )
+    # World half-extent: integrated positions reflect at ±bounds.
+    entity_bounds: float = field(
+        default_factory=lambda: float(_env("WQL_ENTITY_BOUNDS", "1000"))
+    )
+    # Hard cap on live entities (registrations beyond it are rejected
+    # with a warning — one peer must not be able to grow device state
+    # without bound).
+    entity_max: int = field(
+        default_factory=lambda: int(_env("WQL_ENTITY_MAX", str(1 << 16)))
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -397,6 +422,24 @@ class Config:
             errors.append("mesh_batch must be greater than 0")
         if self.mesh_space < 0:
             errors.append("mesh_space must be >= 0 (0 = all remaining devices)")
+        if self.entity_sim:
+            if self.spatial_backend == "cpu":
+                errors.append(
+                    "entity_sim requires a device spatial backend "
+                    "('tpu' or 'sharded') — the simulation tick "
+                    "integrates and resolves kNN on device"
+                )
+            if self.tick_interval <= 0:
+                errors.append(
+                    "entity_sim requires tick_interval > 0 — the "
+                    "simulation advances once per ticker flush"
+                )
+        if self.entity_k < 1:
+            errors.append("entity_k must be >= 1")
+        if self.entity_bounds <= 0:
+            errors.append("entity_bounds must be > 0")
+        if self.entity_max < 1:
+            errors.append("entity_max must be >= 1")
 
         if errors:
             raise ValueError("; ".join(errors))
@@ -407,3 +450,55 @@ class Config:
         slow-tick threshold — an auto-dump without spans would be an
         empty tree."""
         return self.trace or self.slow_tick_ms is not None
+
+
+#: device nodes whose presence means a non-CPU jax backend will attach
+#: (TPU chips appear as /dev/accel*, PCIe VFIO passthrough as
+#: /dev/vfio, NVIDIA GPUs as /dev/nvidia*). A filesystem probe instead
+#: of importing jax: on a device-less host the CPU boot path must not
+#: pay (or hang in) accelerator-plugin discovery just to learn there is
+#: nothing to discover.
+_DEVICE_NODES = ("/dev/accel0", "/dev/vfio/0", "/dev/nvidia0")
+
+
+def accelerator_present(probe_paths=_DEVICE_NODES) -> bool:
+    """True when a non-CPU accelerator is visibly attached. Honors the
+    opt-outs: WQL_DEVICE_DEFAULTS=0 disables the probe outright, and a
+    JAX_PLATFORMS env pinned to cpu means the operator already decided
+    (jaxconf forces the cpu platform for that case)."""
+    if os.environ.get("WQL_DEVICE_DEFAULTS", "1") == "0":
+        return False
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    return any(os.path.exists(p) for p in probe_paths)
+
+
+def apply_device_boot_defaults(
+    config: Config,
+    *,
+    backend_explicit: bool,
+    interval_explicit: bool,
+    present: bool | None = None,
+) -> bool:
+    """Default-on device boot (ROADMAP item 5): when an accelerator is
+    attached and the operator expressed NO preference (no flag, no env
+    var), a bare ``python -m worldql_server_tpu`` serves the batched
+    device engine — ``spatial_backend='tpu'``, ``tick_interval=0.05``.
+    Explicit settings always win, field by field; on a CPU-only host
+    the config is returned untouched, byte for byte. Returns whether
+    the defaults were applied."""
+    if backend_explicit or os.environ.get("WQL_SPATIAL_BACKEND"):
+        return False
+    if present is None:
+        present = accelerator_present()
+    if not present:
+        return False
+    config.spatial_backend = "tpu"
+    if not interval_explicit and not os.environ.get("WQL_TICK_INTERVAL"):
+        config.tick_interval = 0.05
+    logger.info(
+        "accelerator detected — defaulting to the batched device "
+        "engine (--spatial-backend tpu --tick-interval %g)",
+        config.tick_interval,
+    )
+    return True

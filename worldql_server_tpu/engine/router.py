@@ -50,6 +50,7 @@ class Router:
         metrics=None,
         durability: DurabilityPipeline | None = None,
         tracer=None,
+        entity_plane=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
@@ -58,6 +59,11 @@ class Router:
         # batch instead of resolving immediately (engine/ticker.py).
         self.ticker = ticker
         self.metrics = metrics
+        # Optional entities.EntityPlane (--entity-sim): a Local/Global-
+        # Message whose `entities` list is non-empty is an entity
+        # registration/update batch for the simulation plane, consumed
+        # here instead of fanning out as pub/sub.
+        self.entity_plane = entity_plane
         # Optional observability.Tracer: per-message handle spans with
         # the instruction as tag. One `enabled` branch per message when
         # off — same budget as the trace_packet call below.
@@ -200,7 +206,24 @@ class Router:
 
     # region: pub/sub fan-out (processing/local_message.rs, global_message.rs)
 
+    def _entity_ingest(self, message: Message) -> bool:
+        """Entity-sim control plane: in --entity-sim mode a Local/
+        GlobalMessage carrying entities registers/updates them (or
+        removes, parameter 'entity.remove') and is consumed — the
+        reference carries the field but never uses it (SURVEY
+        "What's missing" #3). Returns True when consumed."""
+        if self.entity_plane is None or not message.entities:
+            return False
+        applied = self.entity_plane.ingest(message)
+        if self.metrics is not None:
+            self.metrics.inc("messages.entity_batches")
+            if applied:
+                self.metrics.inc("messages.entity_ops", applied)
+        return True
+
     async def _local_message(self, message: Message) -> None:
+        if self._entity_ingest(message):
+            return
         if message.world_name == GLOBAL_WORLD:
             logger.debug(
                 "invalid LocalMessage from %s, uses @global", message.sender_uuid
@@ -243,6 +266,8 @@ class Router:
                 )
 
     async def _global_message(self, message: Message) -> None:
+        if self._entity_ingest(message):
+            return
         sender = message.sender_uuid
         if message.world_name == GLOBAL_WORLD:
             # World-wide broadcast to every connected peer

@@ -163,6 +163,24 @@ class WorldQLServer:
             on_remove=self._on_peer_remove, metrics=self.metrics,
             plane=self.delivery_plane,
         )
+        # Entity simulation plane (worldql_server_tpu/entities): the
+        # device-resident moving-object workload. Constructed only in
+        # --entity-sim mode (validate() guarantees a device backend +
+        # ticker exist for it); the broker-only path never imports it.
+        self.entity_plane = None
+        if config.entity_sim:
+            from ..entities import EntityPlane
+
+            self.entity_plane = EntityPlane(
+                self.backend, self.peer_map,
+                cube_size=config.sub_region_size,
+                k=config.entity_k,
+                dt=config.tick_interval,
+                bounds=config.entity_bounds,
+                max_entities=config.entity_max,
+                metrics=self.metrics,
+                tracer=self.tracer,
+            )
         self.ticker = None
         self.staging = None
         if config.tick_interval > 0:
@@ -186,6 +204,7 @@ class WorldQLServer:
                 supervisor=self.supervisor, tracer=self.tracer,
                 device_telemetry=self.device_telemetry,
                 staging=self.staging,
+                entity_plane=self.entity_plane,
             )
         self.precompile_stats: dict | None = None
         # Durability engine: WAL + write-behind pipeline. With
@@ -217,6 +236,7 @@ class WorldQLServer:
             self.peer_map, self.backend, self.store,
             ticker=self.ticker, metrics=self.metrics,
             durability=self.durability, tracer=self.tracer,
+            entity_plane=self.entity_plane,
         )
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
@@ -282,6 +302,8 @@ class WorldQLServer:
                     f"delivery.worker.{i}",
                     lambda i=i: self.delivery_plane.worker_stats(i),
                 )
+        if self.entity_plane is not None:
+            self.metrics.gauge("entity_sim", self.entity_plane.stats)
         if self.device_telemetry is not None:
             self.metrics.gauge("device", self.device_telemetry.stats)
         if self.recorder is not None:
@@ -347,6 +369,10 @@ class WorldQLServer:
         """Disconnect cleanup: purge the spatial index (the remove_rx
         path, thread.rs:124-126) and let transports drop socket state."""
         self.backend.remove_peer(uuid)
+        if self.entity_plane is not None:
+            # entity slots + refcounts of the departed peer; its index
+            # rows (entity-derived included) are already purged above
+            self.entity_plane.on_peer_removed(uuid)
         if self.delivery_plane is not None:
             # worker-owned socket: the owning shard closes its end
             self.delivery_plane.release(uuid)

@@ -58,12 +58,22 @@ class TickBatcher:
         tracer: Tracer | None = None,
         device_telemetry=None,
         staging=None,
+        entity_plane=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
         self.metrics = metrics
+        # Optional entities.EntityPlane (--entity-sim): every flush
+        # ALSO advances the simulation one tick — dispatch on the loop
+        # (tick.sim.integrate), device wait + fetch on the worker
+        # thread (tick.sim.knn), index churn + frame assembly back on
+        # the loop (tick.sim.apply) — and the neighbor frames join the
+        # tick's batched delivery. A flush with an empty query batch
+        # still ticks the simulation. Sim failures drop only that sim
+        # tick, never the flush.
+        self._entity_plane = entity_plane
         # Optional engine.staging.QueryStaging: enqueue writes each
         # query into preallocated columnar arrays (interned at arrival
         # time), and flush dispatches the flipped buffer through
@@ -166,6 +176,46 @@ class TickBatcher:
             except Exception:
                 logger.exception("tick flush failed — batch dropped")
 
+    # region: entity-sim stages (--entity-sim)
+
+    def _sim_dispatch(self, trace):
+        """Launch the simulation tick (event-loop thread). Returns the
+        collect handle, or None when the plane is idle, a previous sim
+        tick is still in flight (pipelined flushes never stack sim
+        ticks), or the dispatch failed (logged; the flush proceeds)."""
+        plane = self._entity_plane
+        if plane is None or not plane.active():
+            return None
+        try:
+            with trace.span("tick.sim.integrate"):
+                return plane.dispatch_tick()
+        except Exception:
+            logger.exception("entity sim dispatch failed — sim tick skipped")
+            return None
+
+    async def _sim_collect_apply(self, sim_handle, trace) -> list:
+        """Wait out the sim tick on a worker thread, then integrate it
+        back into the host authority on the loop. Returns the tick's
+        neighbor-frame delivery pairs; a failed sim tick aborts cleanly
+        (host columns stay authoritative) and returns []."""
+        plane = self._entity_plane
+        try:
+            with trace.span("tick.sim.knn"):
+                result = await asyncio.to_thread(
+                    plane.collect_tick, sim_handle
+                )
+            with trace.span("tick.sim.apply"):
+                return plane.apply(result, trace)
+        except asyncio.CancelledError:
+            plane.abort_tick()
+            raise
+        except Exception:
+            plane.abort_tick()
+            logger.exception("entity sim tick failed — sim frames dropped")
+            return []
+
+    # endregion
+
     # region: pipelined flush (pipeline > 1)
 
     async def flush_pipelined(self) -> None:
@@ -178,22 +228,38 @@ class TickBatcher:
         self._reap()
         async with self._flushing:
             batch, self._queue = self._queue, []
-            if batch:
+            plane = self._entity_plane
+            sim_on = plane is not None and plane.active()
+            if batch or sim_on:
                 trace = self._begin_trace(len(batch))
                 t0 = time.perf_counter()
                 # frame clock: opened at flush start (the accumulation
                 # window is a config choice, not pipeline latency),
                 # closed at delivery completion on whichever path
                 t_ingress_ns = time.monotonic_ns()
-                with trace.span("tick.dispatch"):
-                    handle = self._dispatch_batch(batch)
-                    self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
-                    if self.metrics is not None:
-                        self.metrics.observe_ms(
-                            "tick.dispatch_ms", self.last_dispatch_ms
-                        )
+                sim_handle = self._sim_dispatch(trace)
+                handle = None
+                if batch:
+                    try:
+                        with trace.span("tick.dispatch"):
+                            handle = self._dispatch_batch(batch)
+                            self.last_dispatch_ms = (
+                                time.perf_counter() - t0
+                            ) * 1e3
+                            if self.metrics is not None:
+                                self.metrics.observe_ms(
+                                    "tick.dispatch_ms",
+                                    self.last_dispatch_ms,
+                                )
+                    except BaseException:
+                        if sim_handle is not None:
+                            # the stage task never spawns — release
+                            # the un-applied sim tick
+                            plane.abort_tick()
+                        raise
                 stage = self._collect_deliver(
-                    batch, handle, self._tail, t0, trace, t_ingress_ns
+                    batch, handle, self._tail, t0, trace, t_ingress_ns,
+                    sim_handle,
                 )
                 if self._sup is not None:
                     task = self._sup.spawn_transient("tick-collect", stage)
@@ -213,7 +279,8 @@ class TickBatcher:
             self._reap()
 
     async def _collect_deliver(self, batch, handle, prev, t0, trace,
-                               t_ingress_ns: int = 0) -> None:
+                               t_ingress_ns: int = 0,
+                               sim_handle=None) -> None:
         """Stage 2 of a pipelined tick: device collect (worker thread),
         then — strictly after tick N-1's stage finished — the batched
         delivery. Handles its own errors (a failed collect drops only
@@ -221,29 +288,38 @@ class TickBatcher:
         cancelled by stop(), which awaits the chain instead."""
         try:
             await self._collect_deliver_inner(
-                batch, handle, prev, t0, trace, t_ingress_ns
+                batch, handle, prev, t0, trace, t_ingress_ns, sim_handle
             )
         finally:
             trace.finish()  # idempotent; seals drop/error paths too
 
     async def _collect_deliver_inner(
-        self, batch, handle, prev, t0, trace, t_ingress_ns: int = 0
+        self, batch, handle, prev, t0, trace, t_ingress_ns: int = 0,
+        sim_handle=None,
     ) -> None:
         targets = None
-        try:
-            tc = time.perf_counter()
-            with trace.span("tick.collect"):
-                targets = await asyncio.to_thread(
-                    self.backend.collect_local_batch, handle
-                )
-                self.last_collect_ms = (time.perf_counter() - tc) * 1e3
-                if self.metrics is not None:
-                    self.metrics.observe_ms(
-                        "tick.collect_ms", self.last_collect_ms
+        if handle is not None:
+            try:
+                tc = time.perf_counter()
+                with trace.span("tick.collect"):
+                    targets = await asyncio.to_thread(
+                        self.backend.collect_local_batch, handle
                     )
-            self._note_collect_stats(trace)
-        except Exception:
-            logger.exception("tick collect failed — batch dropped")
+                    self.last_collect_ms = (time.perf_counter() - tc) * 1e3
+                    if self.metrics is not None:
+                        self.metrics.observe_ms(
+                            "tick.collect_ms", self.last_collect_ms
+                        )
+                self._note_collect_stats(trace)
+            except Exception:
+                logger.exception("tick collect failed — batch dropped")
+        # entity-sim stage: wait out the sim tick and fold it back into
+        # the host authority; its neighbor frames join this tick's
+        # batched delivery below. Runs before wait_prev so sim work
+        # overlaps the predecessor's delivery drain.
+        sim_pairs = []
+        if sim_handle is not None:
+            sim_pairs = await self._sim_collect_apply(sim_handle, trace)
         # Arrival order across ticks: tick N-1's deliveries must all
         # complete before ours start — even when our collect finished
         # first (worker threads overlap). Ride out cancellation: the
@@ -255,17 +331,19 @@ class TickBatcher:
                         await asyncio.shield(prev)
                     except (asyncio.CancelledError, Exception):
                         continue
-        if targets is None:
+        if targets is None and not sim_pairs:
             return
         try:
+            pairs = [
+                (message, tgts)
+                for (message, _), tgts in zip(batch, targets or [])
+                if tgts
+            ]
+            pairs.extend(sim_pairs)
             # awaited in place below (shield loop) — not a dangling
             # loop, so it rides outside the supervisor
             deliver_task = asyncio.ensure_future(  # wql: allow(unsupervised-task)
-                self.peer_map.deliver_batch([
-                    (message, tgts)
-                    for (message, _), tgts in zip(batch, targets)
-                    if tgts
-                ], t_ingress_ns)
+                self.peer_map.deliver_batch(pairs, t_ingress_ns)
             )
             td = time.perf_counter()
             # same shield-and-re-await discipline as the sequential
@@ -353,36 +431,56 @@ class TickBatcher:
         await self._drain_inflight()
         async with self._flushing:
             batch, self._queue = self._queue, []
-            if not batch:
+            plane = self._entity_plane
+            sim_on = plane is not None and plane.active()
+            if not batch and not sim_on:
                 return
             trace = self._begin_trace(len(batch))
             t0 = time.perf_counter()
             t_ingress_ns = time.monotonic_ns()  # frame clock (see above)
 
-            dispatched = False
+            dispatched = not batch
             deliver_task = None
+            sim_handle = self._sim_dispatch(trace)
             try:
-                td = time.perf_counter()
-                with trace.span("tick.dispatch"):
-                    handle = self._dispatch_batch(batch)
-                    self.last_dispatch_ms = (time.perf_counter() - td) * 1e3
-                    if self.metrics is not None:
-                        self.metrics.observe_ms(
-                            "tick.dispatch_ms", self.last_dispatch_ms
+                targets = []
+                if batch:
+                    td = time.perf_counter()
+                    with trace.span("tick.dispatch"):
+                        handle = self._dispatch_batch(batch)
+                        self.last_dispatch_ms = (
+                            time.perf_counter() - td
+                        ) * 1e3
+                        if self.metrics is not None:
+                            self.metrics.observe_ms(
+                                "tick.dispatch_ms", self.last_dispatch_ms
+                            )
+                    tc = time.perf_counter()
+                    with trace.span("tick.collect"):
+                        targets = await asyncio.to_thread(
+                            self.backend.collect_local_batch, handle
                         )
-                tc = time.perf_counter()
-                with trace.span("tick.collect"):
-                    targets = await asyncio.to_thread(
-                        self.backend.collect_local_batch, handle
+                        dispatched = True
+                        self.last_collect_ms = (
+                            time.perf_counter() - tc
+                        ) * 1e3
+                        self.last_resolve_ms = (
+                            time.perf_counter() - t0
+                        ) * 1e3
+                        if self.metrics is not None:
+                            self.metrics.observe_ms(
+                                "tick.collect_ms", self.last_collect_ms
+                            )
+                    self._note_collect_stats(trace)
+                pairs = [
+                    (message, tgts)
+                    for (message, _), tgts in zip(batch, targets)
+                    if tgts
+                ]
+                if sim_handle is not None:
+                    pairs.extend(
+                        await self._sim_collect_apply(sim_handle, trace)
                     )
-                    dispatched = True
-                    self.last_collect_ms = (time.perf_counter() - tc) * 1e3
-                    self.last_resolve_ms = (time.perf_counter() - t0) * 1e3
-                    if self.metrics is not None:
-                        self.metrics.observe_ms(
-                            "tick.collect_ms", self.last_collect_ms
-                        )
-                self._note_collect_stats(trace)
                 # One batched delivery: every message's frame goes to
                 # its targets' transport buffers synchronously; only
                 # saturated/fast-path-less peers cost an await at the
@@ -391,15 +489,17 @@ class TickBatcher:
                 # half-sent — fast-path frames are already in
                 # transport buffers and re-sending would duplicate.
                 deliver_task = asyncio.ensure_future(  # wql: allow(unsupervised-task)
-                    self.peer_map.deliver_batch([
-                        (message, tgts)
-                        for (message, _), tgts in zip(batch, targets)
-                        if tgts
-                    ], t_ingress_ns)
+                    self.peer_map.deliver_batch(pairs, t_ingress_ns)
                 )
                 with trace.span("tick.deliver"):
                     await asyncio.shield(deliver_task)
             except asyncio.CancelledError:
+                if sim_handle is not None:
+                    # un-applied sim tick (cancel landed before or
+                    # inside the sim stage): drop it cleanly — the
+                    # host columns stay authoritative. Idempotent if
+                    # the sim stage already applied or aborted.
+                    plane.abort_tick()
                 if not dispatched:
                     # stop() landed before the device collect: the
                     # whole batch is still owed — re-queue it for the
@@ -421,6 +521,13 @@ class TickBatcher:
                             continue  # repeated cancel — keep waiting
                         except Exception:
                             break  # delivery errors handled by _run
+                raise
+            except Exception:
+                if sim_handle is not None:
+                    # a dispatch/collect error escapes to _run's
+                    # containment; the un-applied sim tick must not
+                    # stay "in flight" forever (idempotent)
+                    plane.abort_tick()
                 raise
 
             self._account(batch, t0, trace=trace)

@@ -1,0 +1,16 @@
+"""Entity simulation plane (ISSUE 9).
+
+The wire protocol has carried ``Message.entities`` since the reference
+(structures/entity.rs) — this package is the first thing that USES it:
+``--entity-sim`` turns the broker into a spatial simulation loop.
+Clients register/update entities over the existing Local/GlobalMessage
+envelope, :class:`EntityPlane` owns the device-resident ``EntityState``
+SoA, and every ticker flush integrates positions, re-quantizes, and
+resolves per-entity kNN neighborhoods on device (ops/tick.py) — the
+resulting neighbor frames fan out through the same delivery plane as
+every other broadcast.
+"""
+
+from .plane import PARAM_FRAME, PARAM_REMOVE, EntityPlane
+
+__all__ = ["EntityPlane", "PARAM_FRAME", "PARAM_REMOVE"]

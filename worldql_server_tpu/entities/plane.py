@@ -1,0 +1,633 @@
+"""EntityPlane: the device-resident moving-object workload.
+
+One plane owns every live entity as a slot in preallocated host SoA
+columns (``pos f32[cap,3] | vel f32[cap,3] | wid i32 | pid i32``) plus
+their device twin, an :class:`~worldql_server_tpu.ops.tick.EntityState`.
+The host columns are the authority (the same discipline as
+spatial/tpu_backend.py): wire ingest mutates them at message-arrival
+time, each ticker flush uploads them whole, runs ONE jitted
+``simulation_tick`` (integrate → re-quantize → spatial-hash rebuild →
+stencil kNN, ops/tick.py), and the collect fetches back integrated
+positions + per-entity neighbor targets.
+
+Capacity is a power-of-two tier (``_MIN_CAP`` floor), so the jitted
+tick sees a handful of shapes over a process lifetime — the tick
+kernel registers with the retrace GUARD under ``entities.sim_tick``
+and the e2e suite holds the steady-state budget.
+
+Index coupling (the bounded-staleness contract): every entity also
+owns ONE subscription row in the authoritative spatial index — its
+owner peer subscribed at the entity's current cube — refcounted per
+``(world, cube, peer)`` so co-located entities of one peer share a
+row. Registration inserts the row IMMEDIATELY (a new entity is
+queryable before its first tick); position churn flows through the
+index's base+delta path (``bulk_move_subscriptions``) when the tick's
+integrated position crosses a cube boundary. Subscription queries
+therefore observe an entity's position with staleness bounded by ONE
+applied tick: the cube registered in the index is the quantization of
+the position the LAST applied tick integrated (plus any not-yet-ticked
+wire update, which re-quantizes at the next apply). Entity state and
+index can never diverge structurally — both are derived from the same
+host columns, and the index mutation happens in the same event-loop
+turn as the position writeback.
+
+Tick-path discipline: ``dispatch_tick``/``collect_tick`` are the
+sim-tick hot functions — no per-entity Python, host syncs only at the
+designated collect points (tools/check: host-sync-in-sim-tick). Frame
+assembly and index churn (``apply``) are host delivery/index work,
+O(fan-out) and O(churn) respectively, and run on the event loop like
+the router's per-message handling.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid as uuid_mod
+from collections import Counter
+
+import numpy as np
+
+from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+
+from ..ops.tick import EntityState, make_tick_fn
+from ..protocol.types import Entity, Instruction, Message, Vector3
+from ..spatial.quantize import cube_coords_batch
+from ..utils.names import SanitizeError, sanitize_world_name
+from ..utils.retrace import GUARD
+
+logger = logging.getLogger(__name__)
+
+#: Message.parameter marking an entity-removal batch (any other
+#: parameter — usually None — upserts the carried entities)
+PARAM_REMOVE = "entity.remove"
+#: Message.parameter stamped on outbound neighbor frames
+PARAM_FRAME = "entity.frame"
+
+#: smallest capacity tier (pow2); arrays never shrink below it
+_MIN_CAP = 256
+#: parked coordinate for dead slots: quantizes to the saturated cube of
+#: the dead world (wid -1), far outside any live neighborhood
+_DEAD_POS = np.float32(1.0e30)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(n, 1) - 1).bit_length()
+
+
+class EntityPlane:
+    """Device-resident entity population + its authoritative-index
+    coupling for one server. Event-loop owned except where noted."""
+
+    def __init__(
+        self,
+        backend,
+        peer_map,
+        *,
+        cube_size: int,
+        k: int = 8,
+        dt: float = 0.05,
+        bounds: float = 1000.0,
+        max_entities: int = 1 << 16,
+        metrics=None,
+        tracer=None,
+    ):
+        self.backend = backend
+        self.peer_map = peer_map
+        self.cube_size = cube_size
+        self.k = int(k)
+        self.dt = float(dt)
+        self.bounds = float(bounds)
+        self.max_entities = int(max_entities)
+        self.metrics = metrics
+        self.tracer = tracer
+
+        # host SoA columns (authority; slot-indexed, pow2 capacity)
+        self._cap = _MIN_CAP
+        self._pos = np.full((self._cap, 3), _DEAD_POS, np.float32)
+        self._vel = np.zeros((self._cap, 3), np.float32)
+        self._wid = np.full(self._cap, -1, np.int32)
+        self._pid = np.full(self._cap, -1, np.int32)
+        #: cube currently registered in the authoritative index
+        self._cube = np.zeros((self._cap, 3), np.int64)
+        self._live = np.zeros(self._cap, bool)
+        #: slots mutated by wire ingest since the LAST dispatch — the
+        #: post-tick position writeback must not clobber them
+        self._touched = np.zeros(self._cap, bool)
+
+        self._n = 0                     # slot high-water mark
+        self._free: list[int] = []      # recycled slots below _n
+        self._slot_of: dict[uuid_mod.UUID, int] = {}
+        self._uuid_of: dict[int, uuid_mod.UUID] = {}
+
+        # interning (plane-local dense ids; the INDEX interns its own)
+        self._world_ids: dict[str, int] = {}
+        self._world_names: list[str] = []
+        self._peer_ids: dict[uuid_mod.UUID, int] = {}
+        self._peer_uuids: list[uuid_mod.UUID] = []
+        #: per-peer entity slots (eviction sweep)
+        self._peer_slots: dict[int, set[int]] = {}
+
+        #: (wid, cx, cy, cz, pid) → live-entity refcount backing ONE
+        #: index row; transitions through 0 mutate the index
+        self._sub_refs: Counter = Counter()
+
+        # one jitted tick fn; shape (= capacity tier) keys its compile
+        # cache, which the retrace GUARD audits under entities.sim_tick
+        self._tick_fn = jax.jit(
+            make_tick_fn(
+                cube_size=cube_size, k=self.k, dt=self.dt,
+                bounds=self.bounds,
+            )
+        )
+        GUARD.register("entities.sim_tick", self._tick_fn)
+        self._tick_inflight = False
+
+        # stats (exposed via the entity_sim gauge + bench config 8)
+        self.entities_registered = 0
+        self.entities_removed = 0
+        self.updates = 0
+        self.rejected = 0
+        self.dispatches = 0
+        self.applied_ticks = 0
+        self.dropped_ticks = 0
+        self.frames = 0
+        self.index_moves = 0
+        self.last_integrate_ms = 0.0
+        self.last_knn_ms = 0.0
+        self.last_apply_ms = 0.0
+        self.last_churn = 0
+
+    # region: wire ingest (router arrival path)
+
+    @property
+    def entity_count(self) -> int:
+        return len(self._slot_of)
+
+    def active(self) -> bool:
+        return bool(self._slot_of)
+
+    def ingest(self, message: Message) -> int:
+        """Apply one inbound entity batch: upsert every carried Entity
+        (or remove, when ``parameter == 'entity.remove'``) for the
+        sending peer. Per-entity Python is fine HERE — this is the
+        message-arrival path, amortized like any router handler.
+        Returns entities applied."""
+        sender = message.sender_uuid
+        removing = message.parameter == PARAM_REMOVE
+        applied = 0
+        for ent in message.entities:
+            try:
+                if removing:
+                    applied += self._remove_entity(ent.uuid, sender)
+                else:
+                    applied += self._upsert(ent, message, sender)
+            except SanitizeError as exc:
+                logger.warning(
+                    "peer %s sent entity with invalid world %r (%s)",
+                    sender, ent.world_name or message.world_name, exc,
+                )
+        if applied and self.metrics is not None:
+            self.metrics.inc("sim.updates", applied)
+        self.updates += applied
+        return applied
+
+    def _upsert(self, ent: Entity, message: Message,
+                sender: uuid_mod.UUID) -> int:
+        world = sanitize_world_name(ent.world_name or message.world_name)
+        slot = self._slot_of.get(ent.uuid)
+        new = slot is None
+        if new:
+            if len(self._slot_of) >= self.max_entities:
+                self.rejected += 1
+                if self.metrics is not None:
+                    self.metrics.inc("sim.rejected")
+                logger.warning(
+                    "entity registration rejected: plane full "
+                    "(%d >= max_entities %d)",
+                    len(self._slot_of), self.max_entities,
+                )
+                return 0
+            slot = self._alloc_slot(ent.uuid, sender, world)
+            self.entities_registered += 1
+        else:
+            owner = self._peer_uuids[self._pid[slot]]
+            if owner != sender:
+                # an entity belongs to the peer that registered it;
+                # a hijacking update is dropped, not transferred
+                logger.warning(
+                    "peer %s sent update for entity %s owned by %s — "
+                    "dropped", sender, ent.uuid, owner,
+                )
+                return 0
+        p = ent.position
+        self._pos[slot, 0] = p.x
+        self._pos[slot, 1] = p.y
+        self._pos[slot, 2] = p.z
+        vel = _decode_velocity(ent.flex)
+        if vel is not None:
+            self._vel[slot] = vel
+        self._touched[slot] = True
+        if new:
+            # index coupling: queryable before the first tick
+            self._register_cube(slot)
+        return 1
+
+    def _alloc_slot(self, uuid: uuid_mod.UUID, sender: uuid_mod.UUID,
+                    world: str) -> int:
+        if self._free:
+            slot = self._free.pop()
+        else:
+            if self._n == self._cap:
+                self._grow(self._cap * 2)
+            slot = self._n
+            self._n += 1
+        wid = self._world_ids.get(world)
+        if wid is None:
+            wid = self._world_ids[world] = len(self._world_names)
+            self._world_names.append(world)
+        pid = self._peer_ids.get(sender)
+        if pid is None:
+            pid = self._peer_ids[sender] = len(self._peer_uuids)
+            self._peer_uuids.append(sender)
+        self._slot_of[uuid] = slot
+        self._uuid_of[slot] = uuid
+        self._wid[slot] = wid
+        self._pid[slot] = pid
+        self._vel[slot] = 0.0
+        self._live[slot] = True
+        self._peer_slots.setdefault(pid, set()).add(slot)
+        # index coupling: a fresh entity is queryable IMMEDIATELY —
+        # its row enters the index's delta path in this same turn.
+        # The cube registers from the wire position below via the
+        # same refcount transition churn uses.
+        self._cube[slot] = 0  # filled by _register_cube after pos write
+        return slot
+
+    def _register_cube(self, slot: int) -> None:
+        """Refcount-in the slot's CURRENT position cube (registration
+        path; churn uses the vectorized transition in apply())."""
+        cube = cube_coords_batch(
+            self._pos[slot].astype(np.float64), self.cube_size
+        )
+        self._cube[slot] = cube
+        self._ref_add(
+            int(self._wid[slot]), cube, int(self._pid[slot]),
+        )
+
+    def _ref_key(self, wid: int, cube, pid: int) -> tuple:
+        return (wid, int(cube[0]), int(cube[1]), int(cube[2]), pid)
+
+    def _ref_add(self, wid: int, cube, pid: int) -> None:
+        key = self._ref_key(wid, cube, pid)
+        self._sub_refs[key] += 1
+        if self._sub_refs[key] == 1:
+            self.backend.add_subscription(
+                self._world_names[wid], self._peer_uuids[pid],
+                (int(cube[0]), int(cube[1]), int(cube[2])),
+            )
+
+    def _ref_drop(self, wid: int, cube, pid: int) -> None:
+        key = self._ref_key(wid, cube, pid)
+        self._sub_refs[key] -= 1
+        if self._sub_refs[key] <= 0:
+            del self._sub_refs[key]
+            self.backend.remove_subscription(
+                self._world_names[wid], self._peer_uuids[pid],
+                (int(cube[0]), int(cube[1]), int(cube[2])),
+            )
+
+    def _remove_entity(self, uuid: uuid_mod.UUID,
+                       sender: uuid_mod.UUID | None) -> int:
+        slot = self._slot_of.get(uuid)
+        if slot is None:
+            return 0
+        pid = int(self._pid[slot])
+        if sender is not None and self._peer_uuids[pid] != sender:
+            logger.warning(
+                "peer %s sent remove for entity %s it does not own — "
+                "dropped", sender, uuid,
+            )
+            return 0
+        self._ref_drop(int(self._wid[slot]), self._cube[slot], pid)
+        self._release_slot(slot, pid)
+        return 1
+
+    def _release_slot(self, slot: int, pid: int) -> None:
+        uuid = self._uuid_of.pop(slot)
+        del self._slot_of[uuid]
+        slots = self._peer_slots.get(pid)
+        if slots is not None:
+            slots.discard(slot)
+            if not slots:
+                del self._peer_slots[pid]
+        self._live[slot] = False
+        self._touched[slot] = False
+        self._wid[slot] = -1
+        self._pid[slot] = -1
+        self._pos[slot] = _DEAD_POS
+        self._vel[slot] = 0.0
+        self._free.append(slot)
+        self.entities_removed += 1
+
+    def on_peer_removed(self, peer: uuid_mod.UUID) -> int:
+        """Disconnect sweep: drop every entity the peer owned. The
+        server purges the peer's index rows wholesale via
+        ``backend.remove_peer`` BEFORE this hook runs, so only the
+        plane-side bookkeeping (slots + refcounts) is released here."""
+        pid = self._peer_ids.get(peer)
+        if pid is None:
+            return 0
+        removed = 0
+        for slot in list(self._peer_slots.get(pid, ())):
+            key = self._ref_key(
+                int(self._wid[slot]), self._cube[slot], pid
+            )
+            self._sub_refs.pop(key, None)  # index row already purged
+            self._release_slot(slot, pid)
+            removed += 1
+        return removed
+
+    def _grow(self, cap: int) -> None:
+        """Double the capacity tier (pow2): reallocate every column,
+        preserving slots. The next dispatch compiles the new tier —
+        visible in device.retraces as a tier first hit, exactly like
+        the query engine's capacity ladder."""
+        def grow2(arr, fill, dtype, width=None):
+            shape = (cap,) if width is None else (cap, width)
+            out = np.full(shape, fill, dtype)
+            out[: self._cap] = arr
+            return out
+
+        self._pos = grow2(self._pos, _DEAD_POS, np.float32, 3)
+        self._vel = grow2(self._vel, 0.0, np.float32, 3)
+        self._wid = grow2(self._wid, -1, np.int32)
+        self._pid = grow2(self._pid, -1, np.int32)
+        self._cube = grow2(self._cube, 0, np.int64, 3)
+        self._live = grow2(self._live, False, bool)
+        self._touched = grow2(self._touched, False, bool)
+        self._cap = cap
+        logger.info("entity plane grew to capacity tier %d", cap)
+
+    # endregion
+
+    # region: sim tick (ticker flush path)
+
+    def dispatch_tick(self):
+        """Launch one simulation tick from the host columns (event-loop
+        thread; tick.sim.integrate span). Uploads the full capacity
+        tier, launches the fused integrate+kNN kernel, and enqueues the
+        D2H prefetch. Returns an opaque handle for ``collect_tick`` or
+        None when idle / a previous tick is still in flight (pipelined
+        flushes never stack sim ticks — the writeback of tick N is
+        input to tick N+1)."""
+        if not self._slot_of or self._tick_inflight:
+            return None
+        t0 = time.perf_counter()
+        cap = self._cap
+        state = EntityState(
+            position=jnp.asarray(self._pos),
+            velocity=jnp.asarray(self._vel),
+            world=jnp.asarray(self._wid),
+            peer=jnp.asarray(self._pid),
+        )
+        new_state, targets, counts = self._tick_fn(state)
+        for arr in (new_state.position, targets, counts):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        self._touched[:cap] = False
+        self._tick_inflight = True
+        self.dispatches += 1
+        self.last_integrate_ms = (time.perf_counter() - t0) * 1e3
+        if self.metrics is not None:
+            self.metrics.observe_ms("sim.integrate_ms", self.last_integrate_ms)
+        return {
+            "pos": new_state.position,
+            "targets": targets,
+            "counts": counts,
+            "cap": cap,
+            "t0": t0,
+        }
+
+    def collect_tick(self, handle) -> dict:
+        """Wait out the device and fetch results (worker thread;
+        tick.sim.knn span). The three fetches below are the sim tick's
+        designated device→host sync points; everything else stays
+        vectorized. Also re-quantizes the integrated positions to
+        cubes host-side in f64 — the AUTHORITATIVE quantizer, so the
+        index coupling follows the golden grid, not the device's f32
+        twin."""
+        t0 = time.perf_counter()
+        pos = np.asarray(handle["pos"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
+        targets = np.asarray(handle["targets"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
+        counts = np.asarray(handle["counts"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
+        cubes = cube_coords_batch(pos.astype(np.float64), self.cube_size)
+        knn_ms = (time.perf_counter() - t0) * 1e3
+        return {
+            "pos": pos, "targets": targets, "counts": counts,
+            "cubes": cubes, "cap": handle["cap"], "knn_ms": knn_ms,
+        }
+
+    def abort_tick(self) -> None:
+        """Drop an in-flight tick without applying it (cancelled or
+        errored flush): host columns stay authoritative and unchanged,
+        the next dispatch simply re-integrates from them."""
+        if self._tick_inflight:
+            self._tick_inflight = False
+            self.dropped_ticks += 1
+
+    def apply(self, result: dict, trace=None) -> list:
+        """Integrate one collected tick back into the host authority
+        (event-loop thread): position writeback, index churn through
+        the base+delta path, neighbor-frame assembly. Returns
+        ``(message, targets)`` delivery pairs for the tick's batched
+        deliver."""
+        self._tick_inflight = False
+        t0 = time.perf_counter()
+        cap = result["cap"]
+        pos, cubes = result["pos"], result["cubes"]
+        targets, counts = result["targets"], result["counts"]
+
+        # 1. position writeback — every live slot that the wire did
+        # NOT touch since dispatch (a client update must win over the
+        # concurrent integration it never saw)
+        wb = self._live[:cap] & ~self._touched[:cap]
+        self._pos[:cap][wb] = pos[wb]
+
+        # 2. index churn: slots whose authoritative cube moved. Only
+        # written-back slots move here — touched slots re-quantize at
+        # the NEXT applied tick from their client-given position.
+        moved = wb & np.any(cubes != self._cube[:cap], axis=1)
+        moved_slots = np.flatnonzero(moved)
+        if moved_slots.size:
+            self._apply_churn(moved_slots, cubes)
+        self.last_churn = int(moved_slots.size)
+
+        # 3. neighbor frames: one message per entity with >= 1 target,
+        # fanned out to the owning peers of its k nearest co-cube
+        # entities (the device already applied except-self per PEER)
+        pairs = self._build_frames(pos, targets, counts, cap)
+
+        self.applied_ticks += 1
+        self.frames += len(pairs)
+        self.last_apply_ms = (time.perf_counter() - t0) * 1e3
+        self.last_knn_ms = result["knn_ms"]
+        if self.metrics is not None:
+            self.metrics.observe_ms("sim.knn_ms", result["knn_ms"])
+            self.metrics.observe_ms("sim.apply_ms", self.last_apply_ms)
+            if moved_slots.size:
+                self.metrics.inc("sim.index_moves", int(moved_slots.size))
+            if pairs:
+                self.metrics.inc("sim.frames", len(pairs))
+        if trace is not None:
+            trace.tag(sim={
+                "entities": len(self._slot_of),
+                "frames": len(pairs),
+                "index_moves": int(moved_slots.size),
+                "integrate_ms": round(self.last_integrate_ms, 3),
+                "knn_ms": round(result["knn_ms"], 3),
+                "apply_ms": round(self.last_apply_ms, 3),
+            })
+        return pairs
+
+    def _apply_churn(self, moved_slots: np.ndarray,
+                     cubes: np.ndarray) -> None:
+        """Move the index rows of slots whose cube changed, through the
+        backend's delta path. Refcount transitions decide which moves
+        actually touch the index (co-located entities of one peer share
+        a row); the surviving adds/removes go down vectorized, grouped
+        by world, via ``bulk_move_subscriptions`` when the backend has
+        it (TPU/sharded) or per-row mutations otherwise."""
+        old_cubes = self._cube[moved_slots].copy()
+        new_cubes = cubes[moved_slots]
+        wids = self._wid[moved_slots]
+        pids = self._pid[moved_slots]
+        self._cube[moved_slots] = new_cubes
+        self.index_moves += int(moved_slots.size)
+
+        # refcount transitions (O(churn) host work, like any index
+        # mutation batch): rows crossing 0 materialize as index ops
+        add_rows: list[int] = []
+        rem_rows: list[int] = []
+        refs = self._sub_refs
+        for i in range(moved_slots.size):
+            wid = int(wids[i])
+            pid = int(pids[i])
+            old_key = (wid, int(old_cubes[i, 0]), int(old_cubes[i, 1]),
+                       int(old_cubes[i, 2]), pid)
+            new_key = (wid, int(new_cubes[i, 0]), int(new_cubes[i, 1]),
+                       int(new_cubes[i, 2]), pid)
+            refs[old_key] -= 1
+            if refs[old_key] <= 0:
+                del refs[old_key]
+                rem_rows.append(i)
+            refs[new_key] += 1
+            if refs[new_key] == 1:
+                add_rows.append(i)
+
+        bulk_move = getattr(self.backend, "bulk_move_subscriptions", None)
+        for wid in np.unique(wids).tolist():
+            world = self._world_names[wid]
+            rem = [i for i in rem_rows if wids[i] == wid]
+            add = [i for i in add_rows if wids[i] == wid]
+            rem_peers = [self._peer_uuids[int(pids[i])] for i in rem]
+            add_peers = [self._peer_uuids[int(pids[i])] for i in add]
+            if bulk_move is not None:
+                bulk_move(
+                    world,
+                    rem_peers, old_cubes[rem],
+                    add_peers, new_cubes[add],
+                )
+            else:
+                for peer, cube in zip(rem_peers, old_cubes[rem]):
+                    self.backend.remove_subscription(
+                        world, peer, tuple(int(c) for c in cube)
+                    )
+                for peer, cube in zip(add_peers, new_cubes[add]):
+                    self.backend.add_subscription(
+                        world, peer, tuple(int(c) for c in cube)
+                    )
+        # Make the churn visible to the device twin and run the LSM
+        # compaction policy NOW: the query path calls flush() at every
+        # dispatch, but an entity-sim-only server has no query
+        # dispatches — without this the delta log (and its tombstones)
+        # would grow without bound. No-op-cheap when nothing is dirty.
+        self.backend.flush()
+
+    def _build_frames(self, pos, targets, counts, cap: int) -> list:
+        """Assemble per-entity neighbor frames: for every live entity
+        with at least one resolved target, one LocalMessage carrying
+        the entity's integrated position, addressed to the owning peers
+        of its nearest neighbors. The message serializes ONCE in
+        deliver_batch and fans out from there. O(entities with
+        neighbors) host work — the delivery-path analog of the query
+        engine's decode."""
+        live = self._live[:cap]
+        valid = targets >= 0
+        has_any = live & valid.any(axis=1)
+        rows = np.flatnonzero(has_any)
+        if rows.size == 0:
+            return []
+        pairs = []
+        peer_uuids = self._peer_uuids
+        uuid_of = self._uuid_of
+        world_names = self._world_names
+        wid_col = self._wid
+        pid_col = self._pid
+        for row in rows.tolist():
+            tgt_pids = np.unique(targets[row][valid[row]])
+            targets_u = [peer_uuids[int(p)] for p in tgt_pids]
+            position = Vector3(
+                float(pos[row, 0]), float(pos[row, 1]), float(pos[row, 2])
+            )
+            world = world_names[int(wid_col[row])]
+            msg = Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                parameter=PARAM_FRAME,
+                sender_uuid=peer_uuids[int(pid_col[row])],
+                world_name=world,
+                position=position,
+                entities=[Entity(
+                    uuid=uuid_of[row], position=position,
+                    world_name=world,
+                )],
+            )
+            pairs.append((msg, targets_u))
+        return pairs
+
+    # endregion
+
+    def stats(self) -> dict:
+        return {
+            "entities": len(self._slot_of),
+            "capacity": self._cap,
+            "peers": len(self._peer_slots),
+            "worlds": len(self._world_names),
+            "k": self.k,
+            "registered": self.entities_registered,
+            "removed": self.entities_removed,
+            "updates": self.updates,
+            "rejected": self.rejected,
+            "dispatches": self.dispatches,
+            "applied_ticks": self.applied_ticks,
+            "dropped_ticks": self.dropped_ticks,
+            "frames": self.frames,
+            "index_moves": self.index_moves,
+            "index_rows": len(self._sub_refs),
+            "last_integrate_ms": round(self.last_integrate_ms, 3),
+            "last_knn_ms": round(self.last_knn_ms, 3),
+            "last_apply_ms": round(self.last_apply_ms, 3),
+            "last_churn": self.last_churn,
+        }
+
+
+def _decode_velocity(flex: bytes | None):
+    """Wire velocity: ``Entity.flex`` carries 12 little-endian f32
+    bytes (vx, vy, vz). Absent/short flex = no velocity change (zero
+    for a fresh registration)."""
+    if flex is None or len(flex) < 12:
+        return None
+    return np.frombuffer(flex[:12], dtype="<f4").astype(np.float32)

@@ -235,6 +235,34 @@ class ResilientBackend(SpatialBackend):
                 self._note_failure("mutate")
         return out
 
+    def bulk_remove_subscriptions(self, world, peers, cubes) -> int:
+        """Explicit override: without it the call would fall through
+        ``__getattr__`` straight to the inner backend, silently
+        bypassing the mirror — a later rebuild would resurrect the
+        removed rows. The CPU mirror has no bulk remove; per-row
+        removal is its reference path anyway."""
+        out = 0
+        for peer, cube in zip(peers, cubes):
+            if self.mirror.remove_subscription(
+                world, peer, tuple(int(c) for c in cube)
+            ):
+                out += 1
+        if not self.failed_over:
+            try:
+                self.inner.bulk_remove_subscriptions(world, peers, cubes)
+            except Exception:
+                self._note_failure("mutate")
+        return out
+
+    def bulk_move_subscriptions(
+        self, world, rem_peers, rem_cubes, add_peers, add_cubes,
+    ) -> tuple[int, int]:
+        """Moving-object churn (entities/plane.py) with the mirror
+        kept authoritative on both sides of the move."""
+        removed = self.bulk_remove_subscriptions(world, rem_peers, rem_cubes)
+        added = self.bulk_add_subscriptions(world, add_peers, add_cubes)
+        return removed, added
+
     def flush(self) -> None:
         if not self.failed_over:
             try:

@@ -1324,6 +1324,22 @@ class TpuSpatialBackend(SpatialBackend):
         self._dirty = True
         return int(all_pids.size)
 
+    def bulk_move_subscriptions(
+        self, world, rem_peers, rem_cubes, add_peers, add_cubes,
+    ) -> tuple[int, int]:
+        """Moving-object churn ingest (entities/plane.py): retire
+        ``rem_peers[i] → rem_cubes[i]`` rows and insert ``add_peers[i]
+        → add_cubes[i]`` rows in one call, both through the base+delta
+        path — tombstones into whichever segment holds each retired
+        row, appends into the delta log (whose growth drives the normal
+        compaction policy, so sustained churn exercises the LSM fold
+        exactly like any other write stream). Removes run FIRST so a
+        peer hopping cubes within one batch never momentarily holds
+        two rows. Returns ``(removed, added)``."""
+        removed = self.bulk_remove_subscriptions(world, rem_peers, rem_cubes)
+        added = self.bulk_add_subscriptions(world, add_peers, add_cubes)
+        return removed, added
+
     def _intern_peers(self, peers) -> np.ndarray:
         peer_ids = self._peer_ids
         peer_list = self._peer_list
