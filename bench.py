@@ -2729,16 +2729,259 @@ def bench_config8(args) -> dict:
 # --------------------------------------------------------------------
 
 
+def bench_config9(args) -> dict:
+    """Overload-storm admission workload (ISSUE 10): a real server
+    over real ZMQ with the OverloadGovernor on, deliberately throttled
+    (tiny tick budget → degraded admitted tier) so a single client can
+    offer sustained multiples of the sustainable rate even on a 1-core
+    container. Three legs:
+
+    * **sustainable** — unpaced flood, governor engaged → the admitted
+      ceiling ``sustainable_per_s`` (the 1x reference);
+    * **2x / 10x** — offered load paced to 2x and 10x of that ceiling
+      while a record-op stream (durability='wal', acked at the fsync)
+      runs through the SAME router → per-phase admitted-vs-offered
+      rate, shed fraction by class, governor peak state, and the
+      admitted record-op p99;
+    * **audit** — after each phase drains, offered == flushed +
+      drop-oldest + shed-at-ingest, exactly (shed work is never
+      silent).
+
+    ``--smoke`` shrinks the windows and asserts the 10x phase actually
+    engaged the governor, shed work, kept the audit exact, and landed
+    every record op — the CI gate for the overload plane."""
+    import tempfile
+
+    from tests.client_util import ZmqClient, free_port
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+    from worldql_server_tpu.protocol import Instruction, Message
+    from worldql_server_tpu.protocol.types import Record, Vector3
+
+    quick = args.quick
+    base_s = 0.8 if quick else 3.0
+    phase_s = 1.0 if quick else 4.0
+    record_rate = 25  # record ops per second, through the wal path
+
+    tmp = tempfile.TemporaryDirectory(prefix="wql-overload-bench-")
+    config = Config(
+        store_url=f"sqlite://{tmp.name}/bench.db",
+        durability="wal", wal_dir=f"{tmp.name}/wal",
+        checkpoint_interval=0.5,
+        http_enabled=False, ws_enabled=False,
+        zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+        spatial_backend="cpu", tick_interval=0.01,
+        max_batch=256, overload="on",
+        overload_tick_budget_ms=0.5, overload_min_batch=8,
+        overload_deadline_k=2, overload_recover_ticks=5,
+    )
+
+    async def scenario() -> dict:
+        server = WorldQLServer(config)
+        await server.start()
+        gov = server.governor
+        metrics = server.metrics
+        try:
+            client = await ZmqClient.connect(config.zmq_server_port)
+
+            def counters() -> dict:
+                snap = metrics.snapshot()["counters"]
+                return {
+                    "seen": snap.get("messages.local_message", 0),
+                    "flushed": snap.get("tick.messages", 0),
+                    "dropped": gov.drop_oldest,
+                    "shed": gov.shed["local"],
+                    "limited": gov.rate_limited,
+                }
+
+            async def flood(duration: float, rate: float | None):
+                """Offer locals for ``duration``; None = unpaced.
+                Returns (offered, wall)."""
+                sent = 0
+                t0 = time.perf_counter()
+                end = t0 + duration
+                while time.perf_counter() < end:
+                    for _ in range(32):
+                        await client.send(Message(
+                            instruction=Instruction.LOCAL_MESSAGE,
+                            world_name="bench",
+                            position=Vector3(1.0, 1.0, 1.0),
+                            parameter="s",
+                        ))
+                        sent += 1
+                    if rate is not None:
+                        pace = t0 + sent / rate - time.perf_counter()
+                        if pace > 0:
+                            await asyncio.sleep(pace)
+                        else:
+                            await asyncio.sleep(0)
+                return sent, time.perf_counter() - t0
+
+            async def drain():
+                for _ in range(1000):
+                    if (
+                        not server.ticker._queue
+                        and not server.ticker.inflight()
+                    ):
+                        return
+                    await asyncio.sleep(0.01)
+
+            record_seq = [0]
+
+            async def record_ops(duration: float) -> list:
+                walls = []
+                end = time.perf_counter() + duration
+                while time.perf_counter() < end:
+                    record_seq[0] += 1
+                    i = record_seq[0]
+                    t0 = time.perf_counter()
+                    await server.router.durability.insert_records([
+                        Record(
+                            uuid=uuid_mod.UUID(int=i), world_name="w",
+                            position=Vector3(1, 2, 3), data=f"r{i}",
+                        )
+                    ])
+                    walls.append((time.perf_counter() - t0) * 1e3)
+                    await asyncio.sleep(1.0 / record_rate)
+                return walls
+
+            async def run_phase(duration: float, rate: float | None):
+                """One offered-load window: flood (paced or unpaced)
+                + the concurrent record stream, drained, audited."""
+                before = counters()
+                gov.peak_level = gov.level  # peak WITHIN this phase
+                (offered, wall), walls = await asyncio.gather(
+                    flood(duration, rate), record_ops(duration),
+                )
+                await drain()
+                after = counters()
+                delta = {k: after[k] - before[k] for k in after}
+                walls.sort()
+                shed_total = delta["dropped"] + delta["shed"]
+                return {
+                    "offered_per_s": round(offered / wall, 1),
+                    "admitted_per_s": round(delta["flushed"] / wall, 1),
+                    "shed_fraction_local": round(
+                        shed_total / max(delta["seen"], 1), 4
+                    ),
+                    "drop_oldest": delta["dropped"],
+                    "shed_at_ingest": delta["shed"],
+                    "rate_limited": delta["limited"],
+                    "governor_peak_level": gov.peak_level,
+                    "record_ops": len(walls),
+                    "record_p99_ms": round(
+                        walls[max(0, int(len(walls) * 0.99) - 1)], 3
+                    ) if walls else None,
+                    # the exactness invariant, reported not assumed
+                    "audit_exact": (
+                        delta["seen"] == delta["flushed"] + shed_total
+                    ),
+                }
+
+            # -- leg 1: saturation storm (unpaced = everything the
+            # client can offer). What the governed server SERVES under
+            # it is the sustainable ceiling — the 1x reference for the
+            # paced legs — and the shedding here is guaranteed, which
+            # is what the smoke gate pins.
+            saturation = await run_phase(base_s, None)
+            sustainable = max(saturation["admitted_per_s"], 1.0)
+            phases = {"saturation": saturation}
+
+            # -- legs 2+3: paced at 2x and 10x the sustained ceiling --
+            for factor in (2, 10):
+                phase = await run_phase(phase_s, sustainable * factor)
+                phase["target_factor"] = factor
+                phase["achieved_factor"] = round(
+                    phase["offered_per_s"] / sustainable, 2
+                )
+                phases[f"{factor}x"] = phase
+
+            # recovery: back to OK after the storm (bounded wait)
+            recovered_ticks = None
+            ticks0 = gov.ticks
+            for _ in range(600):
+                if gov.state == "ok" and not gov.degraded():
+                    recovered_ticks = gov.ticks - ticks0
+                    break
+                await asyncio.sleep(0.01)
+
+            await client.close()
+            return {
+                "sustainable_per_s": round(sustainable, 1),
+                "phases": phases,
+                "recovered_to_ok_within_ticks": recovered_ticks,
+                "transitions": gov.transitions,
+                "coalesced": int(
+                    metrics.snapshot()["counters"].get(
+                        "overload.coalesced", 0
+                    )
+                ),
+                "record_ops_total": record_seq[0],
+            }
+        finally:
+            await server.stop()
+            tmp.cleanup()
+
+    overload = asyncio.run(scenario())
+
+    if args.smoke:
+        sat = overload["phases"]["saturation"]
+        assert sat["governor_peak_level"] >= 1, (
+            "smoke: saturation storm never escalated the governor"
+        )
+        assert sat["drop_oldest"] + sat["shed_at_ingest"] > 0, (
+            "smoke: saturation storm shed nothing"
+        )
+        for phase in overload["phases"].values():
+            assert phase["audit_exact"], (
+                f"smoke: shed accounting mismatch: {phase}"
+            )
+        assert sat["record_ops"] > 0 and sat["record_p99_ms"], (
+            "smoke: record stream never ran under the storm"
+        )
+        assert overload["recovered_to_ok_within_ticks"] is not None, (
+            "smoke: governor never returned to OK after the storm"
+        )
+        log(
+            f"smoke: saturation shed {sat['shed_fraction_local']:.1%}, "
+            f"audit exact, record p99 {sat['record_p99_ms']} ms, "
+            f"OK after {overload['recovered_to_ok_within_ticks']} ticks"
+        )
+
+    p10 = overload["phases"]["10x"]
+    result = {
+        "metric": "overload_admitted_at_10x_per_s",
+        "value": p10["admitted_per_s"],
+        "unit": "per_s",
+        "overload": overload,
+        "config": 9,
+    }
+    log(
+        f"overload: sustainable {overload['sustainable_per_s']:,.0f}/s; "
+        f"10x offered {p10['offered_per_s']:,.0f}/s -> admitted "
+        f"{p10['admitted_per_s']:,.0f}/s, shed "
+        f"{p10['shed_fraction_local']:.1%}, record p99 "
+        f"{p10['record_p99_ms']} ms"
+    )
+    return result
+
+
+# --------------------------------------------------------------------
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
                          "(sharded_overhead); 8 = entity-simulation "
                          "plane (update ingest through the delta "
-                         "path, device kNN tick, e2e frame latency)")
+                         "path, device kNN tick, e2e frame latency); "
+                         "9 = overload-storm admission (admitted vs "
+                         "offered at 2x/10x, shed fractions, record "
+                         "p99 under storm)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -2776,14 +3019,14 @@ def main() -> None:
     benches = {
         1: bench_config1, 2: bench_config2, 3: bench_config3,
         4: bench_config4, 5: bench_config5, 6: bench_config6,
-        7: bench_config7, 8: bench_config8,
+        7: bench_config7, 8: bench_config8, 9: bench_config9,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9]
     else:
         selected = [args.config or 5]
     for n in selected:

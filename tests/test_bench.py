@@ -147,15 +147,15 @@ def test_bench_smoke_forces_compacted_collect():
 
 
 def test_bench_all_emits_one_line_per_config():
-    """--all: seven configs, seven JSON lines, in config order
+    """--all: eight configs, eight JSON lines, in config order
     (config 7 re-execs with a forced device topology and runs
     standalone)."""
     records, _ = run_bench(
         "--all", "--quick", "--subs", "4000", "--queries", "256",
         "--ticks", "6", "--cpu-ticks", "2",
     )
-    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6, 8]
-    assert len({rec["metric"] for rec in records}) == 7
+    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6, 8, 9]
+    assert len({rec["metric"] for rec in records}) == 8
 
 
 def test_bench_config8_entity_sim():
@@ -175,6 +175,31 @@ def test_bench_config8_entity_sim():
     assert block["compactions"] >= 1
     assert block["sim_retraces_quiet"] == 0
     assert "entity_sim:" in stderr
+
+
+@pytest.mark.slow   # three real-ZMQ load windows + drains: ~30 s
+def test_bench_config9_overload():
+    """Config 9 (ISSUE 10): the overload-storm admission workload —
+    saturation / 2x / 10x offered-load windows over real ZMQ with the
+    governor on. --smoke additionally asserts the saturation storm
+    escalated the governor and shed (accounted exactly), the record
+    stream landed, and the governor recovered to OK. CI runs the same
+    smoke directly in the bench step; this pins the harness shape."""
+    records, stderr = run_bench("--config", "9", "--smoke")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["metric"] == "overload_admitted_at_10x_per_s"
+    block = rec["overload"]
+    assert block["sustainable_per_s"] > 0
+    for name in ("saturation", "2x", "10x"):
+        phase = block["phases"][name]
+        assert phase["audit_exact"] is True
+        assert phase["offered_per_s"] > 0
+    sat = block["phases"]["saturation"]
+    assert sat["shed_at_ingest"] + sat["drop_oldest"] > 0
+    assert sat["governor_peak_level"] >= 1
+    assert block["recovered_to_ok_within_ticks"] is not None
+    assert "overload:" in stderr
 
 
 @pytest.mark.slow   # two jax boots + per-mesh compiles: minutes on CPU

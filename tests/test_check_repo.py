@@ -21,3 +21,31 @@ def test_package_is_lint_clean():
 def test_tooling_is_lint_clean():
     violations = check_paths([str(REPO / "tools")])
     assert violations == [], "\n" + "\n".join(v.render() for v in violations)
+
+
+def test_no_runtime_artifacts_committed():
+    """Runtime artifacts must never be committed: a stray ``worldql.db``
+    (the default sqlite store, created by any server run in the repo
+    root) has slipped into the tree twice now, and a committed WAL
+    segment would replay into someone else's store at boot. Guard the
+    tracked file list itself — .gitignore only helps before the fact."""
+    import subprocess
+
+    try:
+        tracked = subprocess.run(
+            ["git", "ls-files"], cwd=REPO, capture_output=True,
+            text=True, timeout=30, check=True,
+        ).stdout.splitlines()
+    except Exception:
+        import pytest
+
+        pytest.skip("not a git checkout")
+    offenders = [
+        f for f in tracked
+        if f.endswith((".db", ".sqlite", ".db-journal"))
+        or f.rsplit("/", 1)[-1].startswith("wal-") and f.endswith(".log")
+    ]
+    assert offenders == [], (
+        f"runtime artifacts committed: {offenders} — delete them and "
+        "keep .gitignore covering *.db / wal-*.log"
+    )

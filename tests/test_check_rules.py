@@ -824,9 +824,10 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 14
+    assert len(names) >= 15
     assert names == {
         "async-dangling-task",
+        "unbounded-ingest",
         "async-suppress-await",
         "async-blocking-call",
         "unsupervised-task",
@@ -1061,6 +1062,91 @@ def test_sim_tick_pragma_allows_designated_collect_points():
     """
     assert violations(
         src, relpath=_ENTITIES, select="host-sync-in-sim-tick"
+    ) == []
+
+
+# endregion
+
+
+# region: unbounded-ingest
+
+
+def test_unbounded_ingest_fires_on_bare_append_in_ticker_enqueue():
+    src = """
+    class TickBatcher:
+        async def enqueue(self, message, query):
+            self._queue.append((message, query))
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/ticker.py",
+        select="unbounded-ingest",
+    ) == [("unbounded-ingest", 4)]
+
+
+def test_unbounded_ingest_fires_on_transport_backlog_growth():
+    src = """
+    class ZmqTransport:
+        async def _process_inbound(self, parts, limit):
+            self._backlog.append(parts)
+            self._frames.extend(parts)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="unbounded-ingest",
+    ) == [("unbounded-ingest", 4), ("unbounded-ingest", 5)]
+
+
+def test_unbounded_ingest_quiet_when_admission_present():
+    src = """
+    class TickBatcher:
+        async def enqueue(self, message, query):
+            if self._governor is not None:
+                if len(self._queue) >= self._governor.local_queue_cap():
+                    self._queue.popleft()
+                    self._governor.note_drop_oldest()
+            self._queue.append((message, query))
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/ticker.py",
+        select="unbounded-ingest",
+    ) == []
+
+
+def test_unbounded_ingest_quiet_outside_ingest_functions_and_modules():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            self._inflight.append(self._task)
+
+        async def enqueue(self, message, query):
+            self._queue.append((message, query))
+    """
+    # same growth in a non-ingest function: quiet; the enqueue in a
+    # module outside the wire-traffic scope: quiet too
+    assert violations(
+        src, relpath="worldql_server_tpu/spatial/tpu_backend.py",
+        select="unbounded-ingest",
+    ) == []
+    src2 = """
+    class TickBatcher:
+        async def flush(self):
+            self._inflight.append(self._task)
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/engine/ticker.py",
+        select="unbounded-ingest",
+    ) == []
+
+
+def test_unbounded_ingest_pragma_suppresses():
+    src = """
+    class EntityPlane:
+        def ingest(self, message):
+            self._updates.append(message)  # wql: allow(unbounded-ingest)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/entities/plane.py",
+        select="unbounded-ingest",
     ) == []
 
 

@@ -1,0 +1,636 @@
+"""Overload control plane (robustness/overload.py, ISSUE 10).
+
+Covers the governor state machine (hysteresis — no flapping at the
+threshold boundary), priority-classed admission, per-peer token-bucket
+fairness, tick-deadline degradation, entity-update coalescing, the
+non-blocking enqueue regression (a slow device collect must not
+head-of-line-block ingest), and the --overload off pin (governor off
+⇒ today's behavior: no governor object anywhere, no shed counters, no
+healthz/metrics surface).
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.metrics import Metrics
+from worldql_server_tpu.engine.peers import Peer, PeerMap
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.engine.ticker import TickBatcher
+from worldql_server_tpu.entities import EntityPlane
+from worldql_server_tpu.protocol import deserialize_message
+from worldql_server_tpu.protocol.types import (
+    Entity,
+    Instruction,
+    Message,
+    Vector3,
+)
+from worldql_server_tpu.robustness import failpoints
+from worldql_server_tpu.robustness.overload import (
+    OK,
+    REJECT,
+    SHED_HIGH,
+    SHED_LOW,
+    OverloadGovernor,
+)
+from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+
+from tests.client_util import free_port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.registry.reset()
+    yield
+    failpoints.registry.reset()
+
+
+# region: state machine + hysteresis
+
+
+def test_deadline_k_busts_escalate_and_degrade_tier():
+    gov = OverloadGovernor(
+        max_batch=100, tick_budget_ms=10.0, deadline_k=3,
+        recover_ticks=5, min_batch=8, metrics=Metrics(),
+    )
+    gov.note_tick(15.0, 0)
+    gov.note_tick(15.0, 0)
+    assert gov.state == OK  # 2 busts < K: a slow pair is noise
+    gov.note_tick(15.0, 0)
+    assert gov.state == SHED_LOW  # K consecutive busts = load
+    assert gov.admitted_batch == 50  # tier halved at the K-th bust
+    for _ in range(20):
+        gov.note_tick(15.0, 0)
+    assert gov.admitted_batch == 8  # floor holds
+    assert gov.degraded()
+
+
+def test_single_bust_resets_consecutive_counter():
+    gov = OverloadGovernor(
+        max_batch=100, tick_budget_ms=10.0, deadline_k=3,
+        metrics=Metrics(),
+    )
+    for _ in range(50):  # alternating never accumulates K
+        gov.note_tick(15.0, 0)
+        gov.note_tick(15.0, 0)
+        gov.note_tick(5.0, 0)
+    assert gov.state == OK
+    assert gov.transitions == 0
+
+
+def test_no_flapping_at_threshold_boundary():
+    """A signal parked on the enter threshold escalates ONCE and then
+    holds: the exit threshold sits at hysteresis (0.8x) below, so
+    hovering between them cannot flap the state."""
+    gov = OverloadGovernor(max_batch=100, recover_ticks=5, metrics=Metrics())
+    gov.note_queue_depth(50)  # 0.5 x max_batch — the SHED_LOW boundary
+    assert gov.state == SHED_LOW
+    transitions = gov.transitions
+    for i in range(100):  # hover between exit (40) and enter (50)
+        gov.note_tick(0.0, 45 if i % 2 else 50)
+    assert gov.state == SHED_LOW
+    assert gov.transitions == transitions  # held, not flapped
+    # genuine recovery: below the exit threshold for recover_ticks
+    for _ in range(5):
+        gov.note_tick(0.0, 10)
+    assert gov.state == OK
+    assert gov.transitions == transitions + 1
+
+
+def test_recovery_steps_down_one_state_at_a_time():
+    gov = OverloadGovernor(max_batch=100, recover_ticks=3, metrics=Metrics())
+    gov.note_queue_depth(250)  # 2.5x -> REJECT
+    assert gov.state == REJECT
+    seen = []
+    for _ in range(12):
+        gov.note_tick(0.0, 0)
+        seen.append(gov.state)
+    # REJECT -> SHED_HIGH -> SHED_LOW -> OK, 3 healthy samples each:
+    # full recovery bounded by 3 x recover_ticks
+    assert seen[2] == SHED_HIGH and seen[5] == SHED_LOW and seen[8] == OK
+    assert gov.state == OK
+
+
+def test_tier_restores_and_frame_skip_toggles():
+    gov = OverloadGovernor(
+        max_batch=64, tick_budget_ms=10.0, deadline_k=2,
+        recover_ticks=3, min_batch=4, metrics=Metrics(),
+    )
+    for _ in range(2):
+        gov.note_tick(20.0, 0)
+    assert gov.admitted_batch == 32 and gov.degraded()
+    # while degraded: the entity frame leg sheds every OTHER tick
+    assert gov.take_frame_skip() != gov.take_frame_skip()
+    for _ in range(6):  # 3 healthy ticks per doubling
+        gov.note_tick(1.0, 0)
+    assert gov.admitted_batch == 64 and not gov.degraded()
+    assert gov.take_frame_skip() is False  # full service: never skip
+
+
+def test_force_state_failpoint_drives_transitions_and_is_audited():
+    gov = OverloadGovernor(max_batch=100, metrics=Metrics())
+    for state in (SHED_LOW, SHED_HIGH, REJECT, OK):
+        failpoints.registry.set(
+            "overload.force_state", f"state:{state}"
+        )
+        gov.note_idle(0)
+        assert gov.state == state
+    assert gov.transitions == 4
+    # forced transitions are visible in the failpoints audit gauge
+    assert failpoints.registry.fired("overload.force_state") >= 4
+    failpoints.registry.clear()
+    gov.note_idle(0)
+    assert gov.state == OK
+
+
+# endregion
+
+# region: admission classes + token buckets
+
+
+def test_admission_classes_in_reject():
+    gov = OverloadGovernor(max_batch=100, metrics=Metrics())
+    failpoints.registry.set("overload.force_state", "state:reject")
+    gov.note_idle(0)
+    sender = uuid.uuid4()
+    # record ops are durable+acked: NEVER shed
+    for instr in (
+        Instruction.RECORD_CREATE, Instruction.RECORD_READ,
+        Instruction.RECORD_UPDATE, Instruction.RECORD_DELETE,
+    ):
+        assert gov.admit(instr, sender)
+    # liveness survives overload
+    assert gov.admit(Instruction.HEARTBEAT, sender)
+    # entity updates are never rejected — they coalesce in the plane
+    assert gov.admit(Instruction.LOCAL_MESSAGE, sender, is_entity=True)
+    # locals and globals are refused at the door, counted by class
+    assert not gov.admit(Instruction.LOCAL_MESSAGE, sender)
+    assert not gov.admit(Instruction.GLOBAL_MESSAGE, sender)
+    assert gov.shed == {"local": 1, "global": 1}
+    assert gov.metrics.counters["overload.shed_local"] == 1
+    assert gov.metrics.counters["overload.shed_global"] == 1
+
+
+def test_globals_shed_last():
+    gov = OverloadGovernor(max_batch=100, metrics=Metrics())
+    sender = uuid.uuid4()
+    for state in (SHED_LOW, SHED_HIGH):
+        failpoints.registry.set("overload.force_state", f"state:{state}")
+        gov.note_idle(0)
+        # below REJECT both pub/sub classes still admit at ingest
+        # (locals shed drop-oldest at the ticker queue instead)
+        assert gov.admit(Instruction.GLOBAL_MESSAGE, sender)
+        assert gov.admit(Instruction.LOCAL_MESSAGE, sender)
+
+
+def test_per_peer_fairness_hostile_peer_cannot_starve_victims():
+    """The ISSUE 10 fairness property: a hostile peer offering 100x
+    the fair share is clamped to its bucket rate while every victim's
+    admitted rate stays within epsilon of its (fair-share) offer; the
+    hostile drops are all counted."""
+    clock = [0.0]
+    gov = OverloadGovernor(
+        max_batch=1000, peer_rate=100.0, peer_burst=100,
+        metrics=Metrics(), clock=lambda: clock[0],
+    )
+    hostile = uuid.uuid4()
+    victims = [uuid.uuid4() for _ in range(5)]
+    offered = {p: 0 for p in [hostile, *victims]}
+    admitted = {p: 0 for p in offered}
+    for step in range(2000):  # 2 simulated seconds, 1 ms steps
+        clock[0] = step * 1e-3
+        for _ in range(10):  # hostile: 10_000 msg/s = 100x fair share
+            offered[hostile] += 1
+            admitted[hostile] += gov.admit(
+                Instruction.LOCAL_MESSAGE, hostile
+            )
+        if step % 10 == 0:  # victims: 100 msg/s = the bucket rate
+            for v in victims:
+                offered[v] += 1
+                admitted[v] += gov.admit(Instruction.LOCAL_MESSAGE, v)
+    for v in victims:  # epsilon = 0: a paced victim loses nothing
+        assert admitted[v] == offered[v]
+    # hostile clamped to rate x duration + burst (small slack)
+    assert admitted[hostile] <= 100 * 2 + 100 + 5
+    assert gov.rate_limited == offered[hostile] - admitted[hostile]
+    assert (
+        gov.metrics.counters["peers.rate_limited"] == gov.rate_limited
+    )
+
+
+def test_rate_limited_records_still_admitted():
+    gov = OverloadGovernor(
+        max_batch=100, peer_rate=1.0, peer_burst=1,
+        metrics=Metrics(), clock=lambda: 0.0,
+    )
+    sender = uuid.uuid4()
+    assert gov.admit(Instruction.LOCAL_MESSAGE, sender)  # burns the burst
+    assert not gov.admit(Instruction.LOCAL_MESSAGE, sender)
+    # the bucket is empty, but record ops are never dropped by it
+    assert gov.admit(Instruction.RECORD_CREATE, sender)
+
+
+def test_sustained_abuse_evicts_exactly_once():
+    evicted = []
+    gov = OverloadGovernor(
+        max_batch=100, peer_rate=10.0, peer_burst=1, evict_after=5,
+        on_evict=evicted.append, metrics=Metrics(), clock=lambda: 0.0,
+    )
+    bad = uuid.uuid4()
+    for _ in range(30):
+        gov.admit(Instruction.LOCAL_MESSAGE, bad)
+    assert evicted == [bad]
+    gov.forget_peer(bad)  # the disconnect path resets the bookkeeping
+    assert bad not in gov._buckets
+
+
+# endregion
+
+# region: ticker integration (the head-of-line fix)
+
+
+class GatedBackend:
+    """Dispatch is instant; collect blocks until released — the shape
+    of a slow device tick."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.collects = 0
+
+    def dispatch_local_batch(self, queries):
+        return list(queries)
+
+    def collect_local_batch(self, handle):
+        self.collects += 1
+        assert self.gate.wait(timeout=15), "gate never released"
+        return [[] for _ in handle]
+
+    def supports_staged_dispatch(self):
+        return False
+
+
+def _local(i: int) -> tuple:
+    from worldql_server_tpu.spatial.backend import LocalQuery
+
+    message = Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=uuid.uuid4(),
+        world_name="world", position=Vector3(1, 1, 1), parameter=f"m{i}",
+    )
+    query = LocalQuery(
+        world="world", position=message.position,
+        sender=message.sender_uuid, replication=message.replication,
+    )
+    return message, query
+
+
+def test_slow_collect_does_not_block_enqueue():
+    """The ISSUE 10 satellite regression: hitting max_batch mid-message
+    used to await a full flush from inside the recv path. With a
+    governor the enqueue signals the pump and returns — the only thing
+    standing between a message and the queue is the admission decision
+    (drop-oldest past the cap)."""
+
+    async def scenario():
+        backend = GatedBackend()
+        gov = OverloadGovernor(max_batch=4, metrics=Metrics())
+        ticker = TickBatcher(
+            backend, PeerMap(), interval=0.01, max_batch=4, governor=gov,
+        )
+        ticker.start()
+        for i in range(4):  # fills the batch: pump flushes, collect blocks
+            await ticker.enqueue(*_local(i))
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            if backend.collects:
+                break
+        assert backend.collects == 1  # a flush is wedged in collect
+        t0 = time.perf_counter()
+        for i in range(4, 24):  # 20 enqueues against the wedged flush
+            await ticker.enqueue(*_local(i))
+        elapsed = time.perf_counter() - t0
+        assert not backend.gate.is_set()  # collect is STILL blocked
+        assert elapsed < 0.5, f"enqueue blocked {elapsed:.3f}s"
+        # admission capped the queue: 2 x max_batch, oldest dropped
+        assert len(ticker._queue) == 8
+        assert gov.drop_oldest == 12
+        backend.gate.set()
+        await ticker.stop()
+        # every admitted message flushed exactly once: 24 - 12 dropped
+        assert ticker.messages == 12
+
+    run(scenario())
+
+
+def test_degraded_tier_caps_flush_batch():
+    async def scenario():
+        backend = GatedBackend()
+        backend.gate.set()  # collect never blocks here
+        gov = OverloadGovernor(
+            max_batch=16, tick_budget_ms=5.0, deadline_k=1, min_batch=4,
+            metrics=Metrics(),
+        )
+        ticker = TickBatcher(
+            backend, PeerMap(), interval=60.0, max_batch=16, governor=gov,
+        )
+        for _ in range(2):  # two busts halve twice: 16 -> 8 -> 4
+            gov.note_tick(50.0, 0)
+        assert gov.admitted_batch == 4
+        for i in range(10):
+            await ticker.enqueue(*_local(i))
+        await ticker.flush()
+        assert ticker.last_batch == 4  # the admitted tier, not the queue
+        assert len(ticker._queue) == 6
+
+    run(scenario())
+
+
+def test_ungoverned_enqueue_keeps_inline_flush():
+    """--overload off pin: without a governor, hitting max_batch still
+    flushes inline (today's backpressure, byte for byte)."""
+
+    async def scenario():
+        backend = CpuSpatialBackend(16)
+        peer_map = PeerMap(on_remove=backend.remove_peer)
+        inbox = []
+        target = uuid.uuid4()
+
+        async def send_raw(data):
+            inbox.append(deserialize_message(data))
+
+        await peer_map.insert(Peer(target, "loop", send_raw, "test"))
+        backend.add_subscription("world", target, Vector3(1, 1, 1))
+        ticker = TickBatcher(backend, peer_map, interval=60.0, max_batch=3)
+        # pump NOT started: only the size-triggered inline flush runs
+        for i in range(3):
+            await ticker.enqueue(*_local(i))
+        assert [m.parameter for m in inbox] == ["m0", "m1", "m2"]
+
+    run(scenario())
+
+
+# endregion
+
+# region: entity-update coalescing
+
+
+def _entity_msg(sender, eid, pos, world="world"):
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+        world_name=world,
+        entities=[Entity(uuid=eid, position=pos, world_name=world)],
+    )
+
+
+def test_entity_updates_coalesce_last_write_wins():
+    gov = OverloadGovernor(max_batch=100, metrics=Metrics())
+    plane = EntityPlane(
+        CpuSpatialBackend(16), PeerMap(), cube_size=16, k=2, dt=0.05,
+        metrics=gov.metrics, governor=gov,
+    )
+    owner = uuid.uuid4()
+    eid = uuid.uuid4()
+    plane.ingest(_entity_msg(owner, eid, Vector3(1, 1, 1)))
+    assert plane.entity_count == 1
+
+    failpoints.registry.set("overload.force_state", "state:shed_low")
+    gov.note_idle(0)
+    assert gov.coalesce_entities()
+
+    # 5 updates for one live entity: 1 stages, 4 coalesce away
+    for i in range(5):
+        plane.ingest(_entity_msg(owner, eid, Vector3(10.0 + i, 2, 3)))
+    assert len(plane._pending) == 1
+    assert plane.coalesced == 4
+    assert gov.metrics.counters["overload.coalesced"] == 4
+    # audit invariant: offered == applied/staged + coalesced
+    assert plane.updates + plane.coalesced == 6
+
+    # drain applies ONLY the newest value (lossless for the stream)
+    plane._drain_pending()
+    slot = plane._slot_of[eid]
+    assert plane._pos[slot, 0] == pytest.approx(14.0)
+    assert plane._touched[slot]
+    assert not plane._pending
+
+    # a NEW entity registers immediately even while shedding
+    eid2 = uuid.uuid4()
+    plane.ingest(_entity_msg(owner, eid2, Vector3(5, 5, 5)))
+    assert eid2 in plane._slot_of and eid2 not in plane._pending
+
+
+def test_coalesced_update_enforces_ownership_and_removal():
+    gov = OverloadGovernor(max_batch=100, metrics=Metrics())
+    plane = EntityPlane(
+        CpuSpatialBackend(16), PeerMap(), cube_size=16, k=2, dt=0.05,
+        metrics=gov.metrics, governor=gov,
+    )
+    owner, thief = uuid.uuid4(), uuid.uuid4()
+    eid = uuid.uuid4()
+    plane.ingest(_entity_msg(owner, eid, Vector3(1, 1, 1)))
+    failpoints.registry.set("overload.force_state", "state:shed_high")
+    gov.note_idle(0)
+
+    # hijacking update never enters the staging dict
+    plane.ingest(_entity_msg(thief, eid, Vector3(9, 9, 9)))
+    assert not plane._pending
+
+    # staged update of a since-removed entity must not resurrect it
+    plane.ingest(_entity_msg(owner, eid, Vector3(2, 2, 2)))
+    assert eid in plane._pending
+    remove = _entity_msg(owner, eid, Vector3(2, 2, 2))
+    remove.parameter = "entity.remove"
+    plane.ingest(remove)
+    assert eid not in plane._pending
+    plane._drain_pending()
+    assert eid not in plane._slot_of
+
+
+def test_apply_skip_frames_sheds_fanout_but_advances_state():
+    plane = EntityPlane(
+        CpuSpatialBackend(16), PeerMap(), cube_size=16, k=2, dt=0.05,
+        metrics=Metrics(),
+    )
+    a, b = uuid.uuid4(), uuid.uuid4()
+    plane.ingest(_entity_msg(a, uuid.uuid4(), Vector3(1, 1, 1)))
+    plane.ingest(_entity_msg(b, uuid.uuid4(), Vector3(2, 1, 1)))
+    handle = plane.dispatch_tick()
+    result = plane.collect_tick(handle)
+    pairs = plane.apply(result, skip_frames=True)
+    assert pairs == []  # the delivery leg shed...
+    assert plane.frames_skipped == 1  # ...and accounted
+    assert plane.applied_ticks == 1  # the tick itself applied
+    # next tick with frames on delivers again
+    handle = plane.dispatch_tick()
+    pairs = plane.apply(plane.collect_tick(handle))
+    assert pairs  # co-cube entities of different peers -> frames
+
+    def count_stats():
+        return plane.stats()["frames_skipped"]
+
+    assert count_stats() == 1
+
+
+# endregion
+
+# region: server wiring + observability surface
+
+
+def overload_config(**overrides) -> Config:
+    config = Config(
+        store_url="memory://",
+        http_enabled=True, http_host="127.0.0.1", http_port=free_port(),
+        ws_enabled=False, zmq_enabled=False,
+        spatial_backend="cpu", tick_interval=0.02,
+        max_batch=64, overload="on",
+        supervisor_backoff=0.005,
+    )
+    for k, v in overrides.items():
+        setattr(config, k, v)
+    return config
+
+
+async def _fetch(port, path, accept=None):
+    def get():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"Accept": accept} if accept else {},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return resp.read().decode()
+
+    return await asyncio.to_thread(get)
+
+
+def test_server_overload_surface_healthz_and_metrics():
+    async def scenario():
+        server = WorldQLServer(overload_config())
+        await server.start()
+        try:
+            assert server.governor is not None
+            assert server.router.governor is server.governor
+            assert server.ticker._governor is server.governor
+            # budget derives from the tick interval
+            assert server.governor.tick_budget_ms == pytest.approx(20.0)
+
+            health = json.loads(
+                await _fetch(server.config.http_port, "/healthz")
+            )
+            assert health["overload"]["state"] == "ok"
+            assert health["status"] == "ok"
+
+            # forced REJECT degrades health and tags the shed counters
+            failpoints.registry.set(
+                "overload.force_state", "state:reject"
+            )
+            server.governor.note_idle(0)
+            await server.router.handle_message(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                sender_uuid=uuid.uuid4(), world_name="world",
+                position=Vector3(1, 1, 1),
+            ))
+            health = json.loads(
+                await _fetch(server.config.http_port, "/healthz")
+            )
+            assert health["overload"]["state"] == "reject"
+            assert health["overload"]["shed_local"] == 1
+            assert health["status"] == "degraded"
+
+            prom = await _fetch(server.config.http_port, "/metrics")
+            assert "wql_overload_state_level 3" in prom
+            assert "wql_overload_shed_local_total 1" in prom
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_overload_off_is_todays_behavior():
+    """The acceptance pin: --overload off (the default) builds NO
+    governor — router, ticker and plane take their unchanged paths,
+    and the observability surface carries no overload block."""
+
+    async def scenario():
+        config = overload_config(overload="off")
+        assert Config().overload == "off"  # the default IS off
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            assert server.governor is None
+            assert server.router.governor is None
+            assert server.ticker._governor is None
+            assert server.overload_status() is None
+            assert "overload" not in server.metrics.snapshot()["gauges"]
+            health = json.loads(
+                await _fetch(server.config.http_port, "/healthz")
+            )
+            assert "overload" not in health
+            prom = await _fetch(server.config.http_port, "/metrics")
+            assert "wql_overload" not in prom
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="overload must be"):
+        Config(overload="maybe").validate()
+    with pytest.raises(ValueError, match="overload_evict_after requires"):
+        Config(overload_evict_after=5).validate()
+    with pytest.raises(ValueError, match="max_batch"):
+        Config(max_batch=0).validate()
+    Config(
+        overload="on", overload_peer_rate=100.0, overload_evict_after=5,
+    ).validate()
+
+
+def test_rate_limit_eviction_goes_through_peer_map():
+    async def scenario():
+        config = overload_config(
+            overload_peer_rate=5.0, overload_peer_burst=2,
+            overload_evict_after=3,
+        )
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            chatty = uuid.uuid4()
+
+            async def send_raw(data):
+                pass
+
+            await server.peer_map.insert(
+                Peer(chatty, "loop", send_raw, "test")
+            )
+            for i in range(10):
+                await server.router.handle_message(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    sender_uuid=chatty, world_name="world",
+                    position=Vector3(1, 1, 1), parameter=f"m{i}",
+                ))
+            for _ in range(100):
+                if server.peer_map.get(chatty) is None:
+                    break
+                await asyncio.sleep(0.01)
+            assert server.peer_map.get(chatty) is None
+            assert server.metrics.counters[
+                "peers.evicted_rate_limited"
+            ] == 1
+            # forget_peer ran via the removal hook
+            assert chatty not in server.governor._buckets
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion

@@ -1,0 +1,119 @@
+"""Unbounded-ingest hazard rule.
+
+The overload plane (ISSUE 10) exists because one unbounded ``append``
+on an ingest path is a memory-exhaustion vector under hostile offered
+load: the tick queue, the entity pending buffer, and any transport-
+side backlog all grow at wire speed while the event loop drains at
+device speed. Every growth site on an ingest path must therefore sit
+behind an admission decision (the ``OverloadGovernor``: a queue cap
+with drop-oldest, a coalescing dict keyed by a bounded id space, a
+token bucket) — or carry an auditable
+``# wql: allow(unbounded-ingest)`` pragma explaining why it is
+bounded some other way.
+
+Scope: the modules that receive wire traffic (``engine/ticker.py``,
+``engine/router.py``, ``entities/plane.py``, ``transports/zeromq.py``,
+``transports/websocket.py``), and within them only the ingest-path
+functions (message arrival → enqueue). A function is exempt when it
+visibly consults the admission plane — any reference whose dotted
+path mentions the governor or one of its admission calls — because
+the growth it performs is then governed by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name, walk_shallow
+
+#: modules that take wire traffic (relpath suffixes)
+_SCOPED = (
+    "engine/ticker.py",
+    "engine/router.py",
+    "entities/plane.py",
+    "transports/zeromq.py",
+    "transports/websocket.py",
+)
+
+#: the ingest-path functions inside them (arrival → enqueue)
+_INGEST_FUNCS = {
+    "enqueue",
+    "ingest",
+    "handle_message",
+    "_dispatch",
+    "_entity_ingest",
+    "_local_message",
+    "_global_message",
+    "_stage_update",
+    "_recv_loop",
+    "_process_inbound",
+    "_decode_route",
+    "_handle_connection",
+    "_next_message",
+}
+
+#: container-growth calls that are unbounded unless admitted
+_GROW_METHODS = {"append", "appendleft", "extend", "extendleft"}
+
+#: names whose presence marks the function as admission-governed
+_ADMIT_NAMES = {
+    "admit",
+    "local_queue_cap",
+    "note_queue_depth",
+    "note_drop_oldest",
+    "coalesce_entities",
+}
+
+
+def _mentions_admission(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute):
+            if "governor" in node.attr or node.attr in _ADMIT_NAMES:
+                return True
+        elif isinstance(node, ast.Name):
+            if "governor" in node.id or node.id in _ADMIT_NAMES:
+                return True
+    return False
+
+
+def _check_unbounded_ingest(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_SCOPED):
+        return
+    funcs = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _INGEST_FUNCS
+    ]
+    for func in funcs:
+        if _mentions_admission(func):
+            continue
+        for node in walk_shallow(func.body):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _GROW_METHODS
+            ):
+                continue
+            target = dotted_name(node.func.value) or "<container>"
+            yield from ctx.flag(
+                UNBOUNDED_INGEST,
+                node,
+                f"unbounded {target}.{node.func.attr}(...) on the "
+                f"ingest path ({func.name}) with no admission "
+                "decision — hostile offered load grows it at wire "
+                "speed while the loop drains at device speed; gate "
+                "it behind the overload governor (admit/"
+                "local_queue_cap drop-oldest/coalesce) or justify "
+                "the bound with # wql: allow(unbounded-ingest)",
+            )
+
+
+UNBOUNDED_INGEST = Rule(
+    "unbounded-ingest",
+    "ingest-path container growth without an admission decision "
+    "(router/transport/entity arrival paths)",
+    _check_unbounded_ingest,
+)
+
+RULES = [UNBOUNDED_INGEST]

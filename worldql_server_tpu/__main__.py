@@ -180,6 +180,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "±bounds (default 1000)")
     p.add_argument("--entity-max", type=int, dest="entity_max",
                    help="live-entity hard cap (default 65536)")
+    p.add_argument("--max-batch", type=int, dest="max_batch",
+                   help="tick batch cap: a full queue flushes early; "
+                        "also the overload governor's full-service "
+                        "admitted tier (default 16384)")
+    p.add_argument("--overload", choices=["off", "on"],
+                   help="overload control plane: hysteretic OK/"
+                        "SHED_LOW/SHED_HIGH/REJECT admission governor "
+                        "— record ops never shed, globals shed last, "
+                        "locals drop-oldest, entity updates coalesce "
+                        "last-write-wins; per-peer token buckets; "
+                        "tick-deadline degradation (default off = "
+                        "today's behavior byte for byte)")
+    p.add_argument("--overload-tick-budget-ms", type=float,
+                   dest="overload_tick_budget_ms",
+                   help="tick wall budget for deadline degradation in "
+                        "ms (default 0 = derive from --tick-interval)")
+    p.add_argument("--overload-deadline-k", type=int,
+                   dest="overload_deadline_k",
+                   help="consecutive budget busts before the admitted "
+                        "batch tier halves (default 3)")
+    p.add_argument("--overload-recover-ticks", type=int,
+                   dest="overload_recover_ticks",
+                   help="consecutive healthy samples per one-state "
+                        "de-escalation / tier restore step (default 5)")
+    p.add_argument("--overload-min-batch", type=int,
+                   dest="overload_min_batch",
+                   help="floor of the degraded admitted batch tier "
+                        "(default 256)")
+    p.add_argument("--overload-peer-rate", type=float,
+                   dest="overload_peer_rate",
+                   help="per-peer token bucket rate in msgs/s; record "
+                        "ops are never dropped by it (default 0 = no "
+                        "bucket)")
+    p.add_argument("--overload-peer-burst", type=int,
+                   dest="overload_peer_burst",
+                   help="token bucket burst capacity (default 0 = "
+                        "2x rate)")
+    p.add_argument("--overload-evict-after", type=int,
+                   dest="overload_evict_after",
+                   help="evict a peer after this many consecutive "
+                        "rate-limited messages (default 0 = never)")
+    p.add_argument("--overload-rss-limit-mb", type=int,
+                   dest="overload_rss_limit_mb",
+                   help="RSS ceiling in MiB for the governor's memory "
+                        "signal (default 0 = off)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -202,6 +247,10 @@ _OVERRIDES = [
     "supervisor_budget", "supervisor_backoff",
     "slow_tick_ms", "flight_recorder_depth", "slow_tick_dir",
     "entity_k", "entity_bounds", "entity_max",
+    "max_batch", "overload", "overload_tick_budget_ms",
+    "overload_deadline_k", "overload_recover_ticks",
+    "overload_min_batch", "overload_peer_rate", "overload_peer_burst",
+    "overload_evict_after", "overload_rss_limit_mb",
 ]
 
 

@@ -292,6 +292,62 @@ class Config:
     entity_max: int = field(
         default_factory=lambda: int(_env("WQL_ENTITY_MAX", str(1 << 16)))
     )
+    # Tick batch cap: a full queue flushes early (engine/ticker.py).
+    # Also the overload governor's full-service admitted tier and the
+    # denominator of its queue-pressure signal.
+    max_batch: int = field(
+        default_factory=lambda: int(_env("WQL_MAX_BATCH", "16384"))
+    )
+    # Overload control plane (robustness/overload.py): 'on' builds the
+    # OverloadGovernor — hysteretic OK→SHED_LOW→SHED_HIGH→REJECT state
+    # machine driven by tick wall / queue depth / loop lag / RSS,
+    # priority-classed admission at the router (record ops never shed,
+    # globals shed last, locals drop-oldest, entity updates coalesce
+    # LWW per uuid), per-peer token buckets, and tick-deadline
+    # degradation. 'off' (the default) constructs nothing: every
+    # ingest path keeps today's behavior byte for byte.
+    overload: str = field(
+        default_factory=lambda: _env("WQL_OVERLOAD", "off")
+    )
+    # Tick wall budget in ms for deadline degradation; 0 derives it
+    # from tick_interval (the deadline IS the interval — a tick slower
+    # than its window can't hold rate).
+    overload_tick_budget_ms: float = field(
+        default_factory=lambda: float(_env("WQL_OVERLOAD_TICK_BUDGET_MS", "0"))
+    )
+    # Consecutive over-budget ticks before the admitted batch tier
+    # halves (and the governor's tick signal starts voting).
+    overload_deadline_k: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_DEADLINE_K", "3"))
+    )
+    # Consecutive healthy samples before de-escalating ONE state (and
+    # before a degraded tier doubles back). Full recovery from REJECT
+    # therefore takes at most 3 × this many ticks.
+    overload_recover_ticks: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_RECOVER_TICKS", "5"))
+    )
+    # Floor of the degraded admitted batch tier.
+    overload_min_batch: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_MIN_BATCH", "256"))
+    )
+    # Per-peer token bucket: sustained messages/s per peer (0 = no
+    # bucket). Record ops consume tokens but are never dropped.
+    overload_peer_rate: float = field(
+        default_factory=lambda: float(_env("WQL_OVERLOAD_PEER_RATE", "0"))
+    )
+    # Bucket burst capacity (0 = 2 × rate).
+    overload_peer_burst: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_PEER_BURST", "0"))
+    )
+    # Evict a peer after this many CONSECUTIVE rate-limited messages
+    # (sustained abuse); 0 = never evict, just drop.
+    overload_evict_after: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_EVICT_AFTER", "0"))
+    )
+    # RSS ceiling in MiB for the governor's memory signal (0 = off).
+    overload_rss_limit_mb: int = field(
+        default_factory=lambda: int(_env("WQL_OVERLOAD_RSS_LIMIT_MB", "0"))
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -434,6 +490,34 @@ class Config:
                     "entity_sim requires tick_interval > 0 — the "
                     "simulation advances once per ticker flush"
                 )
+        if self.max_batch < 1:
+            errors.append("max_batch must be >= 1")
+        if self.overload not in ("off", "on"):
+            errors.append("overload must be 'off' or 'on'")
+        if self.overload_tick_budget_ms < 0:
+            errors.append(
+                "overload_tick_budget_ms must be >= 0 (0 = derive "
+                "from tick_interval)"
+            )
+        if self.overload_deadline_k < 1:
+            errors.append("overload_deadline_k must be >= 1")
+        if self.overload_recover_ticks < 1:
+            errors.append("overload_recover_ticks must be >= 1")
+        if self.overload_min_batch < 1:
+            errors.append("overload_min_batch must be >= 1")
+        if self.overload_peer_rate < 0:
+            errors.append("overload_peer_rate must be >= 0 (0 = no bucket)")
+        if self.overload_peer_burst < 0:
+            errors.append("overload_peer_burst must be >= 0 (0 = 2x rate)")
+        if self.overload_evict_after < 0:
+            errors.append("overload_evict_after must be >= 0 (0 = never)")
+        if self.overload_rss_limit_mb < 0:
+            errors.append("overload_rss_limit_mb must be >= 0 (0 = off)")
+        if self.overload_evict_after and not self.overload_peer_rate:
+            errors.append(
+                "overload_evict_after requires overload_peer_rate > 0 "
+                "(eviction is driven by the token bucket)"
+            )
         if self.entity_k < 1:
             errors.append("entity_k must be >= 1")
         if self.entity_bounds <= 0:

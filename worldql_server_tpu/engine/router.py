@@ -51,6 +51,7 @@ class Router:
         durability: DurabilityPipeline | None = None,
         tracer=None,
         entity_plane=None,
+        governor=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
@@ -68,6 +69,14 @@ class Router:
         # the instruction as tag. One `enabled` branch per message when
         # off — same budget as the trace_packet call below.
         self.tracer = tracer
+        # Optional robustness.overload.OverloadGovernor (--overload
+        # on): priority-classed admission at THE ingest choke point —
+        # record ops are never shed, GlobalMessages shed last (REJECT
+        # only), LocalMessages shed drop-oldest at the ticker queue,
+        # entity updates coalesce in the plane, and per-peer token
+        # buckets keep one chatty client from starving the rest. None
+        # (the default) is zero-cost: one attribute test per message.
+        self.governor = governor
         # Every record op goes through the durability frontend — never
         # `await self.store.…` directly (tools/check: store-on-loop).
         # Without an injected pipeline, an off-mode pass-through keeps
@@ -108,6 +117,20 @@ class Router:
         # drops this message (counted in messages.errors), never more
         failpoints.fire("router.dispatch")
         instruction = message.instruction
+
+        governor = self.governor
+        if governor is not None:
+            is_entity = (
+                self.entity_plane is not None
+                and bool(message.entities)
+                and instruction in (
+                    Instruction.LOCAL_MESSAGE, Instruction.GLOBAL_MESSAGE
+                )
+            )
+            if not governor.admit(
+                instruction, message.sender_uuid, is_entity
+            ):
+                return  # shed — already classified and counted
 
         if instruction == Instruction.HEARTBEAT:
             await self._heartbeat(message)

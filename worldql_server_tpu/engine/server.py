@@ -163,6 +163,32 @@ class WorldQLServer:
             on_remove=self._on_peer_remove, metrics=self.metrics,
             plane=self.delivery_plane,
         )
+        # Overload control plane (robustness/overload.py): admission
+        # governor for router, ticker and entity plane. None with
+        # --overload off (the default) — no governor object exists and
+        # every gated path keeps today's behavior byte for byte.
+        self.governor = None
+        if config.overload == "on":
+            from ..robustness.overload import OverloadGovernor
+
+            budget_ms = config.overload_tick_budget_ms
+            if not budget_ms and config.tick_interval > 0:
+                # the deadline IS the tick window: slower can't hold rate
+                budget_ms = config.tick_interval * 1e3
+            self.governor = OverloadGovernor(
+                max_batch=config.max_batch,
+                tick_budget_ms=budget_ms,
+                deadline_k=config.overload_deadline_k,
+                recover_ticks=config.overload_recover_ticks,
+                min_batch=min(config.overload_min_batch, config.max_batch),
+                peer_rate=config.overload_peer_rate,
+                peer_burst=config.overload_peer_burst,
+                evict_after=config.overload_evict_after,
+                rss_limit_mb=config.overload_rss_limit_mb,
+                metrics=self.metrics,
+                loop_monitor=self.loop_monitor,
+                on_evict=self._on_rate_limit_evict,
+            )
         # Entity simulation plane (worldql_server_tpu/entities): the
         # device-resident moving-object workload. Constructed only in
         # --entity-sim mode (validate() guarantees a device backend +
@@ -180,6 +206,7 @@ class WorldQLServer:
                 max_entities=config.entity_max,
                 metrics=self.metrics,
                 tracer=self.tracer,
+                governor=self.governor,
             )
         self.ticker = None
         self.staging = None
@@ -200,11 +227,13 @@ class WorldQLServer:
                 self.staging = QueryStaging(self.backend)
             self.ticker = TickBatcher(
                 self.backend, self.peer_map, config.tick_interval,
+                max_batch=config.max_batch,
                 metrics=self.metrics, pipeline=config.tick_pipeline,
                 supervisor=self.supervisor, tracer=self.tracer,
                 device_telemetry=self.device_telemetry,
                 staging=self.staging,
                 entity_plane=self.entity_plane,
+                governor=self.governor,
             )
         self.precompile_stats: dict | None = None
         # Durability engine: WAL + write-behind pipeline. With
@@ -237,6 +266,7 @@ class WorldQLServer:
             ticker=self.ticker, metrics=self.metrics,
             durability=self.durability, tracer=self.tracer,
             entity_plane=self.entity_plane,
+            governor=self.governor,
         )
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
@@ -304,6 +334,10 @@ class WorldQLServer:
                 )
         if self.entity_plane is not None:
             self.metrics.gauge("entity_sim", self.entity_plane.stats)
+        if self.governor is not None:
+            # governor state + shed/coalesce/rate-limit accounting:
+            # nothing the overload plane does is invisible to a scrape
+            self.metrics.gauge("overload", self.governor.status)
         if self.device_telemetry is not None:
             self.metrics.gauge("device", self.device_telemetry.stats)
         if self.recorder is not None:
@@ -355,6 +389,13 @@ class WorldQLServer:
         }
         return status
 
+    def overload_status(self) -> dict | None:
+        """Governor state + shed accounting for /healthz; None with
+        --overload off (the reference-shaped body stays untouched)."""
+        if self.governor is None:
+            return None
+        return self.governor.status()
+
     def durability_status(self) -> dict | None:
         """Queue depth, WAL state, and last recovery for /healthz and
         the ``durability`` gauge; None when durability is off."""
@@ -365,10 +406,26 @@ class WorldQLServer:
             status["recovery"] = self.last_recovery.as_dict()
         return status
 
+    def _on_rate_limit_evict(self, uuid) -> None:
+        """Overload-governor eviction hook: a peer exhausted its abuse
+        budget (``overload_evict_after`` consecutive rate-limited
+        messages). Leaves through the normal ``PeerMap.remove`` path —
+        PeerDisconnect broadcast, removal hooks, accounting — exactly
+        like the failed-send and worker-lost evictions."""
+        self.metrics.inc("peers.evicted_rate_limited")
+        task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
+            self.peer_map.remove(uuid)
+        )
+        self._delivery_evictions.add(task)
+        task.add_done_callback(self._delivery_evictions.discard)
+
     def _on_peer_remove(self, uuid) -> None:
         """Disconnect cleanup: purge the spatial index (the remove_rx
         path, thread.rs:124-126) and let transports drop socket state."""
         self.backend.remove_peer(uuid)
+        if self.governor is not None:
+            # token bucket bookkeeping stays bounded by live peers
+            self.governor.forget_peer(uuid)
         if self.entity_plane is not None:
             # entity slots + refcounts of the departed peer; its index
             # rows (entity-derived included) are already purged above
@@ -452,6 +509,12 @@ class WorldQLServer:
 
         if self.ticker is not None:
             self.ticker.start()
+
+        if self.governor is not None and self.ticker is None:
+            # immediate-mode servers have no tick clock — a supervised
+            # sampler keeps the lag/RSS signals (and state recovery)
+            # evaluating; with a ticker, note_tick drives everything
+            self.supervisor.spawn("overload-governor", self.governor.run)
 
         if self._restored_peers:
             self.supervisor.spawn(
@@ -651,7 +714,7 @@ class WorldQLServer:
         # sweep run (by which point every handle is already stopped).
         for name in (
             "checkpoint", "stale-sweep", "restored-peer-sweep",
-            "loop-monitor",
+            "loop-monitor", "overload-governor",
         ):
             handle = self.supervisor.get(name)
             if handle is not None:

@@ -27,6 +27,17 @@ awaits the chain instead of cancelling it — the shield/re-queue
 guarantees of the sequential flush carry over unchanged.
 ``pipeline == 1`` (the default) keeps the sequential flush byte for
 byte.
+
+Overload governance (``--overload on``, ISSUE 10): with a governor
+attached, ``enqueue`` never awaits — a full queue signals the pump
+(``_flush_request``) instead of flushing inline, so a slow device
+collect cannot head-of-line-block the transport recv loop; admission
+(drop-oldest past ``local_queue_cap``) is the only shedding on that
+path. Flushes take at most the governor's admitted batch tier, tick
+walls feed its deadline-degradation counters, and the entity
+neighbor-frame leg skips every other tick while degraded. Without a
+governor (the default) every one of those paths is byte-for-byte
+today's behavior.
 """
 
 from __future__ import annotations
@@ -59,6 +70,7 @@ class TickBatcher:
         device_telemetry=None,
         staging=None,
         entity_plane=None,
+        governor=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
@@ -84,6 +96,21 @@ class TickBatcher:
         self._staging = staging
         self.staged_flushes = 0
         self.staging_fallbacks = 0
+        # Optional robustness.overload.OverloadGovernor (--overload on):
+        # enqueue becomes NONBLOCKING (signal the pump instead of
+        # awaiting a flush — the admission decision, drop-oldest past
+        # local_queue_cap, is the only thing that can shed work on the
+        # recv path), flushes take at most the admitted batch tier,
+        # each tick wall feeds the deadline-degradation counters, and
+        # entity neighbor-frame fan-out skips every other tick while
+        # the tier is degraded. None (the default) keeps today's
+        # behavior byte for byte, including the size-triggered inline
+        # flush and its backpressure.
+        self._governor = governor
+        # staged columns go stale the moment admission drops or splits
+        # the queue (rows no longer line up with queued messages);
+        # the flag stops further appends until the next resync/swap
+        self._staging_desynced = False
         # Optional observability.device.DeviceTelemetry: after each
         # collect it tags the tick trace with the device timing split
         # (encode/h2d/compute/d2h) and polls the retrace GUARD so a
@@ -104,9 +131,14 @@ class TickBatcher:
         self._sup = supervisor
         self._handle = None
         self.pipeline = max(1, int(pipeline))
-        self._queue: list[tuple[Message, LocalQuery]] = []
+        self._queue: deque[tuple[Message, LocalQuery]] = deque()
         self._task: asyncio.Task | None = None
         self._flushing = asyncio.Lock()
+        # size-triggered flush request: enqueue SETS it at max_batch
+        # and the pump wakes immediately — hitting the cap mid-message
+        # must never await a full device flush from inside the recv
+        # path (head-of-line blocking, ISSUE 10)
+        self._flush_request = asyncio.Event()
         # pipelined collect+deliver stages: _inflight caps the depth,
         # _tail is the chain head the NEXT stage must wait out before
         # delivering (arrival-order guarantee across ticks)
@@ -143,13 +175,37 @@ class TickBatcher:
                 pass
             self._task = None
         await self.flush()  # drain in-flight stages + whatever is left
+        while self._queue:
+            # governed flushes take at most the admitted tier — keep
+            # draining until the queue is empty (progress guaranteed:
+            # every flush takes >= min_batch >= 1)
+            await self.flush()
 
     def inflight(self) -> int:
         """Dispatched-but-undelivered ticks right now (gauge)."""
         return len(self._inflight)
 
     async def enqueue(self, message: Message, query: LocalQuery) -> None:
-        self._queue.append((message, query))
+        gov = self._governor
+        if gov is not None:
+            # Governed ingest (--overload on): NEVER await a flush
+            # here — signal the pump and return, so a slow device
+            # collect cannot head-of-line-block the transport recv
+            # loop. The admission decision is the only shedding:
+            # past local_queue_cap the OLDEST queued query drops
+            # (the newest position is the freshest work).
+            if len(self._queue) >= gov.local_queue_cap():
+                self._queue.popleft()
+                gov.note_drop_oldest()
+                self._staging_desynced = True
+            self._queue.append((message, query))  # wql: allow(unbounded-ingest) — capped by local_queue_cap above
+            if self._staging is not None and not self._staging_desynced:
+                self._staging.append(query)
+            gov.note_queue_depth(len(self._queue))
+            if len(self._queue) >= self.max_batch:
+                self._flush_request.set()
+            return
+        self._queue.append((message, query))  # wql: allow(unbounded-ingest) — legacy ungoverned path: size cap flushes inline below
         if self._staging is not None:
             # enqueue-time encode: intern + write one staging row NOW,
             # amortized across the tick window; the query object rides
@@ -163,7 +219,16 @@ class TickBatcher:
 
     async def _run(self) -> None:
         while True:
-            await asyncio.sleep(self.interval)
+            # the timer OR a size-triggered flush request, whichever
+            # lands first — a full queue flushes immediately without
+            # the recv path ever blocking on it
+            try:
+                await asyncio.wait_for(
+                    self._flush_request.wait(), timeout=self.interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._flush_request.clear()
             # deliberately OUTSIDE the containment below: an armed
             # `ticker.pump` failpoint kills the pump itself, which is
             # how the chaos suite drives supervisor restart/escalation
@@ -193,11 +258,15 @@ class TickBatcher:
             logger.exception("entity sim dispatch failed — sim tick skipped")
             return None
 
-    async def _sim_collect_apply(self, sim_handle, trace) -> list:
+    async def _sim_collect_apply(self, sim_handle, trace,
+                                 skip_frames: bool = False) -> list:
         """Wait out the sim tick on a worker thread, then integrate it
         back into the host authority on the loop. Returns the tick's
         neighbor-frame delivery pairs; a failed sim tick aborts cleanly
-        (host columns stay authoritative) and returns []."""
+        (host columns stay authoritative) and returns [].
+        ``skip_frames`` (deadline degradation) applies the tick —
+        positions and index churn always advance — but sheds the
+        neighbor-frame fan-out leg."""
         plane = self._entity_plane
         try:
             with trace.span("tick.sim.knn"):
@@ -205,7 +274,7 @@ class TickBatcher:
                     plane.collect_tick, sim_handle
                 )
             with trace.span("tick.sim.apply"):
-                return plane.apply(result, trace)
+                return plane.apply(result, trace, skip_frames=skip_frames)
         except asyncio.CancelledError:
             plane.abort_tick()
             raise
@@ -213,6 +282,24 @@ class TickBatcher:
             plane.abort_tick()
             logger.exception("entity sim tick failed — sim frames dropped")
             return []
+
+    def _take_batch(self) -> list:
+        """Drain the pending queue for one flush. Ungoverned: the
+        whole queue, exactly as before. Governed: at most the admitted
+        batch tier — the remainder stays queued and the pump is
+        re-signalled, so a degraded tier serves smaller, deadline-
+        fitting ticks instead of one giant bust."""
+        queue = self._queue
+        gov = self._governor
+        if gov is not None:
+            admitted = gov.admitted_batch
+            if admitted < len(queue):
+                batch = [queue.popleft() for _ in range(admitted)]
+                self._flush_request.set()  # backlog remains
+                return batch
+        batch = list(queue)
+        queue.clear()
+        return batch
 
     # endregion
 
@@ -227,9 +314,13 @@ class TickBatcher:
         contract as the sequential path's _run handler)."""
         self._reap()
         async with self._flushing:
-            batch, self._queue = self._queue, []
+            batch = self._take_batch()
             plane = self._entity_plane
             sim_on = plane is not None and plane.active()
+            if not batch and not sim_on and self._governor is not None:
+                # idle windows are healthy samples — the governor's
+                # road back to OK once load drops
+                self._governor.note_idle(len(self._queue))
             if batch or sim_on:
                 trace = self._begin_trace(len(batch))
                 t0 = time.perf_counter()
@@ -238,6 +329,11 @@ class TickBatcher:
                 # closed at delivery completion on whichever path
                 t_ingress_ns = time.monotonic_ns()
                 sim_handle = self._sim_dispatch(trace)
+                skip_frames = (
+                    self._governor is not None
+                    and sim_handle is not None
+                    and self._governor.take_frame_skip()
+                )
                 handle = None
                 if batch:
                     try:
@@ -259,7 +355,7 @@ class TickBatcher:
                         raise
                 stage = self._collect_deliver(
                     batch, handle, self._tail, t0, trace, t_ingress_ns,
-                    sim_handle,
+                    sim_handle, skip_frames,
                 )
                 if self._sup is not None:
                     task = self._sup.spawn_transient("tick-collect", stage)
@@ -280,7 +376,8 @@ class TickBatcher:
 
     async def _collect_deliver(self, batch, handle, prev, t0, trace,
                                t_ingress_ns: int = 0,
-                               sim_handle=None) -> None:
+                               sim_handle=None,
+                               skip_frames: bool = False) -> None:
         """Stage 2 of a pipelined tick: device collect (worker thread),
         then — strictly after tick N-1's stage finished — the batched
         delivery. Handles its own errors (a failed collect drops only
@@ -288,14 +385,15 @@ class TickBatcher:
         cancelled by stop(), which awaits the chain instead."""
         try:
             await self._collect_deliver_inner(
-                batch, handle, prev, t0, trace, t_ingress_ns, sim_handle
+                batch, handle, prev, t0, trace, t_ingress_ns, sim_handle,
+                skip_frames,
             )
         finally:
             trace.finish()  # idempotent; seals drop/error paths too
 
     async def _collect_deliver_inner(
         self, batch, handle, prev, t0, trace, t_ingress_ns: int = 0,
-        sim_handle=None,
+        sim_handle=None, skip_frames: bool = False,
     ) -> None:
         targets = None
         if handle is not None:
@@ -319,7 +417,9 @@ class TickBatcher:
         # overlaps the predecessor's delivery drain.
         sim_pairs = []
         if sim_handle is not None:
-            sim_pairs = await self._sim_collect_apply(sim_handle, trace)
+            sim_pairs = await self._sim_collect_apply(
+                sim_handle, trace, skip_frames
+            )
         # Arrival order across ticks: tick N-1's deliveries must all
         # complete before ours start — even when our collect finished
         # first (worker threads overlap). Ride out cancellation: the
@@ -378,7 +478,11 @@ class TickBatcher:
         a correctness dependency."""
         st = self._staging
         if st is not None:
-            if st.count == len(batch) and st.epoch_ok():
+            if (
+                not self._staging_desynced
+                and st.count == len(batch)
+                and st.epoch_ok()
+            ):
                 cols = st.swap()
                 self.staged_flushes += 1
                 if self.metrics is not None:
@@ -387,6 +491,7 @@ class TickBatcher:
                     *cols, fallback=batch
                 )
             st.resync()
+            self._staging_desynced = False
             self.staging_fallbacks += 1
             if self.metrics is not None:
                 self.metrics.inc("tick.staging_fallbacks")
@@ -430,10 +535,12 @@ class TickBatcher:
         keeps cross-tick arrival order)."""
         await self._drain_inflight()
         async with self._flushing:
-            batch, self._queue = self._queue, []
+            batch = self._take_batch()
             plane = self._entity_plane
             sim_on = plane is not None and plane.active()
             if not batch and not sim_on:
+                if self._governor is not None:
+                    self._governor.note_idle(len(self._queue))
                 return
             trace = self._begin_trace(len(batch))
             t0 = time.perf_counter()
@@ -442,6 +549,11 @@ class TickBatcher:
             dispatched = not batch
             deliver_task = None
             sim_handle = self._sim_dispatch(trace)
+            skip_frames = (
+                self._governor is not None
+                and sim_handle is not None
+                and self._governor.take_frame_skip()
+            )
             try:
                 targets = []
                 if batch:
@@ -479,7 +591,9 @@ class TickBatcher:
                 ]
                 if sim_handle is not None:
                     pairs.extend(
-                        await self._sim_collect_apply(sim_handle, trace)
+                        await self._sim_collect_apply(
+                            sim_handle, trace, skip_frames
+                        )
                     )
                 # One batched delivery: every message's frame goes to
                 # its targets' transport buffers synchronously; only
@@ -504,7 +618,7 @@ class TickBatcher:
                     # stop() landed before the device collect: the
                     # whole batch is still owed — re-queue it for the
                     # drain flush.
-                    self._queue = batch + self._queue
+                    self._queue.extendleft(reversed(batch))
                 elif deliver_task is not None:
                     # delivery already in flight: let it finish (peers
                     # without a sync fast path — e.g. ZMQ — are only
@@ -540,6 +654,10 @@ class TickBatcher:
             "tick", tick=self._tick_seq, batch=batch_size,
             inflight=len(self._inflight), pipeline=self.pipeline,
         )
+        if self._governor is not None:
+            # overload state rides every tick trace: a slow-tick dump
+            # answers "was the governor shedding?" without a scrape
+            trace.tag(overload=self._governor.state)
         if trace is not NULL_TRACE:
             stats_fn = getattr(self.backend, "device_stats", None)
             if stats_fn is not None:
@@ -570,6 +688,8 @@ class TickBatcher:
             self.metrics.observe_ms("tick.deliver_ms", self.last_deliver_ms)  # wql: allow(unspanned-stage)
             self.metrics.inc("tick.flushes")
             self.metrics.inc("tick.messages", len(batch))
+        if self._governor is not None:
+            self._governor.note_tick(self.last_tick_ms, len(self._queue))
         trace.tag(tick_ms=round(self.last_tick_ms, 3))
         trace.finish()
 

@@ -93,6 +93,7 @@ class EntityPlane:
         max_entities: int = 1 << 16,
         metrics=None,
         tracer=None,
+        governor=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
@@ -103,6 +104,18 @@ class EntityPlane:
         self.max_entities = int(max_entities)
         self.metrics = metrics
         self.tracer = tracer
+        # Optional robustness.overload.OverloadGovernor: under
+        # SHED_LOW+ updates of LIVE entities coalesce last-write-wins
+        # per uuid into _pending and apply once per tick — lossless
+        # for position streams (the newest position subsumes the ones
+        # it overwrote), and the first step of the columnar
+        # entity-update staging path (ROADMAP item 4). Registrations
+        # and removals always apply immediately (control plane).
+        self._governor = governor
+        #: uuid → latest staged Entity (bounded by live entities)
+        self._pending: dict[uuid_mod.UUID, Entity] = {}
+        self.coalesced = 0
+        self.frames_skipped = 0
 
         # host SoA columns (authority; slot-indexed, pow2 capacity)
         self._cap = _MIN_CAP
@@ -177,11 +190,19 @@ class EntityPlane:
         Returns entities applied."""
         sender = message.sender_uuid
         removing = message.parameter == PARAM_REMOVE
+        governor = self._governor
+        coalesce = (
+            not removing
+            and governor is not None
+            and governor.coalesce_entities()
+        )
         applied = 0
         for ent in message.entities:
             try:
                 if removing:
                     applied += self._remove_entity(ent.uuid, sender)
+                elif coalesce and ent.uuid in self._slot_of:
+                    applied += self._stage_update(ent, message, sender)
                 else:
                     applied += self._upsert(ent, message, sender)
             except SanitizeError as exc:
@@ -193,6 +214,54 @@ class EntityPlane:
             self.metrics.inc("sim.updates", applied)
         self.updates += applied
         return applied
+
+    def _stage_update(self, ent: Entity, message: Message,
+                      sender: uuid_mod.UUID) -> int:
+        """Coalescing admission (governor SHED_LOW+): stage the update
+        of a LIVE entity last-write-wins per uuid; ``_drain_pending``
+        applies the survivors in one pass at the next dispatch.
+        Ownership and world sanitation are enforced HERE so a hostile
+        update can't hide in the staging dict. An overwrite counts as
+        ``overload.coalesced`` — shed-but-lossless work (the audit
+        invariant: offered == applied + coalesced + dropped)."""
+        sanitize_world_name(ent.world_name or message.world_name)
+        slot = self._slot_of[ent.uuid]
+        owner = self._peer_uuids[self._pid[slot]]
+        if owner != sender:
+            logger.warning(
+                "peer %s sent update for entity %s owned by %s — "
+                "dropped", sender, ent.uuid, owner,
+            )
+            return 0
+        if ent.uuid in self._pending:
+            self.coalesced += 1
+            if self.metrics is not None:
+                self.metrics.inc("overload.coalesced")
+            self._pending[ent.uuid] = ent
+            return 0
+        self._pending[ent.uuid] = ent
+        return 1
+
+    def _drain_pending(self) -> None:
+        """Apply every staged update straight into the host columns
+        (one dict pass per tick instead of per-message work — the
+        coalescing staleness bound is therefore the same one tick the
+        plane already documents)."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        for eid, ent in pending.items():
+            slot = self._slot_of.get(eid)
+            if slot is None:
+                continue  # removed after staging
+            p = ent.position
+            self._pos[slot, 0] = p.x
+            self._pos[slot, 1] = p.y
+            self._pos[slot, 2] = p.z
+            vel = _decode_velocity(ent.flex)
+            if vel is not None:
+                self._vel[slot] = vel
+            self._touched[slot] = True
 
     def _upsert(self, ent: Entity, message: Message,
                 sender: uuid_mod.UUID) -> int:
@@ -318,6 +387,8 @@ class EntityPlane:
     def _release_slot(self, slot: int, pid: int) -> None:
         uuid = self._uuid_of.pop(slot)
         del self._slot_of[uuid]
+        # a staged update must not resurrect a removed entity at drain
+        self._pending.pop(uuid, None)
         slots = self._peer_slots.get(pid)
         if slots is not None:
             slots.discard(slot)
@@ -383,6 +454,7 @@ class EntityPlane:
         None when idle / a previous tick is still in flight (pipelined
         flushes never stack sim ticks — the writeback of tick N is
         input to tick N+1)."""
+        self._drain_pending()  # coalesced updates apply tick-edge
         if not self._slot_of or self._tick_inflight:
             return None
         t0 = time.perf_counter()
@@ -439,12 +511,15 @@ class EntityPlane:
             self._tick_inflight = False
             self.dropped_ticks += 1
 
-    def apply(self, result: dict, trace=None) -> list:
+    def apply(self, result: dict, trace=None,
+              skip_frames: bool = False) -> list:
         """Integrate one collected tick back into the host authority
         (event-loop thread): position writeback, index churn through
         the base+delta path, neighbor-frame assembly. Returns
         ``(message, targets)`` delivery pairs for the tick's batched
-        deliver."""
+        deliver. ``skip_frames`` (tick-deadline degradation) applies
+        the writeback + churn but sheds the frame leg — counted, never
+        silent."""
         self._tick_inflight = False
         t0 = time.perf_counter()
         cap = result["cap"]
@@ -469,7 +544,13 @@ class EntityPlane:
         # 3. neighbor frames: one message per entity with >= 1 target,
         # fanned out to the owning peers of its k nearest co-cube
         # entities (the device already applied except-self per PEER)
-        pairs = self._build_frames(pos, targets, counts, cap)
+        if skip_frames:
+            pairs = []
+            self.frames_skipped += 1
+            if self.metrics is not None:
+                self.metrics.inc("sim.frames_skipped")
+        else:
+            pairs = self._build_frames(pos, targets, counts, cap)
 
         self.applied_ticks += 1
         self.frames += len(pairs)
@@ -615,6 +696,9 @@ class EntityPlane:
             "applied_ticks": self.applied_ticks,
             "dropped_ticks": self.dropped_ticks,
             "frames": self.frames,
+            "frames_skipped": self.frames_skipped,
+            "coalesced": self.coalesced,
+            "pending": len(self._pending),
             "index_moves": self.index_moves,
             "index_rows": len(self._sub_refs),
             "last_integrate_ms": round(self.last_integrate_ms, 3),

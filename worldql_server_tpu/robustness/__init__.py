@@ -8,11 +8,15 @@ Three pillars (README "Fault injection & supervision"):
   backoff within a budget, escalated to clean shutdown when critical;
 * :mod:`.resilient` — the spatial backend wrapper that contains device
   failures, rebuilds from the authoritative mirror, and fails over
-  TPU→CPU so fan-out degrades instead of flatlining.
+  TPU→CPU so fan-out degrades instead of flatlining;
+* :mod:`.overload` — the load-survival plane: hysteretic
+  ``OK → SHED_LOW → SHED_HIGH → REJECT`` admission governor,
+  priority-classed shedding, per-peer token buckets, and
+  tick-deadline degradation (README "Overload & admission control").
 
-``resilient`` imports lazily via ``__getattr__``: it pulls in the
-spatial package, which the failpoint call sites (wal, transports)
-must not.
+``resilient`` and ``overload`` import lazily via ``__getattr__``:
+they pull in the spatial/protocol packages, which the failpoint call
+sites (wal, transports) must not.
 """
 
 from . import failpoints
@@ -24,6 +28,7 @@ __all__ = [
     "SupervisedTask",
     "TaskPolicy",
     "ResilientBackend",
+    "OverloadGovernor",
 ]
 
 
@@ -32,4 +37,8 @@ def __getattr__(name):
         from .resilient import ResilientBackend
 
         return ResilientBackend
+    if name == "OverloadGovernor":
+        from .overload import OverloadGovernor
+
+        return OverloadGovernor
     raise AttributeError(name)

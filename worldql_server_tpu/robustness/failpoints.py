@@ -28,11 +28,15 @@ Design constraints, in order:
 Spec syntax (env ``WQL_FAILPOINTS``, CLI ``--failpoints``, or the
 optional HTTP admin endpoint)::
 
-    name=error[:P][:xN] | name=delay:DUR[:P][:xN]
+    name=error[:P][:xN] | name=delay:DUR[:P][:xN] | name=state:VALUE[:P][:xN]
 
 comma-separated; ``P`` is a fire probability in (0, 1] (default 1),
 ``xN`` caps total fires at N, ``DUR`` is ``50ms``/``0.5s``/bare
-milliseconds. Example::
+milliseconds. ``state`` is a VALUE-injection action: it never raises
+or sleeps — a subsystem that polls :func:`forced` reads the armed
+value (fires counted like any other point). The overload governor's
+``overload.force_state`` point uses it so chaos can drive every
+state-machine transition deterministically. Example::
 
     WQL_FAILPOINTS=store.insert=error:0.2,wal.fsync=delay:5ms,backend.collect=error:1:x3
 
@@ -79,7 +83,7 @@ def _parse_duration_s(raw: str) -> float:
 
 class _Point:
     __slots__ = ("name", "spec", "action", "delay_s", "prob", "max_fires",
-                 "hits", "fired")
+                 "hits", "fired", "value")
 
     def __init__(self, name: str, spec: str):
         self.name = name
@@ -91,6 +95,7 @@ class _Point:
         self.delay_s = 0.0
         self.prob = 1.0
         self.max_fires: int | None = None
+        self.value: str | None = None
         if self.action == "error":
             rest = parts[1:]
         elif self.action == "delay":
@@ -100,10 +105,17 @@ class _Point:
                 )
             self.delay_s = _parse_duration_s(parts[1])
             rest = parts[2:]
+        elif self.action == "state":
+            if len(parts) < 2 or not parts[1]:
+                raise FailpointSpecError(
+                    f"{name}: state needs a value (state:shed_high)"
+                )
+            self.value = parts[1]
+            rest = parts[2:]
         else:
             raise FailpointSpecError(
                 f"{name}: unknown action {self.action!r} "
-                "(expected error|delay)"
+                "(expected error|delay|state)"
             )
         for tok in rest:
             if tok.startswith("x"):
@@ -215,12 +227,15 @@ class FailpointRegistry:
     def fire(self, name: str) -> None:
         """Synchronous injection site. ``delay`` blocks the calling
         thread (worker-thread sites: WAL fsync); ``error`` raises
-        :class:`FailpointError`."""
+        :class:`FailpointError`; ``state`` is inert here (it only
+        feeds :meth:`forced_value` polls)."""
         point = self._points.get(name)
         if point is None or not self._should_fire(point):
             return
         if point.action == "delay":
             time.sleep(point.delay_s)
+            return
+        if point.action == "state":
             return
         raise FailpointError(name)
 
@@ -233,7 +248,21 @@ class FailpointRegistry:
         if point.action == "delay":
             await asyncio.sleep(point.delay_s)
             return
+        if point.action == "state":
+            return
         raise FailpointError(name)
+
+    def forced_value(self, name: str) -> str | None:
+        """Value-injection poll: the armed ``state:<value>`` payload,
+        or None (not armed / not a state point / prob-xN said no).
+        Every returned value counts as a fire, so forced transitions
+        stay visible in the failpoints audit gauge."""
+        point = self._points.get(name)
+        if point is None or point.action != "state":
+            return None
+        if not self._should_fire(point):
+            return None
+        return point.value
 
     # endregion
 
@@ -294,3 +323,11 @@ async def afire(name: str) -> None:
     """Hot-path async injection site (loop-side boundaries)."""
     if registry._points:
         await registry.afire(name)
+
+
+def forced(name: str) -> str | None:
+    """Hot-path value-injection poll; one dict-bool when nothing is
+    armed (the overload governor calls this every evaluation)."""
+    if registry._points:
+        return registry.forced_value(name)
+    return None

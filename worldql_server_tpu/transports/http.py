@@ -107,6 +107,17 @@ class HttpTransport:
             body["delivery"] = delivery
             if delivery["degraded"]:
                 body["status"] = "degraded"
+        # Overload governor (admission state + shed accounting): an
+        # orchestrator deciding whether to scale out needs the
+        # governor's state before anything else. SHED_HIGH/REJECT
+        # report degraded — the node is up but refusing work. Absent
+        # with --overload off (reference-shaped body).
+        ovl_fn = getattr(self.server, "overload_status", None)
+        overload = ovl_fn() if ovl_fn is not None else None
+        if overload is not None:
+            body["overload"] = overload
+            if overload["state_level"] >= 2:
+                body["status"] = "degraded"
         # Flight-recorder state (slow-tick count front and center): an
         # operator probing a limping node sees HOW MANY ticks blew the
         # threshold before scraping anything. Absent when tracing is
