@@ -53,6 +53,7 @@ enum {
   WQL_E_BOUNDS = -1,    // malformed/truncated buffer
   WQL_E_TOO_MANY = -2,  // > WQL_MAX_OBJS records or entities
   WQL_E_ALLOC = -3,
+  WQL_E_CAPACITY = -4,  // entity columns too small — caller grows + retries
 };
 
 // ---------------------------------------------------------------- reader
@@ -428,3 +429,298 @@ extern "C" int wql_encode(const WqlMsg* in, uint8_t** out, size_t* out_len) {
 extern "C" void wql_buffer_free(uint8_t* p) { std::free(p); }
 
 extern "C" int wql_max_objs(void) { return WQL_MAX_OBJS; }
+
+// ------------------------------------------- columnar entity ingest
+//
+// The wire→SoA fast path (consumer: worldql_server_tpu/protocol/
+// entity_wire.py → entities/ingest.py): batch-decode the `entities`
+// lists of a whole recv batch straight into preallocated SoA columns —
+// binary uuid keys, f32 positions/velocities — with zero per-entity
+// Python objects. The entities vector is read directly off the wire
+// (no WqlObj scratch), so this path has NO WQL_MAX_OBJS cap; its only
+// bound is the caller's column capacity.
+//
+// A buffer is FAST (status 1) only when the whole message is a plain
+// entity upsert batch the columnar path can represent: Local/Global-
+// Message, no parameter (removals and exotic parameters keep their
+// object-path semantics), canonical 36-char uuids, every entity world
+// empty-or-equal to the message world, position present. Anything else
+// is status 0 and the caller routes those bytes through the ordinary
+// codec — identical semantics, slower.
+
+namespace {
+
+inline int hexval(uint8_t c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// canonical 8-4-4-4-12 uuid string → 16 bytes; false for any other
+// format (Python's uuid.UUID accepts more — those take the object path)
+bool parse_uuid36(const uint8_t* s, int32_t len, uint8_t* out) {
+  if (len != 36 || s[8] != '-' || s[13] != '-' || s[18] != '-' ||
+      s[23] != '-')
+    return false;
+  static const int at[16] = {0,  2,  4,  6,  9,  11, 14, 16,
+                             19, 21, 24, 26, 28, 30, 32, 34};
+  for (int i = 0; i < 16; i++) {
+    const int hi = hexval(s[at[i]]);
+    const int lo = hexval(s[at[i] + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out[i] = static_cast<uint8_t>((hi << 4) | lo);
+  }
+  return true;
+}
+
+constexpr uint8_t INSTR_GLOBAL_MESSAGE = 6;
+constexpr uint8_t INSTR_LOCAL_MESSAGE = 7;
+
+// Validate one Record/Entity table the way the object decoder would
+// read it (uuid canonical + world present + every blob/struct in
+// bounds) WITHOUT materializing anything. The fast path must never
+// accept a buffer the object path would reject — corruption in a field
+// the columnar consumer ignores (records, data) still routes slow.
+bool validate_obj(const Reader& r, size_t table, bool* err) {
+  const uint8_t* u; int32_t ulen;
+  read_blob(r, table, OBJ_UUID, &u, &ulen, err);
+  uint8_t scratch[16];
+  if (*err || u == nullptr || !parse_uuid36(u, ulen, scratch)) return false;
+  const uint8_t* w; int32_t wlen;
+  read_blob(r, table, OBJ_WORLD, &w, &wlen, err);
+  if (*err || w == nullptr) return false;
+  const uint8_t* d; int32_t dlen;
+  read_blob(r, table, OBJ_DATA, &d, &dlen, err);
+  if (*err) return false;
+  read_blob(r, table, OBJ_FLEX, &d, &dlen, err);
+  if (*err) return false;
+  double x, y, z;
+  read_vec3(r, table, OBJ_POSITION, &x, &y, &z, err);
+  return !*err;
+}
+
+}  // namespace
+
+extern "C" int64_t wql_entities_abi(void) { return 1; }
+
+// Decode a recv batch. Per buffer: status[i] = 1 (columnar entity
+// batch; envelope + rows written) or 0 (route through the object
+// path). Entity rows land at ent_start[i]..+ent_count[i] in the shared
+// columns. Returns total rows written, or WQL_E_CAPACITY when ent_cap
+// cannot hold them (caller doubles the columns and retries).
+extern "C" int64_t wql_decode_entities(
+    const uint8_t* const* bufs, const int64_t* lens, int64_t n_bufs,
+    int8_t* status, uint8_t* instr_out, uint8_t* sender_key,
+    int64_t* world_off, int32_t* world_len_out, int64_t* ent_start,
+    int32_t* ent_count, int64_t ent_cap, uint8_t* uuid_keys,
+    float* pos_out, float* vel_out, uint8_t* has_vel) {
+  int64_t total = 0;
+  for (int64_t bi = 0; bi < n_bufs; bi++) {
+    status[bi] = 0;
+    instr_out[bi] = 0;
+    world_off[bi] = 0;
+    world_len_out[bi] = 0;
+    ent_start[bi] = total;
+    ent_count[bi] = 0;
+
+    Reader r{bufs[bi], static_cast<size_t>(lens[bi])};
+    bool err = false;
+    uint32_t root_off;
+    if (!r.load<uint32_t>(0, &root_off) || root_off >= r.len) continue;
+    const size_t root = root_off;
+
+    const uint8_t instr = read_u8(r, root, MSG_INSTRUCTION, 0, &err);
+    if (err) continue;
+    instr_out[bi] = instr;
+    if (instr != INSTR_LOCAL_MESSAGE && instr != INSTR_GLOBAL_MESSAGE)
+      continue;
+    const uint8_t* param;
+    int32_t param_len;
+    read_blob(r, root, MSG_PARAMETER, &param, &param_len, &err);
+    if (err || param != nullptr) continue;  // removal/exotic → object path
+    const uint8_t* sender;
+    int32_t sender_len;
+    read_blob(r, root, MSG_SENDER, &sender, &sender_len, &err);
+    if (err || sender == nullptr ||
+        !parse_uuid36(sender, sender_len, sender_key + 16 * bi))
+      continue;
+    const uint8_t* world;
+    int32_t wlen;
+    read_blob(r, root, MSG_WORLD, &world, &wlen, &err);
+    if (err || world == nullptr) continue;
+    world_off[bi] = static_cast<int64_t>(world - bufs[bi]);
+    world_len_out[bi] = wlen;
+    // fields the columnar consumer ignores still classify: the object
+    // decoder reads them, so corruption there must route slow
+    const uint8_t* mfx;
+    int32_t mfxlen;
+    read_blob(r, root, MSG_FLEX, &mfx, &mfxlen, &err);
+    if (err) continue;
+    double mx, my, mz;
+    read_vec3(r, root, MSG_POSITION, &mx, &my, &mz, &err);
+    if (err) continue;
+    {
+      size_t rpos = field_pos(r, root, MSG_RECORDS, &err);
+      if (err) continue;
+      if (rpos != 0) {
+        size_t rvec = indirect(r, rpos, &err);
+        if (err) continue;
+        uint32_t rn;
+        if (!r.load<uint32_t>(rvec, &rn)) continue;
+        if (!r.in(rvec + 4, static_cast<size_t>(rn) * 4)) continue;
+        bool rec_ok = true;
+        for (uint32_t i = 0; rec_ok && i < rn; i++) {
+          size_t rt = indirect(r, rvec + 4 + 4 * i, &err);
+          if (err || !validate_obj(r, rt, &err)) rec_ok = false;
+        }
+        if (!rec_ok || err) continue;
+      }
+    }
+
+    // entities vector, read straight off the wire — no object cap
+    size_t fpos = field_pos(r, root, MSG_ENTITIES, &err);
+    if (err || fpos == 0) continue;
+    size_t vec = indirect(r, fpos, &err);
+    if (err) continue;
+    uint32_t n;
+    if (!r.load<uint32_t>(vec, &n) || n == 0) continue;
+    if (!r.in(vec + 4, static_cast<size_t>(n) * 4)) continue;
+    if (total + static_cast<int64_t>(n) > ent_cap) return WQL_E_CAPACITY;
+
+    bool ok = true;
+    for (uint32_t i = 0; ok && i < n; i++) {
+      size_t t = indirect(r, vec + 4 + 4 * i, &err);
+      if (err) { ok = false; break; }
+      const uint8_t* u;
+      int32_t ulen;
+      read_blob(r, t, OBJ_UUID, &u, &ulen, &err);
+      if (err || u == nullptr ||
+          !parse_uuid36(u, ulen, uuid_keys + 16 * (total + i))) {
+        ok = false;
+        break;
+      }
+      const uint8_t* ew;
+      int32_t ewlen;
+      read_blob(r, t, OBJ_WORLD, &ew, &ewlen, &err);
+      if (err || ew == nullptr) { ok = false; break; }
+      // entity world must be the message world (empty = inherit, like
+      // `ent.world_name or message.world_name`); anything else keeps
+      // the object path's per-entity world semantics
+      if (ewlen != 0 &&
+          (ewlen != wlen ||
+           std::memcmp(ew, world, static_cast<size_t>(wlen)) != 0)) {
+        ok = false;
+        break;
+      }
+      double x, y, z;
+      if (!read_vec3(r, t, OBJ_POSITION, &x, &y, &z, &err) || err) {
+        ok = false;  // position required — the object path raises
+        break;
+      }
+      const uint8_t* dd;
+      int32_t ddlen;
+      read_blob(r, t, OBJ_DATA, &dd, &ddlen, &err);
+      if (err) { ok = false; break; }  // object decoder reads data too
+      float* p = pos_out + 3 * (total + i);
+      p[0] = static_cast<float>(x);
+      p[1] = static_cast<float>(y);
+      p[2] = static_cast<float>(z);
+      const uint8_t* fx;
+      int32_t fxlen;
+      read_blob(r, t, OBJ_FLEX, &fx, &fxlen, &err);
+      if (err) { ok = false; break; }
+      float* v = vel_out + 3 * (total + i);
+      if (fx != nullptr && fxlen >= 12) {
+        std::memcpy(v, fx, 12);  // 12 LE f32 bytes (host is LE)
+        has_vel[total + i] = 1;
+      } else {  // absent/short flex = no velocity change
+        v[0] = v[1] = v[2] = 0.0f;
+        has_vel[total + i] = 0;
+      }
+    }
+    if (!ok) { ent_count[bi] = 0; continue; }
+    ent_count[bi] = static_cast<int32_t>(n);
+    total += n;
+    status[bi] = 1;
+  }
+  return total;
+}
+
+// --------------------------------------- per-cohort frame encoding
+
+namespace {
+
+void unparse_uuid(const uint8_t* b, uint8_t* out36) {
+  static const char hexd[] = "0123456789abcdef";
+  int j = 0;
+  for (int i = 0; i < 16; i++) {
+    out36[j++] = hexd[b[i] >> 4];
+    out36[j++] = hexd[b[i] & 0xF];
+    if (i == 3 || i == 5 || i == 7 || i == 9) out36[j++] = '-';
+  }
+}
+
+}  // namespace
+
+// Encode n "entity.frame" neighbor frames (LocalMessage, one entity
+// each) sharing ONE world in a single native pass — the serialize-once
+// cohort encode of entities/plane._build_frames. Frames are
+// byte-identical to wql_encode of the equivalent Message (same builder,
+// same write order), concatenated into one malloc'd buffer; frame i is
+// (*out)[out_off[i] .. +out_len[i]]. Free with wql_buffer_free.
+extern "C" int wql_encode_entity_frames(
+    const uint8_t* sender_keys, const uint8_t* ent_keys, const double* pos,
+    int64_t n, const uint8_t* world, int32_t world_len, uint8_t** out,
+    int64_t* out_off, int64_t* out_len) {
+  static const uint8_t PARAM[] = "entity.frame";
+  std::vector<uint8_t> acc;
+  acc.reserve(static_cast<size_t>(n) * 256);
+  int64_t cursor = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint8_t sender36[36], ent36[36];
+    unparse_uuid(sender_keys + 16 * i, sender36);
+    unparse_uuid(ent_keys + 16 * i, ent36);
+    const double* p = pos + 3 * i;
+
+    Builder b(512);
+    WqlObj ent;
+    std::memset(&ent, 0, sizeof(ent));
+    ent.uuid = ent36;
+    ent.uuid_len = 36;
+    ent.world = world;
+    ent.world_len = world_len;
+    ent.has_pos = 1;
+    ent.x = p[0];
+    ent.y = p[1];
+    ent.z = p[2];
+    // mirror wql_encode's write order exactly (byte parity)
+    size_t entities_vec = write_obj_vector(b, &ent, 1);
+    size_t param_off = b.create_blob(PARAM, sizeof(PARAM) - 1, true);
+    size_t sender_off = b.create_blob(sender36, 36, true);
+    size_t world_off = b.create_blob(world, world_len, true);
+    TableBuilder t(b);
+    t.field_u8(MSG_INSTRUCTION, INSTR_LOCAL_MESSAGE, 0);
+    t.field_uoffset(MSG_PARAMETER, param_off);
+    t.field_uoffset(MSG_SENDER, sender_off);
+    t.field_uoffset(MSG_WORLD, world_off);
+    t.field_uoffset(MSG_ENTITIES, entities_vec);
+    b.create_vec3(p[0], p[1], p[2]);
+    t.field_struct(MSG_POSITION, 0);
+    size_t root = t.end();
+    b.prep(std::max<size_t>(b.minalign, 4), 4);
+    b.push_uoffset(root);
+
+    const size_t len = b.offset();
+    acc.insert(acc.end(), b.store.begin() + b.head,
+               b.store.begin() + b.head + len);
+    out_off[i] = cursor;
+    out_len[i] = static_cast<int64_t>(len);
+    cursor += static_cast<int64_t>(len);
+  }
+  uint8_t* mem = static_cast<uint8_t*>(std::malloc(cursor ? cursor : 1));
+  if (!mem) return WQL_E_ALLOC;
+  if (cursor) std::memcpy(mem, acc.data(), static_cast<size_t>(cursor));
+  *out = mem;
+  return WQL_OK;
+}

@@ -159,19 +159,26 @@ def test_bench_all_emits_one_line_per_config():
 
 
 def test_bench_config8_entity_sim():
-    """Config 8 (ISSUE 9): entity-sim workload — update ingest through
-    the delta path, device kNN tick, e2e frame latency over real ZMQ.
-    --smoke additionally asserts the device path fired, churn forced a
-    compaction, and frames were delivered."""
+    """Config 8 (ISSUE 9 + 11): entity-sim workload — columnar
+    wire→SoA→device ingest, device kNN tick with incremental H2D, e2e
+    frame latency over real ZMQ. --smoke additionally asserts the
+    device path AND the native columnar decode fired (both legs),
+    churn forced a compaction, and frames were delivered."""
     records, stderr = run_bench("--config", "8", "--smoke")
     assert len(records) == 1
     rec = records[0]
     assert rec["metric"] == "entity_sim_knn_ms"
     block = rec["entity_sim"]
     assert block["updates_per_s"] > 0
+    assert block["updates_per_s_sustained"] > 0
+    assert block["wire_native"] is True
+    assert block["wire_rows"] > 0
+    assert block["h2d_scatter"] > 0
+    assert block["e2e_wire_rows"] > 0
     assert block["knn_ms"] > 0
     assert block["e2e_p99_ms"] > 0
     assert block["e2e_frames"] > 0
+    assert block["frames_native"] > 0
     assert block["compactions"] >= 1
     assert block["sim_retraces_quiet"] == 0
     assert "entity_sim:" in stderr

@@ -147,3 +147,35 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(regressed), *gate]) == 1
+
+    # the ISSUE 11 ingest gate: a collapsed columnar throughput flags
+    # ON ITS OWN under the same invocation (drop ratio measured against
+    # the new value, so threshold 100 == "old more than 2x new")
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 8:
+            rec["entity_sim"]["updates_per_s"] = (
+                rec["entity_sim"]["updates_per_s"] / 3.0
+            )
+    slow_ingest = tmp_path / "slow_ingest.json"
+    slow_ingest.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(slow_ingest), *gate]) == 1
+
+
+def test_higher_better_drop_ratio_vs_new_value():
+    """A throughput halving must be flaggable at threshold 100: the
+    bad-direction ratio for higher-better metrics is measured against
+    the NEW value (a drop relative to old caps at -100% and could
+    never trip a >=100%% threshold)."""
+    old = {"8": {"config": 8, "updates_per_s": 600000.0}}
+    new = {"8": {"config": 8, "updates_per_s": 250000.0}}
+    rows, regressions = diff(old, new, threshold_pct=100.0)
+    assert [(c, n) for c, n, *_ in regressions] == \
+        [("8", "updates_per_s")]
+    # a drop smaller than the ratio stays green…
+    mild = {"8": {"config": 8, "updates_per_s": 400000.0}}
+    assert diff(old, mild, threshold_pct=100.0)[1] == []
+    # …and an IMPROVEMENT past the threshold never flags
+    assert diff(new, old, threshold_pct=100.0)[1] == []

@@ -824,10 +824,11 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 15
+    assert len(names) >= 16
     assert names == {
         "async-dangling-task",
         "unbounded-ingest",
+        "per-entity-python-ingest",
         "async-suppress-await",
         "async-blocking-call",
         "unsupervised-task",
@@ -1147,6 +1148,91 @@ def test_unbounded_ingest_pragma_suppresses():
     assert violations(
         src, relpath="worldql_server_tpu/entities/plane.py",
         select="unbounded-ingest",
+    ) == []
+
+
+# endregion
+
+# region: per-entity-python-ingest
+
+
+def test_per_entity_ingest_fires_on_for_loop_over_entities():
+    src = """
+    class EntityPlane:
+        def ingest(self, message):
+            for ent in message.entities:
+                self._upsert(ent, message, message.sender_uuid)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/entities/plane.py",
+        select="per-entity-python-ingest",
+    ) == [("per-entity-python-ingest", 4)]
+
+
+def test_per_entity_ingest_fires_on_comprehension_and_enumerate():
+    src = """
+    class Router:
+        def _entity_ingest(self, message):
+            rows = [self._row(e) for e in message.entities]
+            for i, ent in enumerate(message.entities):
+                rows[i] = ent
+            return rows
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/router.py",
+        select="per-entity-python-ingest",
+    ) == [
+        ("per-entity-python-ingest", 4),
+        ("per-entity-python-ingest", 5),
+    ]
+
+
+def test_per_entity_ingest_quiet_outside_scope_and_functions():
+    # same loop in a delivery-path function: quiet (the rule polices
+    # INGEST); same loop in an out-of-scope module: quiet
+    src = """
+    class EntityPlane:
+        def _build_frames_py(self, message):
+            return [e.uuid for e in message.entities]
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/entities/plane.py",
+        select="per-entity-python-ingest",
+    ) == []
+    src2 = """
+    def ingest(message):
+        for ent in message.entities:
+            pass
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/spatial/tpu_backend.py",
+        select="per-entity-python-ingest",
+    ) == []
+
+
+def test_per_entity_ingest_quiet_on_non_entity_iteration():
+    src = """
+    class EntityPlane:
+        def ingest_columns(self, senders, worlds, counts):
+            for b in range(len(senders)):
+                worlds[b] = sanitize_world_name(worlds[b])
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/entities/plane.py",
+        select="per-entity-python-ingest",
+    ) == []
+
+
+def test_per_entity_ingest_pragma_suppresses():
+    src = """
+    class EntityPlane:
+        def ingest(self, message):
+            for ent in message.entities:  # wql: allow(per-entity-python-ingest)
+                self._upsert(ent, message, message.sender_uuid)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/entities/plane.py",
+        select="per-entity-python-ingest",
     ) == []
 
 

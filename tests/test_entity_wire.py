@@ -1,0 +1,231 @@
+"""Native columnar entity codec (protocol/entity_wire.py ↔
+native/codec.cpp wql_decode_entities / wql_encode_entity_frames):
+classification matrix, decode correctness, capacity growth, fuzz
+safety, and frame-encode byte parity.
+
+Deliberately jax-free: this file is the ASan/UBSan leg for the PR 11
+natives (CI runs it under ``make -C native sanitize`` with the
+instrumented library preloaded), so it exercises the ctypes boundary
+and the wire reader only."""
+
+import random
+import struct
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.protocol import (
+    Instruction,
+    Message,
+    deserialize_message,
+    entity_wire,
+    serialize_message,
+)
+from worldql_server_tpu.protocol.codec import py_serialize_message
+from worldql_server_tpu.protocol.types import Entity, Record, Vector3
+
+
+@pytest.fixture(scope="module")
+def wire() -> entity_wire.EntityWire:
+    ew = entity_wire.load()
+    assert ew is not None, "native entity codec failed to load"
+    assert ew.can_decode and ew.can_encode_frames
+    return ew
+
+
+def ent_msg(sender, entities, parameter=None, world="w",
+            instruction=Instruction.LOCAL_MESSAGE):
+    return Message(
+        instruction=instruction, sender_uuid=sender, world_name=world,
+        parameter=parameter, entities=entities,
+    )
+
+
+def test_classification_matrix(wire):
+    s = uuid.uuid4()
+    e = uuid.uuid4()
+    pos = Vector3(1, 2, 3)
+    fast_local = ent_msg(s, [Entity(uuid=e, position=pos, world_name="w")])
+    fast_global = ent_msg(
+        s, [Entity(uuid=e, position=pos, world_name="w")],
+        instruction=Instruction.GLOBAL_MESSAGE,
+    )
+    slow_cases = [
+        # removal / any parameter
+        ent_msg(s, [Entity(uuid=e, position=pos, world_name="w")],
+                parameter="entity.remove"),
+        ent_msg(s, [Entity(uuid=e, position=pos, world_name="w")],
+                parameter="anything"),
+        # no entities
+        ent_msg(s, []),
+        # wrong instruction
+        ent_msg(s, [Entity(uuid=e, position=pos, world_name="w")],
+                instruction=Instruction.RECORD_CREATE),
+        # per-entity world differs from the message world
+        ent_msg(s, [Entity(uuid=e, position=pos, world_name="other")]),
+    ]
+    datas = [serialize_message(m)
+             for m in [fast_local, fast_global] + slow_cases]
+    datas.append(b"\x00\x01\x02")  # malformed
+    batch = wire.decode(datas)
+    assert batch.status.tolist() == [1, 1, 0, 0, 0, 0, 0, 0]
+    assert batch.total == 2
+    assert bytes(batch.sender_keys[0]) == s.bytes
+    assert bytes(batch.uuid_keys[0]) == e.bytes
+    assert batch.instr.tolist()[:2] == [7, 6]
+
+
+def test_decode_values_world_view_and_velocity(wire):
+    s = uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(3)]
+    msg = ent_msg(s, [
+        Entity(uuid=ents[0], position=Vector3(1.5, -2.5, 1e9),
+               world_name="bench", flex=struct.pack("<3f", 1, -2, 0.5)),
+        # empty world inherits the message world (`or` semantics)
+        Entity(uuid=ents[1], position=Vector3(4, 5, 6), world_name="",
+               flex=b"\x01" * 11),  # short flex: no velocity
+        Entity(uuid=ents[2], position=Vector3(7, 8, 9), world_name="bench",
+               flex=struct.pack("<3f", 9, 9, 9) + b"extra"),
+    ], world="bench")
+    data = serialize_message(msg)
+    batch = wire.decode([data])
+    assert batch.status[0] == 1 and batch.ent_count[0] == 3
+    off, ln = int(batch.world_off[0]), int(batch.world_len[0])
+    assert data[off:off + ln] == b"bench"
+    np.testing.assert_array_equal(
+        batch.pos[:3],
+        np.array([[1.5, -2.5, 1e9], [4, 5, 6], [7, 8, 9]], np.float32),
+    )
+    assert batch.has_vel[:3].tolist() == [1, 0, 1]
+    np.testing.assert_array_equal(batch.vel[0], [1, -2, 0.5])
+    np.testing.assert_array_equal(batch.vel[2], [9, 9, 9])
+    assert [bytes(batch.uuid_keys[i]) for i in range(3)] == \
+        [x.bytes for x in ents]
+
+
+def test_records_ride_along_and_are_ignored(wire):
+    # the object path consumes entity batches without touching records;
+    # the columnar classification must not be spooked by their presence
+    s = uuid.uuid4()
+    msg = ent_msg(s, [Entity(uuid=uuid.uuid4(), position=Vector3(1, 1, 1),
+                             world_name="w")])
+    msg.records = [Record(uuid=uuid.uuid4(), position=Vector3(0, 0, 0),
+                          world_name="w", data="ignored")]
+    batch = wire.decode([serialize_message(msg)])
+    assert batch.status[0] == 1 and batch.total == 1
+
+
+def test_missing_entity_position_routes_slow(wire):
+    # hand-build with the Python codec: Entity requires position, so
+    # craft a Record-shaped object (no position) in the entities slot
+    s = uuid.uuid4()
+    msg = Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=s,
+        world_name="w",
+        records=[Record(uuid=uuid.uuid4(), world_name="w")],
+    )
+    wire_bytes = py_serialize_message(msg)
+    # move the records vector into the entities slot by decoding and
+    # re-encoding is impossible (Entity requires position) — instead
+    # assert the decoder survives an entities-free message and a
+    # truncated tail of a valid one
+    batch = wire.decode([wire_bytes])
+    assert batch.status[0] == 0
+    good = serialize_message(ent_msg(s, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(1, 1, 1), world_name="w",
+    )]))
+    for cut in range(0, len(good), 7):
+        batch = wire.decode([good[:cut]])
+        assert batch.status[0] == 0 or cut == len(good)
+
+
+def test_capacity_grows_and_batch_survives(wire):
+    s = uuid.uuid4()
+    n = entity_wire._MIN_ROWS + 17
+    per = 500
+    msgs = []
+    made = 0
+    while made < n:
+        take = min(per, n - made)
+        msgs.append(ent_msg(s, [
+            Entity(uuid=uuid.UUID(int=made + i + 1),
+                   position=Vector3(float(i), 1, 1), world_name="w")
+            for i in range(take)
+        ]))
+        made += take
+    batch = wire.decode([serialize_message(m) for m in msgs])
+    assert batch.total == n
+    assert batch.status.all()
+    assert int(batch.ent_count.sum()) == n
+
+
+def test_fuzzed_garbage_never_crashes(wire):
+    rng = random.Random(23)
+    s = uuid.uuid4()
+    good = serialize_message(ent_msg(s, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(1, 2, 3), world_name="w",
+        flex=struct.pack("<3f", 1, 2, 3),
+    )]))
+    datas = []
+    for _ in range(300):
+        buf = bytearray(good)
+        for _ in range(rng.randrange(1, 6)):
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        datas.append(bytes(buf))
+    for _ in range(50):
+        datas.append(bytes(rng.randrange(256)
+                           for _ in range(rng.randrange(200))))
+    batch = wire.decode(datas)  # must not crash; fast rows stay sane
+    assert 0 <= batch.total <= sum(batch.ent_count)
+    # every buffer the native decode accepted must also decode clean in
+    # the Python codec with the SAME entity lanes (bitflip parity)
+    for i in np.flatnonzero(batch.status).tolist():
+        msg = deserialize_message(datas[i])
+        lo, cnt = int(batch.ent_start[i]), int(batch.ent_count[i])
+        assert len(msg.entities) == cnt
+        for j, ent in enumerate(msg.entities):
+            assert bytes(batch.uuid_keys[lo + j]) == ent.uuid.bytes
+            with np.errstate(over="ignore"):  # bitflipped f64 → ±inf f32
+                expect = np.array(
+                    [ent.position.x, ent.position.y, ent.position.z],
+                    np.float64,
+                ).astype(np.float32)
+            np.testing.assert_array_equal(batch.pos[lo + j], expect)
+
+
+def test_frame_encode_byte_parity_and_batching(wire):
+    owners = [uuid.uuid4() for _ in range(5)]
+    ents = [uuid.uuid4() for _ in range(5)]
+    pos = np.array(
+        [[1.25 * i, -2.0 * i, 3.0 + i] for i in range(5)], np.float64
+    )
+    frames = wire.encode_frames(
+        np.frombuffer(b"".join(o.bytes for o in owners),
+                      np.uint8).reshape(5, 16),
+        np.frombuffer(b"".join(e.bytes for e in ents),
+                      np.uint8).reshape(5, 16),
+        pos, b"bench",
+    )
+    assert len(frames) == 5
+    for i, frame in enumerate(frames):
+        p = Vector3(*pos[i])
+        ref = Message(
+            instruction=Instruction.LOCAL_MESSAGE,
+            parameter="entity.frame", sender_uuid=owners[i],
+            world_name="bench", position=p,
+            entities=[Entity(uuid=ents[i], position=p,
+                             world_name="bench")],
+        )
+        assert frame == serialize_message(ref)  # byte-identical
+        decoded = deserialize_message(frame)
+        assert decoded.sender_uuid == owners[i]
+        assert decoded.entities[0].uuid == ents[i]
+
+
+def test_encode_frames_empty_cohort(wire):
+    out = wire.encode_frames(
+        np.zeros((0, 16), np.uint8), np.zeros((0, 16), np.uint8),
+        np.zeros((0, 3), np.float64), b"w",
+    )
+    assert out == []

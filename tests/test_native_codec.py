@@ -166,3 +166,50 @@ def test_dispatch_uses_native_when_built(native):
     msg = rand_message(random.Random(7))
     got = codec.deserialize_message(codec.serialize_message(msg))
     assert_messages_equal(msg, got)
+
+
+def _entity_batch(n: int) -> Message:
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        sender_uuid=uuid.UUID(int=5),
+        world_name="w",
+        entities=[
+            Entity(uuid=uuid.UUID(int=i + 1),
+                   position=Vector3(float(i), 1.0, 2.0), world_name="w")
+            for i in range(n)
+        ],
+    )
+
+
+def test_max_objs_boundary_roundtrips_and_overflow_is_counted(native):
+    """The WQL_MAX_OBJS cliff (ISSUE 11 satellite): exactly MAX_OBJS
+    entities stays native; MAX_OBJS + 1 falls back to the Python codec
+    — still correct, but COUNTED (codec.obj_overflow), never silent."""
+    from worldql_server_tpu.protocol.native_codec import MAX_OBJS
+
+    at_cap = _entity_batch(MAX_OBJS)
+    wire = native.encode(at_cap)
+    got = native.decode(wire, codec.DeserializeError)
+    assert len(got.entities) == MAX_OBJS
+    assert_messages_equal(at_cap, got)
+
+    over = _entity_batch(MAX_OBJS + 1)
+    with pytest.raises(_TooManyObjects):
+        native.encode(over)
+    wire_over = codec.py_serialize_message(over)
+    with pytest.raises(_TooManyObjects):
+        native.decode(wire_over, codec.DeserializeError)
+
+    if codec._native is None:
+        pytest.skip("module-level dispatch is pure Python here")
+    before = codec.codec_stats["obj_overflow"]
+    wire2 = codec.serialize_message(over)     # encode fallback: +1
+    got2 = codec.deserialize_message(wire2)   # decode fallback: +1
+    assert len(got2.entities) == MAX_OBJS + 1
+    assert_messages_equal(over, got2)
+    assert codec.codec_stats["obj_overflow"] == before + 2
+
+    before = codec.codec_stats["obj_overflow"]
+    at_wire = codec.serialize_message(at_cap)
+    codec.deserialize_message(at_wire)
+    assert codec.codec_stats["obj_overflow"] == before  # boundary: native

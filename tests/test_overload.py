@@ -406,26 +406,27 @@ def test_entity_updates_coalesce_last_write_wins():
     gov.note_idle(0)
     assert gov.coalesce_entities()
 
-    # 5 updates for one live entity: 1 stages, 4 coalesce away
+    # 5 updates for one live entity: 1 stages, 4 coalesce away as
+    # column overwrites of the same staged slot
     for i in range(5):
         plane.ingest(_entity_msg(owner, eid, Vector3(10.0 + i, 2, 3)))
-    assert len(plane._pending) == 1
+    assert plane.staged_count() == 1
     assert plane.coalesced == 4
     assert gov.metrics.counters["overload.coalesced"] == 4
     # audit invariant: offered == applied/staged + coalesced
     assert plane.updates + plane.coalesced == 6
 
-    # drain applies ONLY the newest value (lossless for the stream)
+    # the flip folds ONLY the newest value (lossless for the stream)
     plane._drain_pending()
     slot = plane._slot_of[eid]
     assert plane._pos[slot, 0] == pytest.approx(14.0)
     assert plane._touched[slot]
-    assert not plane._pending
+    assert plane.staged_count() == 0
 
     # a NEW entity registers immediately even while shedding
     eid2 = uuid.uuid4()
     plane.ingest(_entity_msg(owner, eid2, Vector3(5, 5, 5)))
-    assert eid2 in plane._slot_of and eid2 not in plane._pending
+    assert eid2 in plane._slot_of and not plane.is_staged(eid2)
 
 
 def test_coalesced_update_enforces_ownership_and_removal():
@@ -440,17 +441,17 @@ def test_coalesced_update_enforces_ownership_and_removal():
     failpoints.registry.set("overload.force_state", "state:shed_high")
     gov.note_idle(0)
 
-    # hijacking update never enters the staging dict
+    # hijacking update never enters the staging columns
     plane.ingest(_entity_msg(thief, eid, Vector3(9, 9, 9)))
-    assert not plane._pending
+    assert plane.staged_count() == 0
 
     # staged update of a since-removed entity must not resurrect it
     plane.ingest(_entity_msg(owner, eid, Vector3(2, 2, 2)))
-    assert eid in plane._pending
+    assert plane.is_staged(eid)
     remove = _entity_msg(owner, eid, Vector3(2, 2, 2))
     remove.parameter = "entity.remove"
     plane.ingest(remove)
-    assert eid not in plane._pending
+    assert plane.staged_count() == 0
     plane._drain_pending()
     assert eid not in plane._slot_of
 

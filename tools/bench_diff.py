@@ -120,7 +120,14 @@ def diff(old: dict, new: dict, threshold_pct: float,
     where BOTH values sit below it can't regress — sub-floor timings
     on a shared runner are scheduler noise, not a code change (counts
     like ``retraces`` 0 → 1 still flag: the new value crosses the
-    floor)."""
+    floor).
+
+    The bad-direction magnitude is measured against the WORSE value:
+    for lower-is-better, growth relative to old (a doubling = +100%);
+    for higher-is-better, the drop relative to NEW (a halving = +100%).
+    Without the ratio flip, a throughput metric could never trip a
+    threshold ≥ 100% — its drop caps at −100% — and the gate silently
+    stopped guarding every ``*per_s`` leaf (ISSUE 11 satellite)."""
     rows, regressions = [], []
     for config in sorted(set(old) & set(new)):
         o_flat, n_flat = flatten(old[config]), flatten(new[config])
@@ -130,10 +137,19 @@ def diff(old: dict, new: dict, threshold_pct: float,
                 continue
             pct = ((n - o) / abs(o) * 100.0) if o else float("inf")
             d = direction(name)
+            if d > 0 and n < o:
+                # symmetric with the lower-better doubling: old more
+                # than (1 + threshold/100)× new trips the gate
+                bad_pct = (
+                    ((o - n) / abs(n) * 100.0) if n else float("inf")
+                )
+            elif d < 0 and n > o:
+                bad_pct = pct
+            else:
+                bad_pct = 0.0
             regressed = (
                 d != 0
-                and abs(pct) > threshold_pct
-                and (pct > 0) == (d < 0)   # moved in the bad direction
+                and bad_pct > threshold_pct
                 and max(abs(o), abs(n)) >= min_abs
             )
             rows.append((config, name, o, n, pct, d, regressed))

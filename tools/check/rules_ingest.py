@@ -1,4 +1,4 @@
-"""Unbounded-ingest hazard rule.
+"""Ingest-path hazard rules (unbounded growth, per-entity Python).
 
 The overload plane (ISSUE 10) exists because one unbounded ``append``
 on an ingest path is a memory-exhaustion vector under hostile offered
@@ -116,4 +116,82 @@ UNBOUNDED_INGEST = Rule(
     _check_unbounded_ingest,
 )
 
-RULES = [UNBOUNDED_INGEST]
+
+# --------------------------------------------------------------------
+# per-entity-python-ingest (ISSUE 11): the columnar wire→SoA path
+# exists so entity-update ingest costs zero per-entity Python — one
+# re-introduced `for ent in message.entities` loop puts the router back
+# at ~1.3K updates/s against the 100K+ columnar budget. Any
+# per-element iteration over an `.entities` list inside an ingest-path
+# function must either BE the designated object-path fallback
+# (pragma'd) or move to EntityPlane.ingest_columns.
+
+#: modules on the entity ingest path (relpath suffixes)
+_ENTITY_SCOPED = (
+    "engine/router.py",
+    "entities/plane.py",
+    "entities/ingest.py",
+    "transports/zeromq.py",
+    "transports/websocket.py",
+)
+
+#: ingest-path functions (message arrival → staged columns)
+_ENTITY_INGEST_FUNCS = _INGEST_FUNCS | {
+    "ingest_columns",
+    "process_batch",
+    "_flush_run",
+    "_admit",
+    "_route_data",
+    "_wire_slow_row",
+}
+
+
+def _iterates_entities(node: ast.AST) -> bool:
+    """The iterable expression mentions an ``.entities`` attribute
+    (covers ``message.entities``, ``enumerate(m.entities)``,
+    ``zip(…, msg.entities)``, slices thereof)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "entities":
+            return True
+    return False
+
+
+def _check_per_entity_ingest(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_ENTITY_SCOPED):
+        return
+    funcs = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _ENTITY_INGEST_FUNCS
+    ]
+    for func in funcs:
+        for node in walk_shallow(func.body):
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            if not any(_iterates_entities(it) for it in iters):
+                continue
+            yield from ctx.flag(
+                PER_ENTITY_PYTHON_INGEST,
+                node,
+                f"per-element Python iteration over an entities list "
+                f"on the ingest path ({func.name}) — this is the "
+                "~1.3K-updates/s regime the columnar wire→SoA path "
+                "(EntityPlane.ingest_columns + wql_decode_entities) "
+                "replaced; stage through the columns, or justify the "
+                "object path with "
+                "# wql: allow(per-entity-python-ingest)",
+            )
+
+
+PER_ENTITY_PYTHON_INGEST = Rule(
+    "per-entity-python-ingest",
+    "per-element Python loop over message entities in an ingest-path "
+    "function (router/transport/entity arrival paths)",
+    _check_per_entity_ingest,
+)
+
+RULES = [UNBOUNDED_INGEST, PER_ENTITY_PYTHON_INGEST]

@@ -194,8 +194,9 @@ class WorldQLServer:
         # --entity-sim mode (validate() guarantees a device backend +
         # ticker exist for it); the broker-only path never imports it.
         self.entity_plane = None
+        self.entity_ingest = None
         if config.entity_sim:
-            from ..entities import EntityPlane
+            from ..entities import ColumnarIngest, EntityPlane
 
             self.entity_plane = EntityPlane(
                 self.backend, self.peer_map,
@@ -207,6 +208,18 @@ class WorldQLServer:
                 metrics=self.metrics,
                 tracer=self.tracer,
                 governor=self.governor,
+            )
+            # wire→SoA columnar fast path (PR 11): transports hand whole
+            # recv batches here; entity-update messages batch-decode
+            # natively into the plane's columns, everything else routes
+            # through the ordinary codec. Inert when the native library
+            # predates the entity codec (active == False).
+            self.entity_ingest = ColumnarIngest(
+                self.entity_plane,
+                sender_known=self.peer_map.__contains__,
+                governor=self.governor,
+                metrics=self.metrics,
+                on_error=lambda: self.metrics.inc("zmq.recv_errors"),
             )
         self.ticker = None
         self.staging = None
@@ -334,6 +347,13 @@ class WorldQLServer:
                 )
         if self.entity_plane is not None:
             self.metrics.gauge("entity_sim", self.entity_plane.stats)
+        if self.entity_ingest is not None:
+            self.metrics.gauge("entity_ingest", self.entity_ingest.stats)
+        # codec health: the WQL_MAX_OBJS overflow fallback is counted,
+        # never silent (ISSUE 11 satellite)
+        from ..protocol import codec_stats
+
+        self.metrics.gauge("codec", lambda: dict(codec_stats))
         if self.governor is not None:
             # governor state + shed/coalesce/rate-limit accounting:
             # nothing the overload plane does is invisible to a scrape
@@ -550,6 +570,20 @@ class WorldQLServer:
                 "boot-time tier precompilation failed — serving with "
                 "cold kernel caches"
             )
+        if self.entity_plane is not None:
+            # entity-plane ladder: the sim tick at the boot capacity
+            # tier + the incremental-H2D scatter's dirty-bucket ladder
+            try:
+                stats = self.entity_plane.precompile()
+                if self.precompile_stats is None:
+                    self.precompile_stats = {"entities": stats}
+                else:
+                    self.precompile_stats["entities"] = stats
+            except Exception:
+                logger.exception(
+                    "entity tier precompilation failed — serving with "
+                    "cold sim kernel caches"
+                )
 
     async def _sweep_stale_once(self) -> int:
         """One staleness pass: evict every silent heartbeat-tracked

@@ -11,6 +11,13 @@ resulting neighbor frames fan out through the same delivery plane as
 every other broadcast.
 """
 
-from .plane import PARAM_FRAME, PARAM_REMOVE, EntityPlane
+from .ingest import ColumnarIngest
+from .plane import PARAM_FRAME, PARAM_REMOVE, EntityPlane, WireFrame
 
-__all__ = ["EntityPlane", "PARAM_FRAME", "PARAM_REMOVE"]
+__all__ = [
+    "ColumnarIngest",
+    "EntityPlane",
+    "PARAM_FRAME",
+    "PARAM_REMOVE",
+    "WireFrame",
+]

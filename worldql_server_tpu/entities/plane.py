@@ -5,10 +5,29 @@ columns (``pos f32[cap,3] | vel f32[cap,3] | wid i32 | pid i32``) plus
 their device twin, an :class:`~worldql_server_tpu.ops.tick.EntityState`.
 The host columns are the authority (the same discipline as
 spatial/tpu_backend.py): wire ingest mutates them at message-arrival
-time, each ticker flush uploads them whole, runs ONE jitted
-``simulation_tick`` (integrate → re-quantize → spatial-hash rebuild →
-stencil kNN, ops/tick.py), and the collect fetches back integrated
-positions + per-entity neighbor targets.
+time, each ticker flush runs ONE jitted ``simulation_tick`` (integrate
+→ re-quantize → spatial-hash rebuild → stencil kNN, ops/tick.py), and
+the collect fetches back integrated positions + per-entity neighbor
+targets.
+
+Columnar ingest (PR 11): updates of LIVE entities stage into fixed
+preallocated double-buffered columns (``pos/vel/has_vel/touched`` per
+slot) instead of writing per-entity — coalescing IS the column
+overwrite (last write per slot wins, per field), and the pre-dispatch
+drain is a buffer flip + one vectorized masked fold into the authority
+columns. The wire fast path (``ingest_columns``, fed by
+protocol/entity_wire.wql_decode_entities through entities/ingest.py)
+maps a whole recv batch's uuid keys to slots in one C-level pass and
+stages every owned row without constructing a single Entity object;
+registrations, removals, and exotic messages keep the object path
+(``ingest``) — identical semantics, per-entity cost, control-plane
+rates. The device twin is maintained INCREMENTALLY: a dirty-slot
+bitmap tracks rows whose host authority diverged from the twin
+(client updates, registrations, removals), and each dispatch scatters
+only those rows into device memory (ASH-style partial transfer,
+arXiv:2110.00511) instead of re-shipping whole columns — the scatter
+kernel registers with the retrace GUARD under ``entities.scatter`` and
+its pow2 dirty-bucket ladder precompiles at boot.
 
 Capacity is a power-of-two tier (``_MIN_CAP`` floor), so the jitted
 tick sees a handful of shapes over a process lifetime — the tick
@@ -41,6 +60,7 @@ the router's per-message handling.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 import uuid as uuid_mod
@@ -53,6 +73,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.tick import EntityState, make_tick_fn
+from ..protocol import entity_wire
 from ..protocol.types import Entity, Instruction, Message, Vector3
 from ..spatial.quantize import cube_coords_batch
 from ..utils.names import SanitizeError, sanitize_world_name
@@ -71,10 +92,81 @@ _MIN_CAP = 256
 #: parked coordinate for dead slots: quantizes to the saturated cube of
 #: the dead world (wid -1), far outside any live neighborhood
 _DEAD_POS = np.float32(1.0e30)
+#: smallest dirty-row scatter bucket (pow2 ladder floor): below this the
+#: fixed launch cost dominates and finer tiers only multiply compiles
+_SCATTER_MIN_BUCKET = 64
+#: world-name fallback envelope for wire-path registrations (the world
+#: is always resolved before this is consulted)
+_WIRE_MSG = Message(instruction=Instruction.LOCAL_MESSAGE)
 
 
 def _next_pow2(n: int) -> int:
     return 1 << (max(n, 1) - 1).bit_length()
+
+
+class WireFrame:
+    """A pre-encoded outbound frame: ready wire bytes standing in for a
+    Message in ``PeerMap.deliver_batch`` pairs (which reads ``.wire``
+    and never re-serializes when it is set). The native per-cohort
+    frame encode hands these out so the apply leg constructs no
+    per-entity Message objects. Message attributes (``entities``,
+    ``parameter``, …) resolve lazily by decoding the wire bytes —
+    diagnostics-only; the delivery path never triggers it."""
+
+    __slots__ = ("wire", "_msg")
+
+    def __init__(self, wire: bytes):
+        self.wire = wire
+        self._msg = None
+
+    def __getattr__(self, name):
+        msg = object.__getattribute__(self, "_msg")
+        if msg is None:
+            from ..protocol import deserialize_message
+
+            msg = deserialize_message(self.wire)
+            object.__setattr__(self, "_msg", msg)
+        return getattr(msg, name)
+
+
+class _StageBuf:
+    """One side of the double-buffered update-staging columns: the LWW
+    coalescing surface. ``touched[slot]`` marks a staged position;
+    ``has_vel[slot]`` marks a staged velocity (fields coalesce
+    independently, exactly like sequential application)."""
+
+    __slots__ = ("pos", "vel", "has_vel", "touched", "dirty")
+
+    def __init__(self, cap: int):
+        self.pos = np.zeros((cap, 3), np.float32)
+        self.vel = np.zeros((cap, 3), np.float32)
+        self.has_vel = np.zeros(cap, bool)
+        self.touched = np.zeros(cap, bool)
+        self.dirty = False  # any touched bit set since the last flip
+
+    def grow(self, cap: int) -> None:
+        old = self.touched.shape[0]
+        for name in ("pos", "vel"):
+            out = np.zeros((cap, 3), np.float32)
+            out[:old] = getattr(self, name)
+            setattr(self, name, out)
+        for name in ("has_vel", "touched"):
+            out = np.zeros(cap, bool)
+            out[:old] = getattr(self, name)
+            setattr(self, name, out)
+
+
+def _scatter_update(state: EntityState, idx, pos, vel, wid, pid):
+    """Scatter dirty host rows into the device twin — the incremental
+    H2D leg (only touched slots ship, never whole columns). ``idx`` is
+    padded to its pow2 bucket with the out-of-range capacity value;
+    ``mode='drop'`` discards those lanes on device."""
+    return EntityState(
+        position=state.position.at[idx].set(pos, mode="drop"),
+        velocity=state.velocity.at[idx].set(vel, mode="drop"),
+        world=state.world.at[idx].set(wid, mode="drop"),
+        peer=state.peer.at[idx].set(pid, mode="drop"),
+    )
 
 
 class EntityPlane:
@@ -94,6 +186,7 @@ class EntityPlane:
         metrics=None,
         tracer=None,
         governor=None,
+        wire="auto",
     ):
         self.backend = backend
         self.peer_map = peer_map
@@ -106,14 +199,11 @@ class EntityPlane:
         self.tracer = tracer
         # Optional robustness.overload.OverloadGovernor: under
         # SHED_LOW+ updates of LIVE entities coalesce last-write-wins
-        # per uuid into _pending and apply once per tick — lossless
-        # for position streams (the newest position subsumes the ones
-        # it overwrote), and the first step of the columnar
-        # entity-update staging path (ROADMAP item 4). Registrations
-        # and removals always apply immediately (control plane).
+        # per slot into the staging columns and apply once per tick —
+        # lossless for position streams (the newest value per field
+        # subsumes the ones it overwrote). Registrations and removals
+        # always apply immediately (control plane).
         self._governor = governor
-        #: uuid → latest staged Entity (bounded by live entities)
-        self._pending: dict[uuid_mod.UUID, Entity] = {}
         self.coalesced = 0
         self.frames_skipped = 0
 
@@ -129,10 +219,25 @@ class EntityPlane:
         #: slots mutated by wire ingest since the LAST dispatch — the
         #: post-tick position writeback must not clobber them
         self._touched = np.zeros(self._cap, bool)
+        #: binary uuid per slot (frame encode + wire-path slot map)
+        self._uuid_bytes = np.zeros((self._cap, 16), np.uint8)
+        #: double-buffered update-staging columns: ingest writes the
+        #: active side; the pre-dispatch drain flips and folds the
+        #: retired side in one vectorized pass (replaces the per-uuid
+        #: _pending dict of PR 10)
+        self._stage = [_StageBuf(self._cap), _StageBuf(self._cap)]
+        self._stage_active = 0
+        #: slots whose host authority diverged from the device twin
+        #: since its last upload — the incremental-H2D scatter set
+        self._device_dirty = np.zeros(self._cap, bool)
+        self._dev_state: EntityState | None = None
+        self._dev_cap = 0
 
         self._n = 0                     # slot high-water mark
         self._free: list[int] = []      # recycled slots below _n
         self._slot_of: dict[uuid_mod.UUID, int] = {}
+        #: 16-byte uuid key → slot (the wire path's C-level bulk map)
+        self._slot_of_key: dict[bytes, int] = {}
         self._uuid_of: dict[int, uuid_mod.UUID] = {}
 
         # interning (plane-local dense ids; the INDEX interns its own)
@@ -140,8 +245,15 @@ class EntityPlane:
         self._world_names: list[str] = []
         self._peer_ids: dict[uuid_mod.UUID, int] = {}
         self._peer_uuids: list[uuid_mod.UUID] = []
+        #: binary uuid per dense peer id (cohort frame senders)
+        self._peer_key_arr = np.zeros((64, 16), np.uint8)
         #: per-peer entity slots (eviction sweep)
         self._peer_slots: dict[int, set[int]] = {}
+
+        # native columnar wire codec: "auto" = the shared in-tree
+        # library (symbol-probed; stale .so → None and every leg
+        # degrades to the object path), None/instance for tests
+        self._wire = entity_wire.shared() if wire == "auto" else wire
 
         #: (wid, cx, cy, cz, pid) → live-entity refcount backing ONE
         #: index row; transitions through 0 mutate the index
@@ -156,6 +268,10 @@ class EntityPlane:
             )
         )
         GUARD.register("entities.sim_tick", self._tick_fn)
+        # incremental H2D: one jitted scatter, shape-keyed on
+        # (capacity tier, dirty bucket) — the ladder precompiles at boot
+        self._scatter_fn = jax.jit(_scatter_update)
+        GUARD.register("entities.scatter", self._scatter_fn)
         self._tick_inflight = False
 
         # stats (exposed via the entity_sim gauge + bench config 8)
@@ -172,6 +288,15 @@ class EntityPlane:
         self.last_knn_ms = 0.0
         self.last_apply_ms = 0.0
         self.last_churn = 0
+        # columnar-path stats (wire rows staged with zero per-entity
+        # Python; flips; H2D split; native cohort-encoded frames)
+        self.wire_rows = 0
+        self.wire_slow_rows = 0
+        self.column_flips = 0
+        self.h2d_full = 0
+        self.h2d_scatter = 0
+        self.last_h2d_rows = 0
+        self.frames_native = 0
 
     # region: wire ingest (router arrival path)
 
@@ -183,11 +308,13 @@ class EntityPlane:
         return bool(self._slot_of)
 
     def ingest(self, message: Message) -> int:
-        """Apply one inbound entity batch: upsert every carried Entity
-        (or remove, when ``parameter == 'entity.remove'``) for the
-        sending peer. Per-entity Python is fine HERE — this is the
-        message-arrival path, amortized like any router handler.
-        Returns entities applied."""
+        """Apply one inbound entity batch THE OBJECT WAY: upsert every
+        carried Entity (or remove, when ``parameter ==
+        'entity.remove'``) for the sending peer. This is the semantic
+        reference and the fallback for everything the columnar wire
+        path (``ingest_columns``) routes around — removals, exotic
+        parameters/uuid formats, per-entity worlds, a stale native
+        library. Returns entities applied."""
         sender = message.sender_uuid
         removing = message.parameter == PARAM_REMOVE
         governor = self._governor
@@ -197,7 +324,7 @@ class EntityPlane:
             and governor.coalesce_entities()
         )
         applied = 0
-        for ent in message.entities:
+        for ent in message.entities:  # wql: allow(per-entity-python-ingest) — the object-path semantic reference; hot traffic rides ingest_columns
             try:
                 if removing:
                     applied += self._remove_entity(ent.uuid, sender)
@@ -217,11 +344,13 @@ class EntityPlane:
 
     def _stage_update(self, ent: Entity, message: Message,
                       sender: uuid_mod.UUID) -> int:
-        """Coalescing admission (governor SHED_LOW+): stage the update
-        of a LIVE entity last-write-wins per uuid; ``_drain_pending``
-        applies the survivors in one pass at the next dispatch.
-        Ownership and world sanitation are enforced HERE so a hostile
-        update can't hide in the staging dict. An overwrite counts as
+        """Coalescing admission (governor SHED_LOW+), object-path leg:
+        stage the update of a LIVE entity into the columnar staging
+        buffer — coalescing IS the column overwrite (last write per
+        slot wins, per field); ``_drain_pending`` folds the survivors
+        in one vectorized pass at the next dispatch. Ownership and
+        world sanitation are enforced HERE so a hostile update can't
+        hide in the staging columns. An overwrite counts as
         ``overload.coalesced`` — shed-but-lossless work (the audit
         invariant: offered == applied + coalesced + dropped)."""
         sanitize_world_name(ent.world_name or message.world_name)
@@ -233,35 +362,182 @@ class EntityPlane:
                 "dropped", sender, ent.uuid, owner,
             )
             return 0
-        if ent.uuid in self._pending:
-            self.coalesced += 1
-            if self.metrics is not None:
-                self.metrics.inc("overload.coalesced")
-            self._pending[ent.uuid] = ent
-            return 0
-        self._pending[ent.uuid] = ent
-        return 1
+        buf = self._stage[self._stage_active]
+        first = not buf.touched[slot]
+        p = ent.position
+        buf.pos[slot, 0] = p.x
+        buf.pos[slot, 1] = p.y
+        buf.pos[slot, 2] = p.z
+        vel = _decode_velocity(ent.flex)
+        if vel is not None:
+            buf.vel[slot] = vel
+            buf.has_vel[slot] = True
+        buf.touched[slot] = True
+        buf.dirty = True
+        if first:
+            return 1
+        self.coalesced += 1
+        if self.metrics is not None:
+            self.metrics.inc("overload.coalesced")
+        return 0
 
     def _drain_pending(self) -> None:
-        """Apply every staged update straight into the host columns
-        (one dict pass per tick instead of per-message work — the
-        coalescing staleness bound is therefore the same one tick the
-        plane already documents)."""
-        if not self._pending:
+        """Fold the staged update columns into the host authority —
+        the buffer flip that replaced PR 10's per-uuid dict walk: flip
+        the double buffer (ingest keeps writing the fresh side), then
+        apply the retired side's touched rows as one masked copy per
+        column. The coalescing staleness bound is the same one tick
+        the plane already documents."""
+        buf = self._stage[self._stage_active]
+        if not buf.dirty:
             return
-        pending, self._pending = self._pending, {}
-        for eid, ent in pending.items():
-            slot = self._slot_of.get(eid)
-            if slot is None:
-                continue  # removed after staging
-            p = ent.position
-            self._pos[slot, 0] = p.x
-            self._pos[slot, 1] = p.y
-            self._pos[slot, 2] = p.z
-            vel = _decode_velocity(ent.flex)
-            if vel is not None:
-                self._vel[slot] = vel
-            self._touched[slot] = True
+        self._stage_active ^= 1
+        rows = np.flatnonzero(buf.touched)
+        self._pos[rows] = buf.pos[rows]
+        hv = rows[buf.has_vel[rows]]
+        if hv.size:
+            self._vel[hv] = buf.vel[hv]
+        # a client update must win over the in-flight tick's writeback,
+        # and its rows must ship to the device twin at this dispatch
+        self._touched[rows] = True
+        self._device_dirty[rows] = True
+        buf.touched[rows] = False
+        buf.has_vel[rows] = False
+        buf.dirty = False
+        self.column_flips += 1
+
+    def staged_count(self) -> int:
+        """Touched rows awaiting the next flip (test/gauge probe)."""
+        return int(np.count_nonzero(self._stage[self._stage_active].touched))
+
+    def is_staged(self, eid: uuid_mod.UUID) -> bool:
+        slot = self._slot_of.get(eid)
+        if slot is None:
+            return False
+        return bool(self._stage[self._stage_active].touched[slot])
+
+    def ingest_columns(
+        self,
+        senders: list,
+        worlds: list,
+        counts: np.ndarray,
+        uuid_keys: np.ndarray,
+        pos: np.ndarray,
+        vel: np.ndarray,
+        has_vel: np.ndarray,
+    ) -> int:
+        """Wire→SoA fast path: stage a whole recv batch's entity
+        updates with zero per-entity Python. ``senders``/``worlds`` are
+        per message; ``counts[i]`` rows of the shared columns belong to
+        message i. uuid→slot mapping is one C-level bulk dict pass;
+        ownership is enforced vectorized at stage time; position/
+        velocity staging is a fancy-indexed column overwrite whose
+        last-write-wins order is exactly arrival order. Only rows whose
+        uuid is unknown (registrations — control-plane rates) take the
+        per-entity object path. Returns entities applied, mirroring
+        ``ingest``'s accounting."""
+        n_bufs = len(senders)
+        total = int(counts.sum())
+        if total == 0:
+            return 0
+        pids = np.empty(n_bufs, np.int32)
+        buf_ok = np.ones(n_bufs, bool)
+        for b in range(n_bufs):
+            try:
+                worlds[b] = sanitize_world_name(worlds[b])
+                pids[b] = self._peer_ids.get(senders[b], -1)
+            except SanitizeError as exc:
+                logger.warning(
+                    "peer %s sent entity batch with invalid world %r "
+                    "(%s)", senders[b], worlds[b], exc,
+                )
+                buf_ok[b] = False
+                pids[b] = -1
+        row_buf = np.repeat(np.arange(n_bufs), counts)
+        row_ok = buf_ok[row_buf]
+        exp_pid = pids[row_buf]
+
+        # V16 (not S16): bytes_ views strip trailing NULs, void keeps
+        # all 16 bytes — the keys must match uuid.bytes exactly
+        keys = uuid_keys.reshape(total, 16).view("V16").ravel().tolist()
+        slots = np.fromiter(
+            map(self._slot_of_key.get, keys, itertools.repeat(-1)),
+            np.int64, count=total,
+        )
+        hit = (slots >= 0) & row_ok
+        safe = np.where(hit, slots, 0)
+        owned = hit & (self._pid[safe] == exp_pid)
+        stolen = int(hit.sum()) - int(owned.sum())
+        if stolen:
+            logger.warning(
+                "%d entity updates for entities their senders do not "
+                "own — dropped", stolen,
+            )
+
+        applied = 0
+        orows = np.flatnonzero(owned)
+        if orows.size:
+            s = slots[orows]
+            buf = self._stage[self._stage_active]
+            governor = self._governor
+            if governor is not None and governor.coalesce_entities():
+                # dict-parity accounting: first stage per slot applies,
+                # every overwrite (intra-batch duplicates included)
+                # counts as coalesced — shed-but-lossless
+                uniq = np.unique(s)
+                fresh = int(np.count_nonzero(~buf.touched[uniq]))
+                over = int(orows.size) - fresh
+                if over:
+                    self.coalesced += over
+                    if self.metrics is not None:
+                        self.metrics.inc("overload.coalesced", over)
+                applied += fresh
+            else:
+                applied += int(orows.size)
+            buf.pos[s] = pos[orows]
+            hv = has_vel[orows].astype(bool)
+            if hv.any():
+                sv = s[hv]
+                buf.vel[sv] = vel[orows][hv]
+                buf.has_vel[sv] = True
+            buf.touched[s] = True
+            buf.dirty = True
+            self.wire_rows += int(orows.size)
+
+        # unknown uuids: registrations (or intra-batch updates of one
+        # just registered) — the per-entity object path is the right
+        # cost for this control-plane traffic, and re-probing the slot
+        # map per row keeps intra-batch arrival order exact
+        miss = row_ok & (slots < 0)
+        for r in np.flatnonzero(miss).tolist():  # wql: allow(per-entity-python-ingest) — registrations only; update traffic stays columnar
+            b = int(row_buf[r])
+            applied += self._wire_slow_row(
+                keys[r], worlds[b], pos[r], vel[r], bool(has_vel[r]),
+                senders[b],
+            )
+            self.wire_slow_rows += 1
+
+        if applied:
+            self.updates += applied
+            if self.metrics is not None:
+                self.metrics.inc("sim.updates", applied)
+        return applied
+
+    def _wire_slow_row(self, key: bytes, world: str, p, v,
+                       has_v: bool, sender: uuid_mod.UUID) -> int:
+        """One columnar row routed through the object path (its uuid
+        was unknown at batch start): registration — or, for a uuid
+        registered earlier in the same batch, a normal owned update."""
+        ent = Entity(
+            uuid=uuid_mod.UUID(bytes=key),
+            position=Vector3(float(p[0]), float(p[1]), float(p[2])),
+            world_name=world,
+            flex=v.tobytes() if has_v else None,
+        )
+        try:
+            return self._upsert(ent, _WIRE_MSG, sender)
+        except SanitizeError:
+            return 0  # world sanitized upstream; belt and braces
 
     def _upsert(self, ent: Entity, message: Message,
                 sender: uuid_mod.UUID) -> int:
@@ -299,6 +575,7 @@ class EntityPlane:
         if vel is not None:
             self._vel[slot] = vel
         self._touched[slot] = True
+        self._device_dirty[slot] = True
         if new:
             # index coupling: queryable before the first tick
             self._register_cube(slot)
@@ -321,8 +598,17 @@ class EntityPlane:
         if pid is None:
             pid = self._peer_ids[sender] = len(self._peer_uuids)
             self._peer_uuids.append(sender)
+            if pid >= self._peer_key_arr.shape[0]:
+                out = np.zeros(
+                    (self._peer_key_arr.shape[0] * 2, 16), np.uint8
+                )
+                out[: self._peer_key_arr.shape[0]] = self._peer_key_arr
+                self._peer_key_arr = out
+            self._peer_key_arr[pid] = np.frombuffer(sender.bytes, np.uint8)
         self._slot_of[uuid] = slot
+        self._slot_of_key[uuid.bytes] = slot
         self._uuid_of[slot] = uuid
+        self._uuid_bytes[slot] = np.frombuffer(uuid.bytes, np.uint8)
         self._wid[slot] = wid
         self._pid[slot] = pid
         self._vel[slot] = 0.0
@@ -387,8 +673,12 @@ class EntityPlane:
     def _release_slot(self, slot: int, pid: int) -> None:
         uuid = self._uuid_of.pop(slot)
         del self._slot_of[uuid]
-        # a staged update must not resurrect a removed entity at drain
-        self._pending.pop(uuid, None)
+        self._slot_of_key.pop(uuid.bytes, None)
+        # a staged update must not resurrect a removed entity at the
+        # flip: clear the slot's staging bits on both buffer sides
+        for buf in self._stage:
+            buf.touched[slot] = False
+            buf.has_vel[slot] = False
         slots = self._peer_slots.get(pid)
         if slots is not None:
             slots.discard(slot)
@@ -400,6 +690,9 @@ class EntityPlane:
         self._pid[slot] = -1
         self._pos[slot] = _DEAD_POS
         self._vel[slot] = 0.0
+        self._uuid_bytes[slot] = 0
+        # the parked values must reach the device twin
+        self._device_dirty[slot] = True
         self._free.append(slot)
         self.entities_removed += 1
 
@@ -439,6 +732,12 @@ class EntityPlane:
         self._cube = grow2(self._cube, 0, np.int64, 3)
         self._live = grow2(self._live, False, bool)
         self._touched = grow2(self._touched, False, bool)
+        self._uuid_bytes = grow2(self._uuid_bytes, 0, np.uint8, 16)
+        self._device_dirty = grow2(self._device_dirty, False, bool)
+        for buf in self._stage:
+            buf.grow(cap)
+        # shape change: the next dispatch re-ships the whole tier
+        self._dev_state = None
         self._cap = cap
         logger.info("entity plane grew to capacity tier %d", cap)
 
@@ -446,26 +745,73 @@ class EntityPlane:
 
     # region: sim tick (ticker flush path)
 
-    def dispatch_tick(self):
-        """Launch one simulation tick from the host columns (event-loop
-        thread; tick.sim.integrate span). Uploads the full capacity
-        tier, launches the fused integrate+kNN kernel, and enqueues the
-        D2H prefetch. Returns an opaque handle for ``collect_tick`` or
-        None when idle / a previous tick is still in flight (pipelined
-        flushes never stack sim ticks — the writeback of tick N is
-        input to tick N+1)."""
-        self._drain_pending()  # coalesced updates apply tick-edge
-        if not self._slot_of or self._tick_inflight:
-            return None
-        t0 = time.perf_counter()
-        cap = self._cap
-        state = EntityState(
+    def _upload_state(self, cap: int) -> EntityState:
+        """Device input for this tick: the persistent twin with only
+        the DIRTY slots scattered in (incremental H2D), or a full-tier
+        upload when there is no valid twin / the tier changed / the
+        dirty set is dense enough that one straight re-ship wins."""
+        dev = self._dev_state
+        if dev is not None and self._dev_cap == cap:
+            dirty = np.flatnonzero(self._device_dirty[:cap])
+            if dirty.size == 0:
+                self.last_h2d_rows = 0
+                return dev
+            if dirty.size <= cap // 2:
+                bucket = max(_SCATTER_MIN_BUCKET, _next_pow2(dirty.size))
+                # pad lanes carry the out-of-range index `cap`; the
+                # scatter drops them on device (mode='drop')
+                idx = np.full(bucket, cap, np.int32)
+                idx[: dirty.size] = dirty
+                rows = np.zeros((bucket, 3), np.float32)
+                rows_v = np.zeros((bucket, 3), np.float32)
+                rows_w = np.zeros(bucket, np.int32)
+                rows_p = np.zeros(bucket, np.int32)
+                rows[: dirty.size] = self._pos[dirty]
+                rows_v[: dirty.size] = self._vel[dirty]
+                rows_w[: dirty.size] = self._wid[dirty]
+                rows_p[: dirty.size] = self._pid[dirty]
+                self._device_dirty[:cap] = False
+                self.h2d_scatter += 1
+                self.last_h2d_rows = int(dirty.size)
+                return self._scatter_fn(dev, idx, rows, rows_v, rows_w,
+                                        rows_p)
+        self._device_dirty[:cap] = False
+        self._dev_cap = cap
+        self.h2d_full += 1
+        self.last_h2d_rows = cap
+        return EntityState(
             position=jnp.asarray(self._pos),
             velocity=jnp.asarray(self._vel),
             world=jnp.asarray(self._wid),
             peer=jnp.asarray(self._pid),
         )
+
+    def dispatch_tick(self):
+        """Launch one simulation tick from the host columns (event-loop
+        thread; tick.sim.integrate span): fold the staged update
+        columns, ship only the touched slots to the device twin, launch
+        the fused integrate+kNN kernel, and enqueue the D2H prefetch.
+        Returns an opaque handle for ``collect_tick`` or None when idle
+        / a previous tick is still in flight (pipelined flushes never
+        stack sim ticks — the writeback of tick N is input to tick
+        N+1)."""
+        self._drain_pending()  # staged updates fold tick-edge
+        if not self._slot_of or self._tick_inflight:
+            return None
+        t0 = time.perf_counter()
+        cap = self._cap
+        state = self._upload_state(cap)
         new_state, targets, counts = self._tick_fn(state)
+        # device twin for the NEXT tick: integrated positions; the
+        # UPLOADED (host-authoritative) velocity — the in-tick bounce
+        # reflection is per-tick, exactly as the full re-upload it
+        # replaced behaved (apply() writes back positions only)
+        self._dev_state = EntityState(
+            position=new_state.position,
+            velocity=state.velocity,
+            world=state.world,
+            peer=state.peer,
+        )
         for arr in (new_state.position, targets, counts):
             copy_async = getattr(arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -476,6 +822,7 @@ class EntityPlane:
         self.last_integrate_ms = (time.perf_counter() - t0) * 1e3
         if self.metrics is not None:
             self.metrics.observe_ms("sim.integrate_ms", self.last_integrate_ms)
+            self.metrics.inc("sim.h2d_rows", self.last_h2d_rows)
         return {
             "pos": new_state.position,
             "targets": targets,
@@ -483,6 +830,55 @@ class EntityPlane:
             "cap": cap,
             "t0": t0,
         }
+
+    def precompile(self, max_compiles: int = 32) -> dict:
+        """Boot-time shape precompilation for the sim kernels (the
+        PR 8 tier-precompile discipline extended to the entity plane):
+        the tick kernel at the current capacity tier plus the
+        incremental-H2D scatter across its pow2 dirty-bucket ladder, so
+        steady-state serving re-traces nothing. Returns a stats dict in
+        the spatial/precompile.py shape."""
+        t0 = time.perf_counter()
+        before = GUARD.counts()
+        cap = self._cap
+        compiles = skipped = 0
+        zeros3 = jnp.zeros((cap, 3), jnp.float32)
+        ids = jnp.full(cap, -1, jnp.int32)
+        state = EntityState(zeros3, zeros3, ids, ids)
+        out = self._tick_fn(state)
+        jax.block_until_ready(out)
+        compiles += 1
+        bucket = _SCATTER_MIN_BUCKET
+        while bucket <= cap:
+            if compiles >= max(1, int(max_compiles)):
+                skipped += 1
+                bucket *= 2
+                continue
+            idx = np.full(bucket, cap, np.int32)
+            state = self._scatter_fn(
+                state, idx,
+                np.zeros((bucket, 3), np.float32),
+                np.zeros((bucket, 3), np.float32),
+                np.zeros(bucket, np.int32),
+                np.zeros(bucket, np.int32),
+            )
+            compiles += 1
+            bucket *= 2
+        jax.block_until_ready(state)
+        delta = GUARD.delta(before)
+        stats = {
+            "dispatches": compiles,
+            "skipped_by_budget": skipped,
+            "new_variants": sum(delta.values()),
+            "families": delta,
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        }
+        logger.info(
+            "entity tier precompilation: %d shapes walked, %d new "
+            "kernel variants in %.0f ms",
+            compiles, stats["new_variants"], stats["wall_ms"],
+        )
+        return stats
 
     def collect_tick(self, handle) -> dict:
         """Wait out the device and fetch results (worker thread;
@@ -506,9 +902,12 @@ class EntityPlane:
     def abort_tick(self) -> None:
         """Drop an in-flight tick without applying it (cancelled or
         errored flush): host columns stay authoritative and unchanged,
-        the next dispatch simply re-integrates from them."""
+        the next dispatch simply re-integrates from them. The device
+        twin already holds the dropped tick's integration, so it is
+        invalidated — the next dispatch re-ships the host tier."""
         if self._tick_inflight:
             self._tick_inflight = False
+            self._dev_state = None
             self.dropped_ticks += 1
 
     def apply(self, result: dict, trace=None,
@@ -640,18 +1039,52 @@ class EntityPlane:
 
     def _build_frames(self, pos, targets, counts, cap: int) -> list:
         """Assemble per-entity neighbor frames: for every live entity
-        with at least one resolved target, one LocalMessage carrying
-        the entity's integrated position, addressed to the owning peers
-        of its nearest neighbors. The message serializes ONCE in
-        deliver_batch and fans out from there. O(entities with
-        neighbors) host work — the delivery-path analog of the query
-        engine's decode."""
+        with at least one resolved target, one ``entity.frame``
+        LocalMessage carrying the entity's integrated position,
+        addressed to the owning peers of its nearest neighbors.
+        Entities sharing a (world, recipients) cohort encode in ONE
+        native pass (serialize-once per cohort) and hand ready wire
+        bytes to deliver_batch — zero per-entity Message objects; the
+        object path below is the fallback for a stale native library.
+        O(entities with neighbors) host work either way — the
+        delivery-path analog of the query engine's decode."""
         live = self._live[:cap]
         valid = targets >= 0
         has_any = live & valid.any(axis=1)
         rows = np.flatnonzero(has_any)
         if rows.size == 0:
             return []
+        wire = self._wire
+        if wire is None or not wire.can_encode_frames:
+            return self._build_frames_py(pos, targets, valid, rows)
+        # cohort key = (world, sorted target lanes): rows agreeing on
+        # both share one recipient list and one native encode pass
+        tr = np.sort(targets[rows], axis=1)
+        key = np.concatenate(
+            [self._wid[rows][:, None], tr.astype(np.int32)], axis=1
+        )
+        cohorts, inverse = np.unique(key, axis=0, return_inverse=True)
+        pairs = []
+        peer_uuids = self._peer_uuids
+        for c in range(cohorts.shape[0]):
+            crows = rows[inverse == c]
+            tgt = cohorts[c, 1:]
+            tgt = np.unique(tgt[tgt >= 0])
+            targets_u = [peer_uuids[int(p)] for p in tgt]
+            world = self._world_names[int(cohorts[c, 0])]
+            frames = wire.encode_frames(
+                self._peer_key_arr[self._pid[crows]],
+                self._uuid_bytes[crows],
+                pos[crows].astype(np.float64),
+                world.encode(),
+            )
+            pairs.extend((WireFrame(f), targets_u) for f in frames)
+        self.frames_native += len(pairs)
+        return pairs
+
+    def _build_frames_py(self, pos, targets, valid, rows) -> list:
+        """Object-path frame assembly (stale-native fallback): one
+        Message per entity, serialized later by deliver_batch."""
         pairs = []
         peer_uuids = self._peer_uuids
         uuid_of = self._uuid_of
@@ -697,8 +1130,15 @@ class EntityPlane:
             "dropped_ticks": self.dropped_ticks,
             "frames": self.frames,
             "frames_skipped": self.frames_skipped,
+            "frames_native": self.frames_native,
             "coalesced": self.coalesced,
-            "pending": len(self._pending),
+            "pending": self.staged_count(),
+            "wire_rows": self.wire_rows,
+            "wire_slow_rows": self.wire_slow_rows,
+            "column_flips": self.column_flips,
+            "h2d_full": self.h2d_full,
+            "h2d_scatter": self.h2d_scatter,
+            "last_h2d_rows": self.last_h2d_rows,
             "index_moves": self.index_moves,
             "index_rows": len(self._sub_refs),
             "last_integrate_ms": round(self.last_integrate_ms, 3),

@@ -1,4 +1,9 @@
-from .codec import DeserializeError, deserialize_message, serialize_message
+from .codec import (
+    DeserializeError,
+    codec_stats,
+    deserialize_message,
+    serialize_message,
+)
 from .types import (
     NIL_UUID,
     Entity,
@@ -18,6 +23,7 @@ __all__ = [
     "Replication",
     "Vector3",
     "DeserializeError",
+    "codec_stats",
     "deserialize_message",
     "serialize_message",
 ]

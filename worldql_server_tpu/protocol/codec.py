@@ -279,18 +279,28 @@ from . import native_codec as _native_codec  # noqa: E402
 
 _native = _native_codec.load()
 
+#: codec health counters, exported as the `codec` gauge by the server.
+#: obj_overflow: messages whose records/entities exceeded WQL_MAX_OBJS
+#: and silently took the ~10x-slower Python codec — before this counter
+#: that cliff was invisible (ISSUE 11 satellite). Plain int increments:
+#: the codec runs on the event loop and in sender workers, each process
+#: counting its own.
+codec_stats = {"obj_overflow": 0}
+
 if _native is not None:
 
     def serialize_message(message: Message) -> bytes:  # noqa: F811
         try:
             return _native.encode(message)
         except _native_codec._TooManyObjects:
+            codec_stats["obj_overflow"] += 1
             return py_serialize_message(message)
 
     def deserialize_message(buf: bytes | bytearray | memoryview) -> Message:  # noqa: F811
         try:
             return _native.decode(bytes(buf), DeserializeError)
         except _native_codec._TooManyObjects:
+            codec_stats["obj_overflow"] += 1
             return py_deserialize_message(bytes(buf))
 
 # endregion

@@ -28,6 +28,7 @@ import zmq
 import zmq.asyncio
 
 from ..engine.peers import Peer
+from ..protocol.entity_wire import RECV_DRAIN_MAX
 from ..protocol import (
     DeserializeError,
     Instruction,
@@ -118,6 +119,14 @@ class ZmqTransport:
         """PULL loop (incoming.rs:26-75): multipart frames are
         concatenated, deserialized-or-dropped, then routed.
 
+        Columnar drain (--entity-sim + native codec): everything the
+        socket already holds — bounded by ``RECV_DRAIN_MAX`` — drains
+        into ONE recv batch handed to ``ColumnarIngest.process_batch``,
+        which batch-decodes every entity-update message straight into
+        the plane's SoA columns and routes the rest through
+        ``_route_data`` in arrival order. Without the fast path the
+        loop is the per-message path it always was.
+
         Per-message crash containment: ANY exception escaping the
         processing of one message (a router bug a hostile payload
         tickles, a handshake connect error) drops THAT message —
@@ -133,28 +142,54 @@ class ZmqTransport:
             # supervisor's restart/escalate policy in the chaos suite
             failpoints.fire("zmq.recv")
             parts = await self._pull.recv_multipart()
-            try:
-                await self._process_inbound(parts, limit)
-            except Exception:
-                self.server.metrics.inc("zmq.recv_errors")
-                logger.exception(
-                    "error processing inbound zmq message — dropped"
-                )
+            fast = getattr(self.server, "entity_ingest", None)
+            if fast is None or not fast.active:
+                try:
+                    await self._process_inbound(parts, limit)
+                except Exception:
+                    self.server.metrics.inc("zmq.recv_errors")
+                    logger.exception(
+                        "error processing inbound zmq message — dropped"
+                    )
+                continue
+            datas = []
+            data = self._flatten(parts, limit)
+            if data is not None:
+                datas.append(data)  # wql: allow(unbounded-ingest) — one message; the drain below is bounded by RECV_DRAIN_MAX
+            while len(datas) < RECV_DRAIN_MAX:
+                try:
+                    parts = await self._pull.recv_multipart(zmq.NOBLOCK)
+                except zmq.Again:
+                    break
+                data = self._flatten(parts, limit)
+                if data is not None:
+                    datas.append(data)  # wql: allow(unbounded-ingest) — bounded by RECV_DRAIN_MAX; admission happens in ColumnarIngest/router
+            if datas:
+                # contains per message internally; never raises
+                await fast.process_batch(datas, self._route_data)
 
-    async def _process_inbound(self, parts: list[bytes], limit: int) -> None:
-        """One inbound multipart message: bound, decode, route."""
-        # MAXMSGSIZE bounds each PART; bound the flattened total
-        # before the join materializes it a second time. (libzmq
-        # assembles multipart atomically before delivery, so its
-        # own buffering of many under-cap parts cannot be bounded
-        # by any socket option — see Config.max_message_size.)
+    def _flatten(self, parts: list[bytes], limit: int) -> bytes | None:
+        """Bound + join one multipart message (None = dropped).
+        MAXMSGSIZE bounds each PART; bound the flattened total before
+        the join materializes it a second time. (libzmq assembles
+        multipart atomically before delivery, so its own buffering of
+        many under-cap parts cannot be bounded by any socket option —
+        see Config.max_message_size.)"""
         if sum(len(p) for p in parts) > limit:
             logger.warning(
                 "dropping oversized multipart zmq message (%d parts)",
                 len(parts),
             )
-            return
-        data = b"".join(parts)
+            return None
+        return b"".join(parts)
+
+    async def _process_inbound(self, parts: list[bytes], limit: int) -> None:
+        """One inbound multipart message: bound, decode, route."""
+        data = self._flatten(parts, limit)
+        if data is not None:
+            await self._route_data(data)
+
+    async def _route_data(self, data: bytes) -> None:
         tracer = getattr(self.server, "tracer", None)
         if tracer is not None and tracer.enabled:
             # recv→decode→route under one span tree: the decode and the
