@@ -1061,6 +1061,14 @@ def bench_config5(args) -> dict:
         "value": round(engine_tick_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_p99 / engine_tick_ms, 2),
+        # honest-baseline calibration (ROADMAP 5a): vs_baseline grades
+        # us against our OWN Python oracle; vs_reference grades the
+        # same shapes against a native micro-port of the reference
+        # implementation's AreaMap lookup (single thread, lookup only
+        # — a floor for the reference's per-query cost, deliberately
+        # generous to it). Absent when the native library predates the
+        # probe symbol.
+        "vs_reference": _vs_reference(args, engine_tick_ms),
         "engine_p99_ms": round(engine_p99_ms, 3),
         "sustained_e2e_tick_ms": round(sustained, 3),
         "p50_ms_depth1": round(pctl(lat1, 50), 3),
@@ -1127,6 +1135,42 @@ def bench_config5(args) -> dict:
         ),
         "target_p99_ms": TARGET_P99_MS,
         "config": 5,
+    }
+
+
+def _vs_reference(args, engine_tick_ms: float) -> dict | None:
+    """The ``vs_reference`` calibration row: the native AreaMap probe
+    (spatial.cpp::wql_areamap_probe — a micro-port of the reference
+    Rust server's cube→peers HashMap hot path) timed at THIS run's
+    sub/query shapes on THIS machine, next to the engine's measured
+    per-query cost. The ratio is engine queries/s over reference-probe
+    lookups/s; the note spells out the asymmetry so nobody reads a
+    lookup-only floor as an end-to-end comparison."""
+    from worldql_server_tpu.spatial.native_keys import areamap_probe
+
+    probe = areamap_probe(args.subs, args.queries, cube_size=16, seed=11)
+    if probe is None:
+        return None
+    lookup_ns = probe["lookup_ns_per_query"]
+    ref_qps = 1e9 / lookup_ns if lookup_ns > 0 else None
+    engine_qps = (
+        args.queries / (engine_tick_ms / 1e3) if engine_tick_ms > 0 else None
+    )
+    ratio = (
+        round(engine_qps / ref_qps, 3)
+        if engine_qps and ref_qps else None
+    )
+    return {
+        "probe": "areamap_native",
+        **probe,
+        "ref_lookups_per_s": round(ref_qps) if ref_qps else None,
+        "engine_queries_per_s": round(engine_qps) if engine_qps else None,
+        "engine_per_ref_ratio": ratio,
+        "note": (
+            "reference probe is the AreaMap lookup alone — no fan-out "
+            "assembly, no serialization, no transport; a calibration "
+            "floor for the reference's cost, not an e2e comparison"
+        ),
     }
 
 
@@ -3031,13 +3075,80 @@ def bench_config9(args) -> dict:
     return result
 
 
+def bench_config10(args) -> dict:
+    """Adversarial scenario suite (ISSUE 12, ROADMAP 5b): run the
+    first-class scenario library — flash-crowd migration, battle-royale
+    shrinking bounds, hostile-swarm reconnect storm, mixed game-tick —
+    each a REAL server over real ZMQ with declared survival + SLO
+    checks, and emit the suite as one bench record. ``--smoke`` asserts
+    every check green (the CI gate); the perf gate then diffs the
+    stable leaves (check_failures, lost_subscriptions/entities,
+    resumed counts) against the baseline, so one newly failing
+    scenario assertion — or one lost resumed row — fails the build."""
+    from worldql_server_tpu.scenarios import run_scenario
+
+    shape = "smoke" if args.quick else "full"
+    names = ["flash_crowd", "battle_royale", "reconnect_storm", "game_tick"]
+    reports = {}
+    check_failures = 0
+    for name in names:
+        log(f"scenario {name} ({shape})...")
+        report = run_scenario(name, shape=shape)
+        reports[name] = report
+        check_failures += report["checks_failed"]
+        log(
+            f"scenario {name}: "
+            f"{'PASS' if report['checks_failed'] == 0 else 'FAIL'} "
+            f"in {report['wall_s']}s "
+            f"({report['checks_failed']} failed checks)"
+        )
+
+    if args.smoke:
+        for name, report in reports.items():
+            failed = [c["name"] for c in report["checks"] if not c["ok"]]
+            assert not failed, (
+                f"smoke: scenario {name} failed checks: {failed}"
+            )
+        log("smoke: all scenario survival + SLO checks green")
+
+    storm = reports["reconnect_storm"]["slo"]
+    return {
+        "metric": "scenario_check_failures",
+        "value": check_failures,
+        "unit": "count",
+        # the tentpole guarantee as first-class gated leaves: resumed
+        # sessions lose NOTHING ("lost"-named → lower-is-better gated)
+        "lost_subscriptions": max(
+            0,
+            storm.get("subscriptions_before", 0)
+            - storm.get("subscriptions_after", 0),
+        ),
+        "lost_entities": (
+            storm.get("entities_before", 0)
+            - storm.get("entities_after", 0)
+        ),
+        "sessions_resumed": storm.get("resumed", 0),
+        "resume_p99_ms": storm.get("resume_p99_ms"),
+        "scenarios": {
+            name: {
+                "survived": report["survived"],
+                "check_failures": report["checks_failed"],
+                "wall_s": report["wall_s"],
+                "slo": report["slo"],
+            }
+            for name, report in reports.items()
+        },
+        "config": 10,
+    }
+
+
 # --------------------------------------------------------------------
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -3046,7 +3157,10 @@ def main() -> None:
                          "path, device kNN tick, e2e frame latency); "
                          "9 = overload-storm admission (admitted vs "
                          "offered at 2x/10x, shed fractions, record "
-                         "p99 under storm)")
+                         "p99 under storm); 10 = adversarial scenario "
+                         "suite (flash crowd, battle royale, "
+                         "reconnect storm, game tick — survival + SLO "
+                         "checks over real ZMQ)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -3085,13 +3199,14 @@ def main() -> None:
         1: bench_config1, 2: bench_config2, 3: bench_config3,
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
+        10: bench_config10,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10]
     else:
         selected = [args.config or 5]
     for n in selected:

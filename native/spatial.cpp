@@ -25,8 +25,12 @@
 //     parity with the numpy twin is pinned lane-for-lane by
 //     tests/test_native_keys.py, padding included.
 
+#include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <random>
+#include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -136,6 +140,90 @@ void wql_encode_queries(const double* pos, const int32_t* world_ids,
     senders_out[i] = -1;
     repls_out[i] = 0;
   }
+}
+
+// ------------------------------------------------------------------
+// wql_areamap_probe: a reference-class CPU hot path (ROADMAP 5a).
+//
+// Micro-port of the reference implementation's AreaMap lookup (the
+// Rust server's HashMap<cube, Vec<peer>> per world,
+// worldql_server/src/subscriptions/area_map.rs): build a hash map of
+// n_subs subscriptions keyed by quantized cube, then resolve
+// n_queries point lookups against it. The timing this returns is the
+// calibration row `vs_reference` in the bench JSON — what a
+// reference-shaped single-threaded native CPU path achieves on THIS
+// machine at the same shapes — so `vs_baseline` (measured against our
+// own Python oracle) stops grading our own homework. Lookup only: no
+// fan-out assembly, no serialization, no transport — i.e. a FLOOR for
+// the reference's per-query cost, deliberately generous to it.
+//
+//   out[0] = build wall in ms
+//   out[1] = lookup wall in ns per query
+//   out[2] = total peer rows matched (also defeats dead-code elim)
+//
+// Uses coord_clamp — the golden quantizer both engines share — so
+// probe and engine resolve identical cube geometry.
+
+int64_t wql_areamap_probe(int64_t n_subs, int64_t n_queries,
+                          int64_t cube_size, uint64_t seed, double* out) {
+  if (n_subs <= 0 || n_queries <= 0 || cube_size <= 0) return -1;
+  using clk = std::chrono::steady_clock;
+
+  struct KeyHash {
+    size_t operator()(uint64_t k) const {
+      return static_cast<size_t>(mix(k));
+    }
+  };
+  // cube triple -> one u64 key via the same splitmix chain the engine
+  // hashes with (h1 fixed): collision-free enough for a probe and
+  // cheaper than a 3-int struct key — again generous to the reference
+  const uint64_t h1 = mix(seed + GOLDEN);
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<double> span(-4000.0, 4000.0);
+
+  std::unordered_map<uint64_t, std::vector<int32_t>, KeyHash> areamap;
+  areamap.reserve(static_cast<size_t>(n_subs));
+
+  const auto t0 = clk::now();
+  for (int64_t i = 0; i < n_subs; ++i) {
+    const uint64_t cx =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t cy =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t cz =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t key =
+        static_cast<uint64_t>(chain(h1, 0, cx, cy, cz));
+    areamap[key].push_back(static_cast<int32_t>(i & 0x3FF));
+  }
+  const auto t1 = clk::now();
+
+  int64_t matched = 0;
+  for (int64_t q = 0; q < n_queries; ++q) {
+    const uint64_t cx =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t cy =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t cz =
+        static_cast<uint64_t>(coord_clamp(span(rng), cube_size));
+    const uint64_t key =
+        static_cast<uint64_t>(chain(h1, 0, cx, cy, cz));
+    const auto it = areamap.find(key);
+    if (it != areamap.end()) {
+      matched += static_cast<int64_t>(it->second.size());
+    }
+  }
+  const auto t2 = clk::now();
+
+  const double build_ms =
+      std::chrono::duration<double, std::milli>(t1 - t0).count();
+  const double lookup_ns =
+      std::chrono::duration<double, std::nano>(t2 - t1).count() /
+      static_cast<double>(n_queries);
+  out[0] = build_ms;
+  out[1] = lookup_ns;
+  out[2] = static_cast<double>(matched);
+  return 0;
 }
 
 }  // extern "C"

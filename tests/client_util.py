@@ -33,11 +33,15 @@ def free_port() -> int:
 
 
 class WsClient:
-    """WebSocket client: server assigns our UUID (websocket.rs:51-87)."""
+    """WebSocket client: server assigns our UUID (websocket.rs:51-87).
+    With sessions enabled the assigning handshake carries a resume
+    token as ``flex`` (kept on ``self.token``)."""
 
-    def __init__(self, connection, uuid: uuid_mod.UUID):
+    def __init__(self, connection, uuid: uuid_mod.UUID,
+                 token: str | None = None):
         self.connection = connection
         self.uuid = uuid
+        self.token = token
 
     @classmethod
     async def connect(cls, port: int, host: str = "127.0.0.1") -> "WsClient":
@@ -47,9 +51,43 @@ class WsClient:
         handshake = deserialize_message(await connection.recv())
         assert handshake.instruction == Instruction.HANDSHAKE
         assigned = uuid_mod.UUID(handshake.parameter)
-        client = cls(connection, assigned)
+        token = (
+            bytes(handshake.flex).decode("ascii")
+            if handshake.flex else None
+        )
+        client = cls(connection, assigned, token)
         await client.send(Message(instruction=Instruction.HANDSHAKE))
         return client
+
+    @classmethod
+    async def resume(
+        cls, port: int, token: str, uuid: uuid_mod.UUID,
+        host: str = "127.0.0.1",
+    ) -> "WsClient":
+        """Reconnect presenting a session token: the echo carries it
+        as ``flex`` and the server rebinds this connection to the
+        parked peer ``uuid`` — subsequent frames sign as it."""
+        if ws_connect is None:
+            raise RuntimeError("websockets is not installed")
+        connection = await ws_connect(f"ws://{host}:{port}")
+        handshake = deserialize_message(await connection.recv())
+        assert handshake.instruction == Instruction.HANDSHAKE
+        assigned = uuid_mod.UUID(handshake.parameter)
+        client = cls(connection, assigned, token)
+        await client.send(Message(
+            instruction=Instruction.HANDSHAKE, flex=token.encode(),
+        ))
+        client.uuid = uuid
+        return client
+
+    async def drop(self) -> None:
+        """Hard drop: kill the TCP socket without a close frame — the
+        network-blip shape session continuity exists for."""
+        transport = getattr(self.connection, "transport", None)
+        if transport is not None:
+            transport.abort()
+        else:  # older websockets: best effort
+            await self.connection.close()
 
     async def send(self, message: Message) -> None:
         message.sender_uuid = self.uuid
@@ -76,19 +114,28 @@ class WsClient:
 
 class ZmqClient:
     """ZeroMQ client: we pick our UUID and hand the server a
-    connect-back address (incoming.rs:52-72, outgoing.rs:81-130)."""
+    connect-back address (incoming.rs:52-72, outgoing.rs:81-130).
+    With sessions enabled the handshake echo's parameter carries a
+    resume token (kept on ``self.token``); a refused handshake echoes
+    ``retry-after:<ms>`` instead (``self.retry_after_ms``)."""
 
-    def __init__(self, ctx, push, pull, uuid: uuid_mod.UUID):
+    def __init__(self, ctx, push, pull, uuid: uuid_mod.UUID,
+                 token: str | None = None):
         self.ctx = ctx
         self.push = push  # client → server PULL
         self.pull = pull  # server PUSH → client
         self.uuid = uuid
+        self.token = token
+        self.retry_after_ms: int | None = None
 
     @classmethod
     async def connect(
         cls, server_port: int, host: str = "127.0.0.1",
         peer_uuid: uuid_mod.UUID | None = None,
+        token: str | None = None,
     ) -> "ZmqClient":
+        """Handshake (optionally presenting ``token`` to resume a
+        parked session under ``peer_uuid``)."""
         ctx = zmq.asyncio.Context()
         pull = ctx.socket(zmq.PULL)
         client_port = pull.bind_to_random_port(f"tcp://{host}")
@@ -101,11 +148,28 @@ class ZmqClient:
             Message(
                 instruction=Instruction.HANDSHAKE,
                 parameter=f"{host}:{client_port}",
+                flex=token.encode() if token is not None else None,
             )
         )
         echo = await client.recv()
         assert echo.instruction == Instruction.HANDSHAKE
+        if echo.parameter is not None:
+            if echo.parameter.startswith("retry-after:"):
+                client.retry_after_ms = int(
+                    echo.parameter.split(":", 1)[1]
+                )
+            else:
+                client.token = echo.parameter
         return client
+
+    @classmethod
+    async def resume(
+        cls, server_port: int, token: str, peer_uuid: uuid_mod.UUID,
+        host: str = "127.0.0.1",
+    ) -> "ZmqClient":
+        return await cls.connect(
+            server_port, host, peer_uuid=peer_uuid, token=token,
+        )
 
     async def send(self, message: Message) -> None:
         message.sender_uuid = self.uuid

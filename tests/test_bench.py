@@ -147,15 +147,15 @@ def test_bench_smoke_forces_compacted_collect():
 
 
 def test_bench_all_emits_one_line_per_config():
-    """--all: eight configs, eight JSON lines, in config order
+    """--all: nine configs, nine JSON lines, in config order
     (config 7 re-execs with a forced device topology and runs
     standalone)."""
     records, _ = run_bench(
         "--all", "--quick", "--subs", "4000", "--queries", "256",
         "--ticks", "6", "--cpu-ticks", "2",
     )
-    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6, 8, 9]
-    assert len({rec["metric"] for rec in records}) == 8
+    assert [rec["config"] for rec in records] == [1, 2, 3, 4, 5, 6, 8, 9, 10]
+    assert len({rec["metric"] for rec in records}) == 9
 
 
 def test_bench_config8_entity_sim():

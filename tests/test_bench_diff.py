@@ -118,18 +118,33 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
     assert main([str(baseline), str(baseline), *gate]) == 0
 
-    # JSON-lines baseline: one record per smoke config (5 + 8 + 9)
+    # JSON-lines baseline: one record per smoke config (5+8+9+10)
     records = [
         json.loads(line)
         for line in baseline.read_text().splitlines() if line.strip()
     ]
     by_config = {rec["config"]: rec for rec in records}
-    assert set(by_config) == {5, 8, 9}
+    assert set(by_config) == {5, 8, 9, 10}
     # config 9's gate leaves are the admission RATES; the volatile
     # fsync-bound record p99s are pruned from the baseline on purpose
     # (the bench still reports them) — pin that they stay pruned
     for phase in by_config[9]["overload"]["phases"].values():
         assert "record_p99_ms" not in phase
+    # config 10's gate leaves are the scenario check/loss COUNTS; the
+    # machine-speed-bound timing leaves are pruned the same way
+    def no_timing_leaves(node):
+        if isinstance(node, dict):
+            for key, value in node.items():
+                assert not key.endswith(("_ms", "_s", "per_s")), key
+                no_timing_leaves(value)
+        elif isinstance(node, list):
+            for value in node:
+                no_timing_leaves(value)
+
+    no_timing_leaves(by_config[10])
+    assert by_config[10]["value"] == 0  # all checks green at baseline
+    assert by_config[10]["lost_subscriptions"] == 0
+    assert by_config[10]["lost_entities"] == 0
     bad = copy.deepcopy(records)
     for rec in bad:
         if rec["config"] == 5:
@@ -162,6 +177,21 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(slow_ingest), *gate]) == 1
+
+    # the ISSUE 12 session gate: ONE lost resumed row — or one newly
+    # failing scenario check — flags on its own under the same
+    # invocation (0 -> 1 crosses the --min-abs floor, "lost"/"failures"
+    # name them lower-is-better)
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 10:
+            rec["lost_entities"] = 1
+            rec["value"] = 1  # scenario_check_failures
+    lost = tmp_path / "lost_session_state.json"
+    lost.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(lost), *gate]) == 1
 
 
 def test_higher_better_drop_ratio_vs_new_value():

@@ -824,10 +824,11 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 16
+    assert len(names) >= 17
     assert names == {
         "async-dangling-task",
         "unbounded-ingest",
+        "unguarded-handshake",
         "per-entity-python-ingest",
         "async-suppress-await",
         "async-blocking-call",
@@ -1233,6 +1234,89 @@ def test_per_entity_ingest_pragma_suppresses():
     assert violations(
         src, relpath="worldql_server_tpu/entities/plane.py",
         select="per-entity-python-ingest",
+    ) == []
+
+
+# endregion
+
+
+# region: unguarded-handshake
+
+
+def test_unguarded_handshake_fires_on_bare_registration():
+    src = """
+    class ZmqTransport:
+        async def _handle_handshake(self, message):
+            push = self.ctx.socket(1)
+            self._push_sockets[message.sender_uuid] = push
+            await self.server.peer_map.insert(peer)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="unguarded-handshake",
+    ) == [("unguarded-handshake", 5), ("unguarded-handshake", 6)]
+
+
+def test_unguarded_handshake_fires_on_ws_container_growth():
+    src = """
+    class WebSocketTransport:
+        async def _handle_connection(self, connection):
+            self._pending.append(connection)
+            self._handed_off[peer_uuid] = connection
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/websocket.py",
+        select="unguarded-handshake",
+    ) == [("unguarded-handshake", 4), ("unguarded-handshake", 5)]
+
+
+def test_unguarded_handshake_quiet_when_admission_present():
+    src = """
+    class ZmqTransport:
+        async def _handle_handshake(self, message):
+            admitted, retry = self.server.governor.admit_handshake(False)
+            if not admitted:
+                return
+            self._push_sockets[message.sender_uuid] = push
+            await self.server.peer_map.insert(peer)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="unguarded-handshake",
+    ) == []
+
+
+def test_unguarded_handshake_quiet_outside_scope():
+    # same shape, but neither a handshake function nor a transport
+    src = """
+    class Thing:
+        async def _do_stuff(self, message):
+            self._items[message.key] = message
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="unguarded-handshake",
+    ) == []
+    src2 = """
+    class Engine:
+        async def _handle_handshake(self, message):
+            self._items[message.key] = message
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/engine/router.py",
+        select="unguarded-handshake",
+    ) == []
+
+
+def test_unguarded_handshake_pragma_suppresses():
+    src = """
+    class ZmqTransport:
+        async def _handle_handshake(self, message):
+            await self.server.peer_map.insert(peer)  # wql: allow(unguarded-handshake)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="unguarded-handshake",
     ) == []
 
 

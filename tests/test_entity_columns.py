@@ -501,3 +501,203 @@ def test_e2e_zmq_columnar_path_serves_frames(wire):
 
 
 # endregion
+
+# region: failpoint coverage (ISSUE 12 satellite) — the PR 11 fast
+# path's loss boundaries are chaos-visible: entities.decode_native
+# (error ⇒ object-path fallback fires, counted) and entities.scatter
+# (error ⇒ full-upload fallback), both audited in the failpoints gauge
+# so no injected fault is ever invisible.
+
+
+@pytest.fixture
+def clean_failpoints():
+    from worldql_server_tpu.robustness import failpoints
+
+    failpoints.registry.reset()
+    yield failpoints.registry
+    failpoints.registry.reset()
+
+
+def test_decode_native_failpoint_degrades_to_object_path(
+    wire, clean_failpoints
+):
+    reg = clean_failpoints
+    h = Harness(wire)
+    owner = uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(4)]
+    msg = ent_msg(owner, [
+        Entity(uuid=e, position=Vector3(i * 30.0, 1, 1), world_name="w")
+        for i, e in enumerate(ents)
+    ])
+
+    reg.set("entities.decode_native", "error:1:x1")
+    h.feed(msg)
+    assert reg.fired("entities.decode_native") == 1
+    # the batch still landed — through the object route, counted
+    assert h.ingest.decode_fallbacks == 1
+    assert h.ingest.fast_messages == 0
+    assert h.ingest.slow_messages == 1
+    assert h.wire_plane.entity_count == 4
+    h.assert_lane_parity()
+
+    # disarmed: the next batch rides the fast path again (columnar
+    # staging folds at the tick edge — parity holds post-tick)
+    h.feed(ent_msg(owner, [Entity(
+        uuid=ents[0], position=Vector3(999.0, 1, 1), world_name="w",
+    )]))
+    assert h.ingest.fast_messages == 1
+    h.tick()
+    h.assert_lane_parity()
+
+
+def test_scatter_failpoint_degrades_to_full_upload(
+    wire, clean_failpoints
+):
+    reg = clean_failpoints
+    plane = make_plane()
+    owner = uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(8)]
+    plane.ingest(ent_msg(owner, [
+        Entity(uuid=e, position=Vector3(i * 30.0, 1, 1), world_name="w")
+        for i, e in enumerate(ents)
+    ]))
+    handle = plane.dispatch_tick()
+    plane.apply(plane.collect_tick(handle))
+    assert plane.h2d_full == 1
+
+    # dirty two rows, then fail the scatter: the dispatch must fall
+    # back to ONE full-tier upload — no row may be lost to the fault
+    plane.ingest(ent_msg(owner, [
+        Entity(uuid=ents[1], position=Vector3(500, 1, 1), world_name="w"),
+        Entity(uuid=ents[2], position=Vector3(600, 1, 1), world_name="w"),
+    ]))
+    reg.set("entities.scatter", "error:1:x1")
+    handle = plane.dispatch_tick()
+    plane.apply(plane.collect_tick(handle))
+    assert reg.fired("entities.scatter") == 1
+    assert plane.scatter_fallbacks == 1
+    assert plane.h2d_full == 2          # the fallback fired
+    assert plane.h2d_scatter == 0
+    slot = plane._slot_of[ents[1]]
+    assert plane._pos[slot, 0] == pytest.approx(500.0)
+
+    # disarmed: the next dirty rows scatter incrementally again
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=ents[3], position=Vector3(700, 1, 1), world_name="w",
+    )]))
+    handle = plane.dispatch_tick()
+    plane.apply(plane.collect_tick(handle))
+    assert plane.h2d_scatter == 1
+    assert plane.h2d_full == 2
+
+
+def test_new_failpoints_audited_in_gauge(wire, clean_failpoints):
+    """Chaos audit: every fired entities.* fault shows in the
+    registry's fired_counts — the same dict the server exports as the
+    failpoints gauge — so the fast path is no longer fault-invisible."""
+    reg = clean_failpoints
+    h = Harness(wire)
+    owner = uuid.uuid4()
+    reg.set("entities.decode_native", "error:1:x1")
+    h.feed(ent_msg(owner, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(1, 1, 1), world_name="w",
+    )]))
+    plane = h.wire_plane
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(2, 2, 2), world_name="w",
+    )]))
+    handle = plane.dispatch_tick()
+    plane.apply(plane.collect_tick(handle))
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=next(iter(plane._slot_of)), position=Vector3(3, 3, 3),
+        world_name="w",
+    )]))
+    reg.set("entities.scatter", "error:1:x1")
+    handle = plane.dispatch_tick()
+    if handle is not None:
+        plane.apply(plane.collect_tick(handle))
+    counts = reg.fired_counts()
+    assert counts.get("entities.decode_native") == 1
+    assert counts.get("entities.scatter") == 1
+
+
+# endregion
+
+# region: ResilientBackend rebuild mid-sim-tick (ISSUE 12 satellite)
+
+
+def _resilient_plane(failover_after=3):
+    from worldql_server_tpu.robustness.resilient import ResilientBackend
+
+    backend = ResilientBackend(
+        CpuSpatialBackend(16), factory=lambda: CpuSpatialBackend(16),
+        failover_after=failover_after,
+    )
+    plane = EntityPlane(
+        backend, PeerMap(), cube_size=16, dt=0.05, bounds=1000.0, k=4,
+    )
+    # the server's wiring: rebuild/failover invalidates the twin FIRST
+    backend.on_rebuild = plane.abort_tick
+    return backend, plane
+
+
+def test_rebuild_mid_tick_aborts_before_restore(clean_failpoints):
+    """Regression: a ResilientBackend rebuild during an active entity
+    tick must invalidate the device twin (dirty bitmap included) via
+    abort_tick BEFORE the restore — the next dispatch re-ships the
+    host authority instead of scattering onto a stale twin."""
+    reg = clean_failpoints
+    backend, plane = _resilient_plane()
+    owner = uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(4)]
+    plane.ingest(ent_msg(owner, [
+        Entity(uuid=e, position=Vector3(i * 30.0, 1, 1), world_name="w")
+        for i, e in enumerate(ents)
+    ]))
+    handle = plane.dispatch_tick()
+    plane.apply(plane.collect_tick(handle))
+    full0 = plane.h2d_full
+
+    # client update stages dirty rows, tick goes IN FLIGHT…
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=ents[1], position=Vector3(400.0, 1, 1), world_name="w",
+    )]))
+    assert plane.dispatch_tick() is not None
+    assert plane._tick_inflight
+
+    # …and the backend fails + rebuilds mid-tick (contained dispatch)
+    reg.set("backend.dispatch", "error:1:x1")
+    backend.dispatch_local_batch([])
+    assert backend.rebuilds == 1
+    assert not plane._tick_inflight, "rebuild must abort the tick"
+    assert plane._dev_state is None, "twin must be invalidated"
+    assert plane.dropped_ticks == 1
+
+    # next dispatch re-ships the full host tier — never a stale
+    # scatter — and the client's update is in it
+    scatters0 = plane.h2d_scatter
+    handle = plane.dispatch_tick()
+    result = plane.collect_tick(handle)
+    plane.apply(result)
+    assert plane.h2d_full == full0 + 1
+    assert plane.h2d_scatter == scatters0
+    slot = plane._slot_of[ents[1]]
+    assert plane._pos[slot, 0] == pytest.approx(400.0)
+
+
+def test_failover_mid_tick_also_aborts(clean_failpoints):
+    reg = clean_failpoints
+    backend, plane = _resilient_plane(failover_after=1)
+    owner = uuid.uuid4()
+    plane.ingest(ent_msg(owner, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(1, 1, 1), world_name="w",
+    )]))
+    assert plane.dispatch_tick() is not None
+    reg.set("backend.dispatch", "error:1:x1")
+    backend.dispatch_local_batch([])
+    assert backend.failed_over
+    assert not plane._tick_inflight
+    assert plane.dropped_ticks == 1
+
+
+# endregion

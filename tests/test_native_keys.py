@@ -157,3 +157,29 @@ def test_encode_queries_public_path_and_fallback(native):
 
 
 # endregion
+
+
+# region: areamap reference probe (ROADMAP 5a)
+
+
+def test_areamap_probe_returns_calibration_row():
+    """The vs_reference probe: a reference-shaped native AreaMap build
+    + lookup pass returns sane timings and a deterministic matched
+    count under a fixed seed; a stale library (no symbol) degrades to
+    None, never wrong."""
+    probe = native_keys.areamap_probe(5_000, 2_000, cube_size=16, seed=7)
+    if probe is None:
+        pytest.skip("native library predates wql_areamap_probe")
+    assert probe["subs"] == 5_000 and probe["queries"] == 2_000
+    assert probe["build_ms"] > 0
+    assert probe["lookup_ns_per_query"] > 0
+    assert probe["matched_rows"] >= 0
+    again = native_keys.areamap_probe(5_000, 2_000, cube_size=16, seed=7)
+    assert again["matched_rows"] == probe["matched_rows"]
+    # degenerate shapes refuse instead of reading garbage
+    assert native_keys._native._areamap is None or (
+        native_keys.areamap_probe(0, 10) is None
+    )
+
+
+# endregion

@@ -176,7 +176,10 @@ def test_admission_classes_in_reject():
     # locals and globals are refused at the door, counted by class
     assert not gov.admit(Instruction.LOCAL_MESSAGE, sender)
     assert not gov.admit(Instruction.GLOBAL_MESSAGE, sender)
-    assert gov.shed == {"local": 1, "global": 1}
+    assert gov.shed == {
+        "local": 1, "global": 1,
+        "handshake_new": 0, "handshake_resume": 0,
+    }
     assert gov.metrics.counters["overload.shed_local"] == 1
     assert gov.metrics.counters["overload.shed_global"] == 1
 

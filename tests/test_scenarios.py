@@ -1,0 +1,52 @@
+"""Adversarial scenario suite (ISSUE 12): the catalog's smoke shapes
+run as tests, so a scenario regression (lost resumed state, unbounded
+queue, silent shed, governor stuck) fails tier-1 — not just the CI
+scenario-smoke step and the bench perf gate that also run them.
+"""
+
+import pytest
+
+from worldql_server_tpu.scenarios import CATALOG, run_scenario
+
+
+def assert_green(report):
+    failed = [c for c in report["checks"] if not c["ok"]]
+    assert not failed, (
+        f"scenario {report['scenario']} failed checks: "
+        f"{[c['name'] for c in failed]} (error={report['error']}) "
+        f"slo={report['slo']}"
+    )
+
+
+def test_catalog_names():
+    assert set(CATALOG) == {
+        "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
+    }
+
+
+def test_flash_crowd_smoke():
+    assert_green(run_scenario("flash_crowd", shape="smoke"))
+
+
+def test_game_tick_smoke():
+    assert_green(run_scenario("game_tick", shape="smoke"))
+
+
+def test_reconnect_storm_smoke():
+    """The tentpole acceptance: zero subscription/entity loss for
+    sessions resumed within TTL, bounded handshake p99 under a 10x
+    connect storm, REJECT sheds new-with-hint but admits resume, and
+    the governor returns to OK in-window."""
+    report = run_scenario("reconnect_storm", shape="smoke")
+    assert_green(report)
+    slo = report["slo"]
+    assert slo["resumed"] == slo["swarm"]
+    assert slo["entities_after"] == slo["entities_before"]
+    assert slo["subscriptions_after"] >= slo["subscriptions_before"]
+
+
+@pytest.mark.slow
+def test_battle_royale_smoke():
+    # slow-marked: the tpu-backend sim compile makes this the heaviest
+    # leg; CI runs it in the dedicated Scenario smoke step
+    assert_green(run_scenario("battle_royale", shape="smoke"))

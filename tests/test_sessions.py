@@ -1,0 +1,559 @@
+"""Session continuity (ISSUE 12): park, resume, expire.
+
+Unit legs pin the SessionStore lifecycle and the governor's handshake
+admission asymmetry; the e2e legs drive a REAL server over real ZMQ
+(and WS, importorskip): subscribe + register entities → hard drop →
+resume within TTL → survivor-visible state identical lane for lane;
+the expired-TTL variant proves clean reclamation through the normal
+removal path (``peers.evicted_session_expired``); and the
+``--session-ttl 0`` default is pinned byte-for-byte against the
+pre-session disconnect path.
+"""
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.protocol.types import (
+    Entity,
+    Instruction,
+    Message,
+    Vector3,
+)
+from worldql_server_tpu.robustness import failpoints
+from worldql_server_tpu.robustness.overload import (
+    OverloadGovernor,
+    REJECT,
+)
+from worldql_server_tpu.robustness.sessions import SessionStore
+
+from tests.client_util import ZmqClient, free_port
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.registry.reset()
+    yield
+    failpoints.registry.reset()
+
+
+def index_rows(backend) -> list:
+    """Comparable (world, cube, peer) lane list of the live index."""
+    worlds, peers, wid, cube, pid = backend.export_rows()
+    return sorted(
+        (worlds[int(w)], tuple(int(c) for c in cb), str(peers[int(p)]))
+        for w, cb, p in zip(wid, cube, pid)
+    )
+
+
+def base_config(**overrides) -> Config:
+    config = Config(
+        store_url="memory://",
+        http_enabled=False, ws_enabled=False,
+        zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+        spatial_backend="cpu",
+        session_ttl=10.0,
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config
+
+
+async def connect(port, **kw):
+    for _ in range(100):
+        try:
+            return await asyncio.wait_for(
+                ZmqClient.connect(port, **kw), 1.0
+            )
+        except Exception:
+            await asyncio.sleep(0.02)
+    raise AssertionError("could not connect a zmq client")
+
+
+# region: SessionStore unit
+
+
+def test_store_mint_peek_park_resume_expire():
+    now = [0.0]
+    store = SessionStore(ttl=5.0, clock=lambda: now[0])
+    u = uuid.uuid4()
+    session = store.mint(u, "zeromq")
+    assert store.peek(session.token) is session
+    assert store.peek(session.token, u) is session
+    # wrong uuid, unknown token, bytes token all validated
+    assert store.peek(session.token, uuid.uuid4()) is None
+    assert store.peek("deadbeef") is None
+    assert store.peek(session.token.encode(), u) is session
+    assert store.rejected_tokens == 2
+
+    assert store.park(u) is True
+    assert session.parked and store.parked_count() == 1
+    # resume within TTL
+    now[0] = 4.0
+    assert store.peek(session.token, u) is session
+    store.resume(session)
+    assert not session.parked and store.resumed == 1
+
+    # park again, run out the TTL: peek refuses even before the sweep
+    store.park(u)
+    now[0] = 10.0
+    assert store.peek(session.token, u) is None
+    reclaimed = []
+    store.on_expire = reclaimed.append
+    assert store.expire_due() == [u]
+    assert reclaimed == [u]
+    assert store.get(u) is None and store.expired == 1
+    # a dead token can never resume
+    assert store.peek(session.token, u) is None
+
+
+def test_store_mint_replaces_and_discard_invalidates():
+    store = SessionStore(ttl=5.0)
+    u = uuid.uuid4()
+    first = store.mint(u, "zeromq")
+    second = store.mint(u, "zeromq")
+    assert store.peek(first.token) is None  # replaced → invalid
+    assert store.peek(second.token) is second
+    store.discard(u)
+    assert store.peek(second.token) is None
+    assert store.discarded == 1
+
+
+def test_store_undelivered_counts_only_parked():
+    store = SessionStore(ttl=5.0)
+    u = uuid.uuid4()
+    store.mint(u, "zeromq")
+    store.note_undelivered(u)          # bound: not counted
+    assert store.undelivered_frames == 0
+    store.park(u)
+    store.note_undelivered(u)
+    store.note_undelivered(uuid.uuid4())  # no session: ignored
+    assert store.undelivered_frames == 1
+    assert store.get(u).undelivered == 1
+
+
+# region: governor handshake admission
+
+
+def test_admit_handshake_new_sheds_before_resume():
+    gov = OverloadGovernor(resume_rate=100.0)
+    # OK: everyone passes
+    assert gov.admit_handshake(False) == (True, 0)
+    assert gov.admit_handshake(True) == (True, 0)
+    # SHED_LOW: still everyone
+    gov._transition("shed_low", "test")
+    assert gov.admit_handshake(False)[0] is True
+    # SHED_HIGH: new sheds (with a positive jittered hint), resume passes
+    gov._transition("shed_high", "test")
+    ok, hint = gov.admit_handshake(False)
+    assert ok is False and hint > 0
+    assert gov.admit_handshake(True)[0] is True
+    # REJECT: new sheds; resume admitted up to the token bucket
+    gov._transition(REJECT, "test")
+    assert gov.admit_handshake(False)[0] is False
+    assert gov.admit_handshake(True)[0] is True
+    assert gov.shed["handshake_new"] == 2
+    assert gov.status()["shed_handshake_new"] == 2
+
+
+def test_admit_handshake_reject_resume_bucket_bounds():
+    clock = [0.0]
+    gov = OverloadGovernor(
+        resume_rate=2.0, resume_burst=2, clock=lambda: clock[0]
+    )
+    gov._transition(REJECT, "test")
+    assert gov.admit_handshake(True)[0] is True
+    assert gov.admit_handshake(True)[0] is True
+    ok, hint = gov.admit_handshake(True)  # burst exhausted
+    assert ok is False and hint > 0
+    assert gov.shed["handshake_resume"] == 1
+    clock[0] = 1.0  # 2/s refill → one token back
+    assert gov.admit_handshake(True)[0] is True
+
+
+def test_retry_after_hints_jittered_and_state_scaled():
+    gov = OverloadGovernor()
+    gov._transition("shed_high", "test")
+    hints = {gov._retry_after_ms() for _ in range(64)}
+    assert len(hints) > 8, "retry-after hints must be jittered"
+    assert all(0 < h < 1000 for h in hints)
+    gov._transition(REJECT, "test")
+    deeper = [gov._retry_after_ms() for _ in range(64)]
+    assert max(deeper) > max(hints), "deeper state → longer hints"
+
+
+def test_refusal_hint_budget_bounds():
+    clock = [0.0]
+    gov = OverloadGovernor(clock=lambda: clock[0])
+    grants = sum(gov.take_refusal_hint() for _ in range(200))
+    assert grants == 50  # the burst; beyond it refusals go silent
+    clock[0] = 1.0
+    assert gov.take_refusal_hint() is True  # refilled
+
+
+# region: e2e over real ZMQ
+
+
+def test_zmq_reconnect_resume_within_ttl_state_identical():
+    """Subscribe + register entities → hard drop → resume within TTL:
+    survivor-visible state is identical lane for lane — index rows,
+    entity slots/positions/ownership — with zero index churn."""
+
+    async def scenario():
+        config = base_config(
+            spatial_backend="tpu", tick_interval=0.05,
+            entity_sim=True, precompile_tiers=False,
+        )
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            port = config.zmq_server_port
+            client = await connect(port)
+            survivor = await connect(port)
+            assert client.token and survivor.token
+
+            await client.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            eids = [uuid.uuid4() for _ in range(3)]
+            await client.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name="w",
+                entities=[
+                    Entity(uuid=e, world_name="w",
+                           position=Vector3(10.0 * i, 0.0, 0.0))
+                    for i, e in enumerate(eids)
+                ],
+            ))
+            plane = server.entity_plane
+            for _ in range(200):
+                if plane.entity_count == 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert plane.entity_count == 3
+            subs0 = server.backend.subscription_count()
+            rows0 = index_rows(server.backend)
+            live0 = plane._live[: plane._cap].copy()
+
+            # hard drop; the staleness sweeper's removal parks it
+            token, u = client.token, client.uuid
+            await client.close()
+            await server.peer_map.remove(u)
+            assert server.sessions.parked_count() == 1
+            assert server.metrics.counters.get("sessions.parked") == 1
+            # parked: index + entity slots untouched (zero churn)
+            assert server.backend.subscription_count() == subs0
+            assert plane.entity_count == 3
+
+            # survivor sees the disconnect announced (normal path)
+            await survivor.recv_until(Instruction.PEER_DISCONNECT, 5.0)
+
+            resumed = await ZmqClient.resume(port, token, u)
+            assert resumed.token == token
+            assert server.sessions.resumed == 1
+            # survivor-visible state identical lane for lane
+            assert server.backend.subscription_count() == subs0
+            assert index_rows(server.backend) == rows0
+            assert np.array_equal(plane._live[: plane._cap], live0)
+            await survivor.recv_until(Instruction.PEER_CONNECT, 5.0)
+
+            # ownership survived: an update through the resumed binding
+            updates0 = plane.updates
+            await resumed.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name="w",
+                entities=[Entity(
+                    uuid=eids[0], world_name="w",
+                    position=Vector3(99.0, 0.0, 0.0),
+                )],
+            ))
+            for _ in range(200):
+                if plane.updates > updates0:
+                    break
+                await asyncio.sleep(0.01)
+            assert plane.updates > updates0
+            await resumed.close()
+        finally:
+            try:
+                await survivor.close()
+            except Exception:
+                pass
+            await server.stop()
+
+    run(scenario())
+
+
+def test_zmq_expired_ttl_reclaims_through_normal_removal():
+    async def scenario():
+        config = base_config(session_ttl=0.3)
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            port = config.zmq_server_port
+            client = await connect(port)
+            await client.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            for _ in range(100):
+                if server.backend.subscription_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            u = client.uuid
+            await client.close()
+            await server.peer_map.remove(u)
+            assert server.sessions.parked_count() == 1
+            assert server.backend.subscription_count() == 1  # parked
+
+            # the supervised sweeper reclaims after the TTL
+            for _ in range(200):
+                if server.metrics.counters.get(
+                    "peers.evicted_session_expired", 0
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            assert server.metrics.counters[
+                "peers.evicted_session_expired"
+            ] == 1
+            assert server.backend.subscription_count() == 0
+            assert server.sessions.stats()["live"] == 0
+            # the dead token resumes nothing: fresh registration instead
+            late = await connect(port)
+            assert late.token is not None
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_zmq_resume_over_stale_binding_is_silent():
+    """Resume while the old binding is still registered (server never
+    noticed the drop): survivors see NO PeerDisconnect/PeerConnect —
+    the transport swap is invisible."""
+
+    async def scenario():
+        config = base_config()
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            port = config.zmq_server_port
+            client = await connect(port)
+            witness = await connect(port)
+            token, u = client.token, client.uuid
+            await client.close()  # hard drop, server not told
+            assert u in server.peer_map
+
+            resumed = await ZmqClient.resume(port, token, u)
+            assert resumed.token == token
+            assert u in server.peer_map
+            assert server.sessions.resumed == 1
+            assert server.sessions.parked_count() == 0
+            # no disconnect/connect was broadcast for the swap; the
+            # broker is immediately serviceable through the new binding
+            await resumed.send(Message(instruction=Instruction.HEARTBEAT))
+            hb = await resumed.recv_until(Instruction.HEARTBEAT, 5.0)
+            assert hb is not None
+            for m_inst in (
+                Instruction.PEER_DISCONNECT, Instruction.PEER_CONNECT,
+            ):
+                with pytest.raises(asyncio.TimeoutError):
+                    await witness.recv_until(m_inst, 0.3)
+            await resumed.close()
+            await witness.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_zmq_wrong_token_is_new_peer_and_tears_down_parked_state():
+    async def scenario():
+        config = base_config()
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            port = config.zmq_server_port
+            client = await connect(port)
+            await client.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            for _ in range(100):
+                if server.backend.subscription_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            u = client.uuid
+            await client.close()
+            await server.peer_map.remove(u)
+            assert server.backend.subscription_count() == 1  # parked
+
+            # same uuid, bogus token: NOT a resume — the parked state
+            # belongs to the token holder and is torn down first
+            again = await connect(port, peer_uuid=u, token="forged")
+            assert again.token is not None  # fresh session minted
+            assert server.backend.subscription_count() == 0
+            assert server.sessions.rejected_tokens >= 1
+            await again.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_session_ttl_zero_pins_pre_session_path():
+    """--session-ttl 0 (default): no token in the echo, no session
+    machinery, disconnect tears down immediately — byte for byte the
+    pre-session behavior."""
+
+    async def scenario():
+        config = base_config(session_ttl=0.0)
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            assert server.sessions is None
+            assert server.sessions_status() is None
+            assert server.supervisor.get("session-sweep") is None
+            port = config.zmq_server_port
+            client = await connect(port)
+            assert client.token is None  # bare echo, no parameter
+            await client.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            for _ in range(100):
+                if server.backend.subscription_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            u = client.uuid
+            await client.close()
+            await server.peer_map.remove(u)
+            assert server.backend.subscription_count() == 0  # torn down
+            snap = server.metrics.snapshot()
+            assert "sessions" not in snap["gauges"]
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_parked_frames_counted_never_buffered():
+    async def scenario():
+        config = base_config(tick_interval=0.02)
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            port = config.zmq_server_port
+            listener = await connect(port)
+            sender = await connect(port)
+            await listener.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            await asyncio.sleep(0.1)
+            u = listener.uuid
+            await listener.close()
+            await server.peer_map.remove(u)
+            for _ in range(5):
+                await sender.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name="w", position=Vector3(1, 1, 1),
+                    parameter="x",
+                ))
+            for _ in range(200):
+                if server.sessions.undelivered_frames >= 5:
+                    break
+                await asyncio.sleep(0.01)
+            assert server.sessions.undelivered_frames >= 5
+            assert server.sessions.get(u).undelivered >= 5
+            await sender.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+# region: e2e over WS (importorskip — minimal containers skip)
+
+
+def test_ws_reconnect_resume_within_ttl():
+    pytest.importorskip("websockets")
+    from tests.client_util import WsClient
+
+    async def scenario():
+        config = base_config(
+            ws_enabled=True, ws_host="127.0.0.1", ws_port=free_port(),
+        )
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            ws = await WsClient.connect(config.ws_port)
+            assert ws.token is not None
+            await ws.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="w", position=Vector3(1, 1, 1),
+            ))
+            for _ in range(100):
+                if server.backend.subscription_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            token, u = ws.token, ws.uuid
+
+            await ws.drop()  # hard TCP abort: the recv loop parks it
+            for _ in range(200):
+                if server.sessions.parked_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert server.sessions.parked_count() == 1
+            assert server.backend.subscription_count() == 1  # parked
+
+            resumed = await WsClient.resume(config.ws_port, token, u)
+            await asyncio.sleep(0.1)
+            assert server.sessions.resumed == 1
+            assert server.backend.subscription_count() == 1
+            assert u in server.peer_map
+
+            # the resumed binding serves: fan-out reaches it
+            zc = await connect(config.zmq_server_port)
+            await zc.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="w", position=Vector3(1, 1, 1),
+                parameter="wb",
+            ))
+            frame = await resumed.recv_until(
+                Instruction.LOCAL_MESSAGE, 5.0
+            )
+            assert frame.parameter == "wb"
+            await zc.close()
+            await resumed.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_ws_session_ttl_zero_handshake_unchanged():
+    pytest.importorskip("websockets")
+    from tests.client_util import WsClient
+
+    async def scenario():
+        config = base_config(
+            session_ttl=0.0,
+            ws_enabled=True, ws_host="127.0.0.1", ws_port=free_port(),
+        )
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            ws = await WsClient.connect(config.ws_port)
+            assert ws.token is None  # no flex on the assigned handshake
+            await ws.close()
+        finally:
+            await server.stop()
+
+    run(scenario())

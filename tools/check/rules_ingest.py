@@ -194,4 +194,93 @@ PER_ENTITY_PYTHON_INGEST = Rule(
     _check_per_entity_ingest,
 )
 
-RULES = [UNBOUNDED_INGEST, PER_ENTITY_PYTHON_INGEST]
+# --------------------------------------------------------------------
+# unguarded-handshake (ISSUE 12): handshakes are an admission class.
+# A reconnect storm is the retry-storm/metastable-failure regime — the
+# handshake path allocates per-peer state (connect-back sockets, map
+# entries, session records, delivery shard slots) at wire speed, so
+# any container growth or peer registration on it must sit behind the
+# governor's handshake admission (``admit_handshake``: new connects
+# shed before resumes, REJECT admits resumes via a token bucket) or
+# carry an auditable ``# wql: allow(unguarded-handshake)`` pragma.
+
+#: the transport handshake entry points (relpath suffixes → functions)
+_HANDSHAKE_SCOPED = (
+    "transports/zeromq.py",
+    "transports/websocket.py",
+)
+
+_HANDSHAKE_FUNCS = {
+    "_handle_handshake",
+    "_handle_connection",
+}
+
+#: peer-registration calls: each allocates per-peer server state
+_REGISTER_CALLS = {"insert", "rebind", "adopt", "mint"}
+
+#: names whose presence marks the handshake path admission-guarded
+_HS_ADMIT_NAMES = {"admit_handshake", "take_refusal_hint"}
+
+
+def _mentions_handshake_admission(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute):
+            if "governor" in node.attr or node.attr in _HS_ADMIT_NAMES:
+                return True
+        elif isinstance(node, ast.Name):
+            if "governor" in node.id or node.id in _HS_ADMIT_NAMES:
+                return True
+    return False
+
+
+def _check_unguarded_handshake(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_HANDSHAKE_SCOPED):
+        return
+    funcs = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _HANDSHAKE_FUNCS
+    ]
+    for func in funcs:
+        if _mentions_handshake_admission(func):
+            continue
+        for node in walk_shallow(func.body):
+            what = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in (_GROW_METHODS | _REGISTER_CALLS)
+            ):
+                target = dotted_name(node.func.value) or "<object>"
+                what = f"{target}.{node.func.attr}(...)"
+            elif isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Subscript) for t in node.targets
+            ):
+                sub = next(
+                    t for t in node.targets if isinstance(t, ast.Subscript)
+                )
+                target = dotted_name(sub.value) or "<container>"
+                what = f"{target}[...] = …"
+            if what is None:
+                continue
+            yield from ctx.flag(
+                UNGUARDED_HANDSHAKE,
+                node,
+                f"handshake-path state growth {what} ({func.name}) "
+                "with no admission reference — a reconnect storm "
+                "allocates per-peer state at wire speed; gate the "
+                "path behind governor.admit_handshake (new sheds "
+                "before resume, REJECT admits resumes via token "
+                "bucket) or justify with "
+                "# wql: allow(unguarded-handshake)",
+            )
+
+
+UNGUARDED_HANDSHAKE = Rule(
+    "unguarded-handshake",
+    "handshake-path container growth or peer registration without a "
+    "governor/admission reference (transport handshake entry points)",
+    _check_unguarded_handshake,
+)
+
+RULES = [UNBOUNDED_INGEST, PER_ENTITY_PYTHON_INGEST, UNGUARDED_HANDSHAKE]

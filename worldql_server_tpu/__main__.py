@@ -225,6 +225,17 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="overload_rss_limit_mb",
                    help="RSS ceiling in MiB for the governor's memory "
                         "signal (default 0 = off)")
+    p.add_argument("--session-ttl", type=float, dest="session_ttl",
+                   help="park a dropped peer's subscriptions/entities "
+                        "for this many seconds and let a reconnect "
+                        "presenting its session token resume them with "
+                        "zero index churn; 0 (default) = sessions off, "
+                        "pre-session disconnect semantics byte for byte")
+    p.add_argument("--session-resume-rate", type=float,
+                   dest="session_resume_rate",
+                   help="resumes/s the overload governor still admits "
+                        "in REJECT (new connects shed at SHED_HIGH+; "
+                        "default 200)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -251,6 +262,7 @@ _OVERRIDES = [
     "overload_deadline_k", "overload_recover_ticks",
     "overload_min_batch", "overload_peer_rate", "overload_peer_burst",
     "overload_evict_after", "overload_rss_limit_mb",
+    "session_ttl", "session_resume_rate",
 ]
 
 

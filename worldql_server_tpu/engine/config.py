@@ -348,6 +348,23 @@ class Config:
     overload_rss_limit_mb: int = field(
         default_factory=lambda: int(_env("WQL_OVERLOAD_RSS_LIMIT_MB", "0"))
     )
+    # Session continuity (robustness/sessions.py): with a TTL > 0 every
+    # handshake mints a resumable session token; a dropped peer's
+    # subscriptions / owned entities / undelivered-frame accounting are
+    # PARKED for this many seconds instead of torn down, and a
+    # reconnect presenting the token rebinds the new transport to the
+    # parked state with zero index churn. 0 (the default) keeps the
+    # pre-session disconnect path byte for byte.
+    session_ttl: float = field(
+        default_factory=lambda: float(_env("WQL_SESSION_TTL", "0"))
+    )
+    # Token bucket for resumes the governor still admits in REJECT
+    # (resumes/s; handshake admission is only active with --overload
+    # on). New connects shed at SHED_HIGH+; resumes shed only beyond
+    # this trickle in REJECT.
+    session_resume_rate: float = field(
+        default_factory=lambda: float(_env("WQL_SESSION_RESUME_RATE", "200"))
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -517,6 +534,13 @@ class Config:
             errors.append(
                 "overload_evict_after requires overload_peer_rate > 0 "
                 "(eviction is driven by the token bucket)"
+            )
+        if self.session_ttl < 0:
+            errors.append("session_ttl must be >= 0 (0 = sessions off)")
+        if self.session_resume_rate < 0:
+            errors.append(
+                "session_resume_rate must be >= 0 (0 = no resumes "
+                "admitted in REJECT)"
             )
         if self.entity_k < 1:
             errors.append("entity_k must be >= 1")

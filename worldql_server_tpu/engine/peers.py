@@ -143,7 +143,7 @@ class PeerMap:
     """
 
     def __init__(self, on_remove: OnRemove | None = None, metrics=None,
-                 plane=None):
+                 plane=None, sessions=None):
         self._map: dict[uuid_mod.UUID, Peer] = {}
         self._on_remove = on_remove
         self.metrics = metrics
@@ -153,6 +153,12 @@ class PeerMap:
         # (and the whole map when plane is None — the default) take
         # the byte-for-byte in-process path below.
         self._plane = plane
+        # Optional robustness.sessions.SessionStore (--session-ttl):
+        # frames addressed to a PARKED peer (dropped transport, state
+        # held for resume) are counted there — accounting, never
+        # buffering. None (the default) costs one attribute test on
+        # the map-miss path only.
+        self._sessions = sessions
 
     # region: lookups
 
@@ -210,6 +216,33 @@ class PeerMap:
         if self._on_remove is not None:
             self._on_remove(uuid)
         return peer
+
+    def detach(self, uuid: uuid_mod.UUID) -> Peer | None:
+        """Silently pop a peer's TRANSPORT binding: no PeerDisconnect
+        broadcast, no removal hook — the logical state (index rows,
+        entity slots, session) stays untouched. The session-resume
+        rebind uses this to swap a stale binding for a fresh one with
+        zero survivor-visible churn."""
+        peer = self._map.pop(uuid, None)
+        if peer is not None:
+            peer.closed = True
+        return peer
+
+    def rebind(self, peer: Peer) -> None:
+        """Install a fresh transport binding for a peer the survivors
+        still consider connected (resume-over-stale-binding): silent
+        counterpart of :meth:`insert`."""
+        peer.closed = False
+        self._map[peer.uuid] = peer
+
+    async def remove_if(self, uuid: uuid_mod.UUID, peer: Peer) -> bool:
+        """Remove only when ``peer`` is still the CURRENT binding: a
+        connection's teardown path must never evict the fresh binding
+        a resume installed after it."""
+        if self._map.get(uuid) is not peer:
+            return False
+        await self.remove(uuid)
+        return True
 
     # endregion
 
@@ -295,6 +328,8 @@ class PeerMap:
                 for u in uuids:
                     p = self._map.get(u)
                     if p is None:
+                        if self._sessions is not None:
+                            self._sessions.note_undelivered(u)
                         continue
                     if p.shard is not None:
                         group = groups.get(p.shard)
@@ -341,6 +376,8 @@ class PeerMap:
             for u in uuids:
                 p = self._map.get(u)
                 if p is None:
+                    if self._sessions is not None:
+                        self._sessions.note_undelivered(u)
                     continue
                 n += 1
                 outbox.setdefault(p, []).append(framed)

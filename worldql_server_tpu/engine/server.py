@@ -159,9 +159,24 @@ class WorldQLServer:
                 # tail end-to-end
                 self.recorder.stitcher = self.delivery_plane.stitch
         self._delivery_evictions: set = set()
+        # Session continuity (robustness/sessions.py): with
+        # --session-ttl > 0 a dropped peer's logical state parks for
+        # the TTL instead of tearing down, and a reconnect presenting
+        # the handshake-minted token rebinds to it. None with TTL 0
+        # (the default) — every disconnect path keeps the pre-session
+        # behavior byte for byte.
+        self.sessions = None
+        if config.session_ttl > 0:
+            from ..robustness.sessions import SessionStore
+
+            self.sessions = SessionStore(
+                config.session_ttl,
+                metrics=self.metrics,
+                on_expire=self._expire_session,
+            )
         self.peer_map = PeerMap(
             on_remove=self._on_peer_remove, metrics=self.metrics,
-            plane=self.delivery_plane,
+            plane=self.delivery_plane, sessions=self.sessions,
         )
         # Overload control plane (robustness/overload.py): admission
         # governor for router, ticker and entity plane. None with
@@ -185,6 +200,7 @@ class WorldQLServer:
                 peer_burst=config.overload_peer_burst,
                 evict_after=config.overload_evict_after,
                 rss_limit_mb=config.overload_rss_limit_mb,
+                resume_rate=config.session_resume_rate,
                 metrics=self.metrics,
                 loop_monitor=self.loop_monitor,
                 on_evict=self._on_rate_limit_evict,
@@ -221,6 +237,15 @@ class WorldQLServer:
                 metrics=self.metrics,
                 on_error=lambda: self.metrics.inc("zmq.recv_errors"),
             )
+        if self.entity_plane is not None and hasattr(
+            self.backend, "_note_failure"
+        ):
+            # ResilientBackend rebuild/failover swaps the inner index
+            # out from under an in-flight sim tick: the entity plane's
+            # device twin (and its dirty bitmap) must be invalidated
+            # BEFORE the restore so the next dispatch re-ships the
+            # host authority instead of scattering onto a stale twin.
+            self.backend.on_rebuild = self.entity_plane.abort_tick
         self.ticker = None
         self.staging = None
         if config.tick_interval > 0:
@@ -345,6 +370,10 @@ class WorldQLServer:
                     f"delivery.worker.{i}",
                     lambda i=i: self.delivery_plane.worker_stats(i),
                 )
+        if self.sessions is not None:
+            # session continuity accounting: minted/parked/resumed/
+            # expired and the undelivered-frame count are never silent
+            self.metrics.gauge("sessions", self.sessions.stats)
         if self.entity_plane is not None:
             self.metrics.gauge("entity_sim", self.entity_plane.stats)
         if self.entity_ingest is not None:
@@ -409,6 +438,13 @@ class WorldQLServer:
         }
         return status
 
+    def sessions_status(self) -> dict | None:
+        """Session-continuity state for /healthz; None with
+        --session-ttl 0 (the reference-shaped body stays untouched)."""
+        if self.sessions is None:
+            return None
+        return self.sessions.stats()
+
     def overload_status(self) -> dict | None:
         """Governor state + shed accounting for /healthz; None with
         --overload off (the reference-shaped body stays untouched)."""
@@ -440,8 +476,37 @@ class WorldQLServer:
         task.add_done_callback(self._delivery_evictions.discard)
 
     def _on_peer_remove(self, uuid) -> None:
-        """Disconnect cleanup: purge the spatial index (the remove_rx
-        path, thread.rs:124-126) and let transports drop socket state."""
+        """Disconnect cleanup. With sessions enabled and a session
+        minted for this peer, the TRANSPORT state is released (delivery
+        shard slot, connect-back sockets) but the logical state —
+        subscription index rows, entity slots, governor bucket — PARKS
+        for the TTL; otherwise the full teardown runs as always."""
+        if self.sessions is not None and self.sessions.park(uuid):
+            self._release_transport_state(uuid)
+            return
+        self._teardown_peer_state(uuid)
+
+    def _release_transport_state(self, uuid) -> None:
+        """Drop everything bound to the peer's (dead or superseded)
+        transport: the delivery-plane shard slot and per-transport
+        socket state. Logical state untouched."""
+        if self.delivery_plane is not None:
+            # worker-owned socket: the owning shard closes its end
+            self.delivery_plane.release(uuid)
+        for transport in self._transports:
+            hook = getattr(transport, "on_peer_removed", None)
+            if hook is not None:
+                hook(uuid)
+
+    def _teardown_peer_state(self, uuid) -> None:
+        """The NORMAL removal path's state teardown: purge the spatial
+        index (the remove_rx path, thread.rs:124-126), entity slots,
+        governor bookkeeping, and transport/delivery socket state.
+        Session expiry funnels through here too — reclamation IS a
+        normal removal, just deferred by the TTL."""
+        if self.sessions is not None:
+            # a torn-down peer's token must never resume
+            self.sessions.discard(uuid)
         self.backend.remove_peer(uuid)
         if self.governor is not None:
             # token bucket bookkeeping stays bounded by live peers
@@ -450,13 +515,23 @@ class WorldQLServer:
             # entity slots + refcounts of the departed peer; its index
             # rows (entity-derived included) are already purged above
             self.entity_plane.on_peer_removed(uuid)
-        if self.delivery_plane is not None:
-            # worker-owned socket: the owning shard closes its end
-            self.delivery_plane.release(uuid)
-        for transport in self._transports:
-            hook = getattr(transport, "on_peer_removed", None)
-            if hook is not None:
-                hook(uuid)
+        self._release_transport_state(uuid)
+
+    def _expire_session(self, uuid) -> None:
+        """Session-sweeper expiry hook: the parked state's TTL ran out
+        — reclaim through the normal teardown."""
+        self._teardown_peer_state(uuid)
+
+    def prepare_rebind(self, uuid):
+        """First half of a session resume: silently detach the stale
+        old transport binding (no PeerDisconnect broadcast, no state
+        teardown) and release its shard slot + sockets, so the caller
+        can adopt + rebind the fresh binding — possibly onto a
+        different delivery-plane shard. Returns the detached Peer, or
+        None when the peer was already out of the map (parked)."""
+        old = self.peer_map.detach(uuid)
+        self._release_transport_state(uuid)
+        return old
 
     def _on_delivery_peer_lost(self, uuid, reason: str) -> None:
         """Delivery-plane eviction hook: a sender worker reported a
@@ -526,6 +601,11 @@ class WorldQLServer:
 
         if self.config.zmq_enabled:
             self.supervisor.spawn("stale-sweep", self._staleness_sweeper)
+
+        if self.sessions is not None:
+            # supervised reclamation: expired parked sessions leave
+            # through the normal teardown even if a sweep pass raises
+            self.supervisor.spawn("session-sweep", self.sessions.sweep)
 
         if self.ticker is not None:
             self.ticker.start()
@@ -748,7 +828,7 @@ class WorldQLServer:
         # sweep run (by which point every handle is already stopped).
         for name in (
             "checkpoint", "stale-sweep", "restored-peer-sweep",
-            "loop-monitor", "overload-governor",
+            "session-sweep", "loop-monitor", "overload-governor",
         ):
             handle = self.supervisor.get(name)
             if handle is not None:

@@ -58,6 +58,7 @@ class ColumnarIngest:
         self.slow_messages = 0  # messages routed through the object path
         self.dropped = 0        # unknown sender / shed / decode-contained
         self.rows = 0           # entity rows staged columnar
+        self.decode_fallbacks = 0  # native decode errors → object path
 
     @property
     def active(self) -> bool:
@@ -77,6 +78,7 @@ class ColumnarIngest:
             "slow_messages": self.slow_messages,
             "dropped": self.dropped,
             "rows": self.rows,
+            "decode_fallbacks": self.decode_fallbacks,
         }
 
     async def process_batch(self, datas: list[bytes], slow_route) -> None:
@@ -89,7 +91,24 @@ class ColumnarIngest:
                 await self._slow(data, slow_route)
             return
         self.batches += 1
-        res = self._wire.decode(datas)
+        try:
+            # entities.decode_native: the PR 11 fast path's loss
+            # boundary — a native decode failure (or an armed chaos
+            # fault) degrades THIS batch to the object route, counted,
+            # with identical semantics
+            failpoints.fire("entities.decode_native")
+            res = self._wire.decode(datas)
+        except Exception:
+            self.decode_fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.inc("sim.decode_fallbacks")
+            logger.exception(
+                "native entity decode failed — batch of %d messages "
+                "degraded to the object path", len(datas),
+            )
+            for data in datas:
+                await self._slow(data, slow_route)
+            return
         run_idx: list[int] = []
         run_senders: list[uuid_mod.UUID] = []
         for i in range(len(datas)):

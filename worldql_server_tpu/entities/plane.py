@@ -74,6 +74,7 @@ import jax.numpy as jnp
 
 from ..ops.tick import EntityState, make_tick_fn
 from ..protocol import entity_wire
+from ..robustness import failpoints
 from ..protocol.types import Entity, Instruction, Message, Vector3
 from ..spatial.quantize import cube_coords_batch
 from ..utils.names import SanitizeError, sanitize_world_name
@@ -295,6 +296,7 @@ class EntityPlane:
         self.column_flips = 0
         self.h2d_full = 0
         self.h2d_scatter = 0
+        self.scatter_fallbacks = 0  # scatter errors → full upload
         self.last_h2d_rows = 0
         self.frames_native = 0
 
@@ -757,24 +759,44 @@ class EntityPlane:
                 self.last_h2d_rows = 0
                 return dev
             if dirty.size <= cap // 2:
-                bucket = max(_SCATTER_MIN_BUCKET, _next_pow2(dirty.size))
-                # pad lanes carry the out-of-range index `cap`; the
-                # scatter drops them on device (mode='drop')
-                idx = np.full(bucket, cap, np.int32)
-                idx[: dirty.size] = dirty
-                rows = np.zeros((bucket, 3), np.float32)
-                rows_v = np.zeros((bucket, 3), np.float32)
-                rows_w = np.zeros(bucket, np.int32)
-                rows_p = np.zeros(bucket, np.int32)
-                rows[: dirty.size] = self._pos[dirty]
-                rows_v[: dirty.size] = self._vel[dirty]
-                rows_w[: dirty.size] = self._wid[dirty]
-                rows_p[: dirty.size] = self._pid[dirty]
-                self._device_dirty[:cap] = False
-                self.h2d_scatter += 1
-                self.last_h2d_rows = int(dirty.size)
-                return self._scatter_fn(dev, idx, rows, rows_v, rows_w,
-                                        rows_p)
+                try:
+                    # entities.scatter: the incremental-H2D loss
+                    # boundary — a scatter failure (or an armed chaos
+                    # fault) degrades to one full-tier upload below,
+                    # counted; the dirty bitmap is cleared only AFTER
+                    # the scatter succeeds, so no row is ever lost to
+                    # a failed partial transfer
+                    failpoints.fire("entities.scatter")
+                    bucket = max(
+                        _SCATTER_MIN_BUCKET, _next_pow2(dirty.size)
+                    )
+                    # pad lanes carry the out-of-range index `cap`; the
+                    # scatter drops them on device (mode='drop')
+                    idx = np.full(bucket, cap, np.int32)
+                    idx[: dirty.size] = dirty
+                    rows = np.zeros((bucket, 3), np.float32)
+                    rows_v = np.zeros((bucket, 3), np.float32)
+                    rows_w = np.zeros(bucket, np.int32)
+                    rows_p = np.zeros(bucket, np.int32)
+                    rows[: dirty.size] = self._pos[dirty]
+                    rows_v[: dirty.size] = self._vel[dirty]
+                    rows_w[: dirty.size] = self._wid[dirty]
+                    rows_p[: dirty.size] = self._pid[dirty]
+                    out = self._scatter_fn(dev, idx, rows, rows_v,
+                                           rows_w, rows_p)
+                    self._device_dirty[:cap] = False
+                    self.h2d_scatter += 1
+                    self.last_h2d_rows = int(dirty.size)
+                    return out
+                except Exception:
+                    self.scatter_fallbacks += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("sim.scatter_fallbacks")
+                    logger.exception(
+                        "incremental H2D scatter failed (%d dirty "
+                        "rows) — degrading to a full-tier upload",
+                        int(dirty.size),
+                    )
         self._device_dirty[:cap] = False
         self._dev_cap = cap
         self.h2d_full += 1
@@ -1138,6 +1160,7 @@ class EntityPlane:
             "column_flips": self.column_flips,
             "h2d_full": self.h2d_full,
             "h2d_scatter": self.h2d_scatter,
+            "scatter_fallbacks": self.scatter_fallbacks,
             "last_h2d_rows": self.last_h2d_rows,
             "index_moves": self.index_moves,
             "index_rows": len(self._sub_refs),

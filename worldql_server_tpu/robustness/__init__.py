@@ -12,7 +12,11 @@ Three pillars (README "Fault injection & supervision"):
 * :mod:`.overload` — the load-survival plane: hysteretic
   ``OK → SHED_LOW → SHED_HIGH → REJECT`` admission governor,
   priority-classed shedding, per-peer token buckets, and
-  tick-deadline degradation (README "Overload & admission control").
+  tick-deadline degradation (README "Overload & admission control");
+* :mod:`.sessions` — client-survival: a dropped peer's
+  subscriptions/entities park for ``--session-ttl`` and a reconnect
+  presenting the handshake-minted token rebinds with zero index churn
+  (README "Sessions & scenarios").
 
 ``resilient`` and ``overload`` import lazily via ``__getattr__``:
 they pull in the spatial/protocol packages, which the failpoint call
@@ -29,6 +33,7 @@ __all__ = [
     "TaskPolicy",
     "ResilientBackend",
     "OverloadGovernor",
+    "SessionStore",
 ]
 
 
@@ -41,4 +46,8 @@ def __getattr__(name):
         from .overload import OverloadGovernor
 
         return OverloadGovernor
+    if name == "SessionStore":
+        from .sessions import SessionStore
+
+        return SessionStore
     raise AttributeError(name)

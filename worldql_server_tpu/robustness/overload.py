@@ -74,6 +74,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import time
 import uuid as uuid_mod
 
@@ -97,6 +98,14 @@ CLASS_GLOBAL = "global"
 CLASS_LOCAL = "local"
 CLASS_SUBSCRIBE = "subscribe"
 CLASS_CONTROL = "control"
+#: handshakes are an admission class too (ISSUE 12): a reconnect storm
+#: must not be able to starve the tick with connect-back work. New
+#: connects shed FIRST (SHED_HIGH+); resumes — peers with parked state
+#: the server is already holding — shed LAST (REJECT only, and even
+#: there a token bucket keeps admitting a bounded trickle so a mass
+#: reconnect drains instead of livelocking).
+CLASS_HS_NEW = "handshake_new"
+CLASS_HS_RESUME = "handshake_resume"
 
 _CLASS_OF = {
     Instruction.LOCAL_MESSAGE: CLASS_LOCAL,
@@ -151,6 +160,8 @@ class OverloadGovernor:
         rss_limit_mb: int = 0,
         hysteresis: float = 0.8,
         sample_interval: float = 0.25,
+        resume_rate: float = 200.0,
+        resume_burst: int = 0,
         metrics=None,
         loop_monitor=None,
         on_evict=None,
@@ -177,6 +188,21 @@ class OverloadGovernor:
         self._buckets: dict[uuid_mod.UUID, list] = {}
         self._evicting: set[uuid_mod.UUID] = set()
 
+        # handshake admission (session continuity, ISSUE 12): the
+        # resume bucket bounds how many parked-state rebinds REJECT
+        # still admits; the hint bucket bounds refusal replies so the
+        # retry-after path can't itself be driven as a reflector.
+        self.resume_rate = float(resume_rate)
+        self.resume_burst = int(resume_burst) if resume_burst else max(
+            1, int(2 * self.resume_rate)
+        )
+        self._resume_bucket = [float(self.resume_burst), self._clock()]
+        self._hint_bucket = [50.0, self._clock()]
+        #: jittered retry-after hints: a storm told to retry at the
+        #: same instant just re-synchronizes itself — the jitter source
+        #: is deliberately unseeded (de-correlating peers is the point)
+        self._jitter = random.Random()
+
         self._state = OK
         self._recover = 0          # consecutive below-state samples
         self._busts = 0            # consecutive over-budget ticks
@@ -195,7 +221,11 @@ class OverloadGovernor:
         self.ticks = 0
         self.transitions = 0
         self.peak_level = 0
-        self.shed = {CLASS_LOCAL: 0, CLASS_GLOBAL: 0}
+        self.shed = {
+            CLASS_LOCAL: 0, CLASS_GLOBAL: 0,
+            CLASS_HS_NEW: 0, CLASS_HS_RESUME: 0,
+        }
+        self.handshakes_admitted = 0
         self.drop_oldest = 0
         self.rate_limited = 0
         self.tier_degradations = 0
@@ -412,6 +442,71 @@ class OverloadGovernor:
         # here — the newest query is the freshest work
         return True
 
+    def admit_handshake(self, resume: bool = False) -> tuple[bool, int]:
+        """One inbound handshake's admission decision (the transports'
+        choke point, BEFORE any connect-back/socket work). Returns
+        ``(admitted, retry_after_ms)`` — the hint is 0 when admitted,
+        jittered when refused so a refused storm de-synchronizes
+        instead of re-arriving as one wave.
+
+        New connects shed before resumes: a fresh peer costs full
+        registration (index rows, entity slots, connect-back socket)
+        while a resume rebinds state the server is ALREADY paying for
+        — refusing resumes leaks exactly the memory the TTL bounds.
+        So new connects shed at SHED_HIGH and above; resumes pass in
+        every state below REJECT, and in REJECT a token bucket
+        (``resume_rate``/s) keeps admitting a bounded trickle so a
+        mass reconnect drains rather than livelocking."""
+        level = _LEVEL[self._state]
+        if resume:
+            if level < _LEVEL[REJECT] or self._take_resume_token():
+                self.handshakes_admitted += 1
+                return True, 0
+            cls = CLASS_HS_RESUME
+        else:
+            if level < _LEVEL[SHED_HIGH]:
+                self.handshakes_admitted += 1
+                return True, 0
+            cls = CLASS_HS_NEW
+        self.shed[cls] += 1
+        if self.metrics is not None:
+            self.metrics.inc(f"overload.shed_{cls}")
+        return False, self._retry_after_ms()
+
+    def _take_resume_token(self) -> bool:
+        if self.resume_rate <= 0:
+            return False
+        now = self._clock()
+        bucket = self._resume_bucket
+        tokens = bucket[0] + (now - bucket[1]) * self.resume_rate
+        bucket[0] = min(tokens, float(self.resume_burst))
+        bucket[1] = now
+        if bucket[0] >= 1.0:
+            bucket[0] -= 1.0
+            return True
+        return False
+
+    def _retry_after_ms(self) -> int:
+        """Jittered backoff hint scaled to the governor state: the
+        deeper the overload, the longer the herd is told to stay
+        away. Uniform jitter in [0.5x, 1.5x) of the base."""
+        base = 250 * (1 << max(0, _LEVEL[self._state] - 1))
+        return max(1, int(base * (0.5 + self._jitter.random())))
+
+    def take_refusal_hint(self) -> bool:
+        """Budget for SENDING a refusal hint where it costs a socket
+        (the ZMQ connect-back): a bounded trickle of hints beats both
+        silence (clients retry blind at full rate) and an unbounded
+        reflector (the refusal path DoSing the refuser)."""
+        now = self._clock()
+        bucket = self._hint_bucket
+        bucket[0] = min(bucket[0] + (now - bucket[1]) * 50.0, 50.0)
+        bucket[1] = now
+        if bucket[0] >= 1.0:
+            bucket[0] -= 1.0
+            return True
+        return False
+
     def coalesce_entities(self) -> bool:
         """SHED_LOW and above: the EntityPlane stages updates of live
         entities last-write-wins per uuid and applies them once per
@@ -513,6 +608,9 @@ class OverloadGovernor:
             "queue_depth": self._queue_depth,
             "shed_local": self.shed[CLASS_LOCAL],
             "shed_global": self.shed[CLASS_GLOBAL],
+            "shed_handshake_new": self.shed[CLASS_HS_NEW],
+            "shed_handshake_resume": self.shed[CLASS_HS_RESUME],
+            "handshakes_admitted": self.handshakes_admitted,
             "drop_oldest": self.drop_oldest,
             "rate_limited": self.rate_limited,
             "peers_tracked": len(self._buckets),

@@ -101,6 +101,13 @@ class ResilientBackend(SpatialBackend):
         self.rebuilds = 0
         self.degraded_batches = 0
         self.failed_over = False
+        #: invoked BEFORE every rebuild/failover restore: dependents
+        #: holding device state derived from the inner backend (the
+        #: entity plane's twin + dirty bitmap) must invalidate it —
+        #: a rebuild mid-sim-tick would otherwise scatter dirty rows
+        #: onto a twin the restore just made stale. The server wires
+        #: EntityPlane.abort_tick here.
+        self.on_rebuild: Callable[[], None] | None = None
 
     # region: failure machinery
 
@@ -125,7 +132,21 @@ class ResilientBackend(SpatialBackend):
         else:
             self._rebuild()
 
+    def _notify_rebuild(self) -> None:
+        """Tell dependents the inner backend (and anything derived
+        from it) is about to be replaced. Must never block the
+        containment path — a raising hook is logged and dropped.
+        May fire from the collect worker thread (collect failures):
+        the wired hook (abort_tick) is idempotent flag-flipping."""
+        if self.on_rebuild is None:
+            return
+        try:
+            self.on_rebuild()
+        except Exception:
+            logger.exception("on_rebuild hook failed — continuing")
+
     def _failover(self, stage: str) -> None:
+        self._notify_rebuild()
         self.failed_over = True
         if self.metrics is not None:
             self.metrics.inc("resilience.failovers")
@@ -143,6 +164,10 @@ class ResilientBackend(SpatialBackend):
         failure escalates toward failover."""
         if self._factory is None:
             return
+        # invalidate dependent device state BEFORE the restore: an
+        # in-flight sim tick's writeback/scatter must not land on a
+        # twin whose backing index this rebuild is replacing
+        self._notify_rebuild()
         try:
             fresh = self._factory()
             worlds, peers, wid, cube, pid = self.mirror.export_rows()

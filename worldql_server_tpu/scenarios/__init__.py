@@ -1,0 +1,30 @@
+"""Adversarial scenario library (ROADMAP 5b, ISSUE 12).
+
+First-class hostile workloads driving a real server over real ZeroMQ:
+``CATALOG`` maps names to :class:`~.engine.Scenario` classes;
+:func:`run_scenario` produces one structured survival + SLO report.
+Consumed by ``python -m worldql_server_tpu.scenarios`` (CI scenario
+smoke), ``bench.py --config 10`` (the perf-gated suite record) and
+tests/test_scenarios.py.
+"""
+
+from .catalog import BattleRoyale, FlashCrowd, GameTick, ReconnectStorm
+from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
+
+CATALOG = {
+    scenario.name: scenario
+    for scenario in (FlashCrowd, BattleRoyale, ReconnectStorm, GameTick)
+}
+
+__all__ = [
+    "CATALOG",
+    "BattleRoyale",
+    "Check",
+    "FlashCrowd",
+    "GameTick",
+    "ReconnectStorm",
+    "Scenario",
+    "ScenarioContext",
+    "format_report",
+    "run_scenario",
+]

@@ -52,6 +52,16 @@ class _NativeKeys:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        # Reference-calibration probe (ROADMAP 5a): newer symbol,
+        # probed separately like the encode below.
+        self._areamap = getattr(lib, "wql_areamap_probe", None)
+        if self._areamap is not None:
+            self._areamap.restype = ctypes.c_int64
+            self._areamap.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_double),
+            ]
         # The fused batch encode is newer than wql_query_keys — probe
         # it separately so a stale library degrades to the two-step
         # path instead of losing the native keys entirely.
@@ -157,6 +167,33 @@ def query_keys(world_ids, positions, cube_size: int, seed: int):
         spatial_keys(world_ids, cubes, seed),
         spatial_keys2(world_ids, cubes, seed),
     )
+
+
+def areamap_probe(n_subs: int, n_queries: int, cube_size: int = 16,
+                  seed: int = 11) -> dict | None:
+    """Reference-class CPU calibration (``wql_areamap_probe``): build
+    a reference-shaped cube→peers hash map of ``n_subs`` rows and
+    resolve ``n_queries`` lookups against it, single native thread —
+    the ``vs_reference`` row in the bench JSON. None when the native
+    library predates the symbol (the bench row degrades to absent,
+    never wrong)."""
+    if _native is None or getattr(_native, "_areamap", None) is None:
+        return None
+    out = np.zeros(3, np.float64)
+    rc = _native._areamap(
+        int(n_subs), int(n_queries), int(cube_size),
+        ctypes.c_uint64(seed & _U64_MASK),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        return None
+    return {
+        "subs": int(n_subs),
+        "queries": int(n_queries),
+        "build_ms": round(float(out[0]), 3),
+        "lookup_ns_per_query": round(float(out[1]), 1),
+        "matched_rows": int(out[2]),
+    }
 
 
 def numpy_query_keys(world_ids, positions, cube_size: int, seed: int):

@@ -107,6 +107,15 @@ class HttpTransport:
             body["delivery"] = delivery
             if delivery["degraded"]:
                 body["status"] = "degraded"
+        # Session continuity (parked/resumed/expired accounting): a
+        # reconnect storm's progress — how many peers are parked and
+        # how fast resumes are landing — is the first thing an
+        # operator needs mid-blip. Absent with --session-ttl 0
+        # (reference-shaped body).
+        ses_fn = getattr(self.server, "sessions_status", None)
+        sessions = ses_fn() if ses_fn is not None else None
+        if sessions is not None:
+            body["sessions"] = sessions
         # Overload governor (admission state + shed accounting): an
         # orchestrator deciding whether to scale out needs the
         # governor's state before anything else. SHED_HIGH/REJECT

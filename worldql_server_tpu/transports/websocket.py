@@ -98,14 +98,24 @@ class WebSocketTransport:
     async def _handle_connection(self, connection) -> None:
         addr = "%s:%s" % (connection.remote_address or ("?", "?"))[:2]
         peer_uuid = uuid_mod.uuid4()
+        provisional_uuid = peer_uuid
         registered = False
+        sessions = getattr(self.server, "sessions", None)
+        peer = None
         try:
-            # Server-assigned UUID handshake (websocket.rs:51-63).
+            # Server-assigned UUID handshake (websocket.rs:51-63). With
+            # sessions enabled the frame also carries a freshly minted
+            # resume token as ``flex`` (``--session-ttl 0`` keeps the
+            # reference-shaped frame byte for byte).
+            token = None
+            if sessions is not None:
+                token = sessions.mint(peer_uuid, "websocket").token
             await connection.send(
                 serialize_message(
                     Message(
                         instruction=Instruction.HANDSHAKE,
                         parameter=str(peer_uuid),
+                        flex=token.encode() if token is not None else None,
                     )
                 )
             )
@@ -118,6 +128,38 @@ class WebSocketTransport:
             if first is None or first.instruction != Instruction.HANDSHAKE:
                 logger.debug("peer %s did not complete handshake", addr)
                 return
+
+            # Session resume: the echo presents a previously minted
+            # token as ``flex`` — the connection rebinds to the parked
+            # peer's UUID and state instead of serving as a new peer.
+            session = None
+            if sessions is not None and first.flex:
+                session = sessions.peek(first.flex)
+
+            # Storm-safe admission (ISSUE 12): classified new-vs-resume
+            # once the echo identifies the peer; a refusal replies with
+            # a jittered retry-after Handshake and closes — before any
+            # registration or fd-handoff work.
+            governor = getattr(self.server, "governor", None)
+            if governor is not None:
+                admitted, retry_ms = governor.admit_handshake(
+                    resume=session is not None
+                )
+                if not admitted:
+                    self.server.metrics.inc("ws.handshakes_refused")
+                    await connection.send(serialize_message(Message(
+                        instruction=Instruction.HANDSHAKE,
+                        parameter=f"retry-after:{retry_ms}",
+                    )))
+                    return
+
+            old = None
+            if session is not None:
+                # the provisional session minted for the assigned UUID
+                # is dead weight once the echo proves a resume
+                sessions.discard(provisional_uuid)
+                old = self.server.prepare_rebind(session.uuid)
+                peer_uuid = session.uuid
 
             def _writable() -> bool:
                 """OPEN + healthy buffer; a peer past the hard limit
@@ -138,7 +180,7 @@ class WebSocketTransport:
                     # next-inbound-frame-delayed
                     self.server.metrics.inc("peers.evicted_overflow")
                     task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
-                        self.server.peer_map.remove(peer_uuid)
+                        self.server.peer_map.remove_if(peer_uuid, peer)
                     )
                     self._evictions.add(task)
                     task.add_done_callback(self._evictions.discard)
@@ -206,7 +248,20 @@ class WebSocketTransport:
                     peer, fd=raw_sock.fileno()
                 ):
                     self._handed_off[peer_uuid] = connection
-            await self.server.peer_map.insert(peer)
+            if session is not None:
+                sessions.resume(session)
+                if old is not None:
+                    # resume over a still-registered stale binding:
+                    # survivor-invisible swap (no Disconnect/Connect)
+                    self.server.peer_map.rebind(peer)
+                else:
+                    await self.server.peer_map.insert(peer)
+                logger.info(
+                    "[%s] websocket session resumed for %s",
+                    addr, peer_uuid,
+                )
+            else:
+                await self.server.peer_map.insert(peer)
             registered = True
 
             while True:
@@ -241,9 +296,20 @@ class WebSocketTransport:
         except Exception:
             logger.exception("websocket connection error: %s", addr)
         finally:
-            self._handed_off.pop(peer_uuid, None)
+            if self._handed_off.get(peer_uuid) is connection:
+                # guard: a resume may have handed a NEWER connection
+                # off under the same uuid — never pop that one
+                self._handed_off.pop(peer_uuid, None)
             if registered:
-                await self.server.peer_map.remove(peer_uuid)
+                # only while this connection is still the CURRENT
+                # binding — a resumed session's fresh binding must not
+                # be evicted by its predecessor's teardown
+                await self.server.peer_map.remove_if(peer_uuid, peer)
+            elif sessions is not None:
+                # never-registered connection: drop the provisional
+                # session minted for the assigned UUID (a resumed
+                # session stays parked for its TTL instead)
+                sessions.discard(provisional_uuid)
 
     def on_peer_removed(self, peer_uuid: uuid_mod.UUID) -> None:
         """PeerMap removal hook: for a peer handed off to a delivery

@@ -16,6 +16,17 @@ registers the peer. Known senders' Handshakes are swallowed
 (outgoing.rs:88-94). ZMQ peers are heartbeat-tracked: the engine's
 staleness sweeper evicts them (outgoing.rs:28-47,132-150), and a failed
 send evicts immediately (outgoing.rs:66-76).
+
+Session continuity (``--session-ttl``, robustness/sessions.py): the
+handshake echo's ``parameter`` carries a minted session token; a
+reconnecting client presents it as ``flex`` on its Handshake and the
+server rebinds the new connect-back to the parked state — valid even
+while the stale old binding is still registered (the server has not
+yet noticed the drop). Handshakes are also a governor admission class
+(``--overload on``): a refused handshake gets a one-shot jittered
+``retry-after:<ms>`` Handshake on its connect-back address (budgeted —
+the refusal path must not become a reflector) and no registration
+work happens at all.
 """
 
 from __future__ import annotations
@@ -215,6 +226,17 @@ class ZmqTransport:
         if message.sender_uuid in self.server.peer_map:
             if message.instruction != Instruction.HANDSHAKE:
                 await self.server.router.handle_message(message)
+                return
+            # known-sender handshakes are swallowed (incoming.rs:56-61)
+            # UNLESS a valid session token rides along: the client is
+            # resuming over a stale binding the server has not yet
+            # noticed dropping — rebind instead of ignoring
+            sessions = getattr(self.server, "sessions", None)
+            if sessions is None or sessions.peek(
+                message.flex, message.sender_uuid
+            ) is None:
+                return
+            await self._handle_handshake(message)
             return
 
         if (
@@ -226,28 +248,61 @@ class ZmqTransport:
         await self._handle_handshake(message)
 
     async def _handle_handshake(self, message: Message) -> None:
-        """Connect-back PUSH + handshake echo + registration
-        (outgoing.rs:81-130)."""
-        if message.sender_uuid in self.server.peer_map:
+        """Connect-back PUSH + handshake echo + registration or
+        session resume (outgoing.rs:81-130). Admission runs BEFORE any
+        connect-back/socket work — a shed handshake costs one decode."""
+        sessions = getattr(self.server, "sessions", None)
+        session = None
+        if sessions is not None:
+            session = sessions.peek(message.flex, message.sender_uuid)
+        if message.sender_uuid in self.server.peer_map and session is None:
             return  # clashing UUID → drop
 
         parameter = message.parameter
         if parameter is None or not _valid_socket_addr(parameter):
             return  # invalid socket address → drop
-
         endpoint = f"tcp://{parameter}"
+
+        # Storm-safe admission (ISSUE 12): new connects shed before
+        # resumes; REJECT still admits resumes up to the governor's
+        # token bucket. Refusals get a budgeted jittered retry-after
+        # hint on the address the client just supplied.
+        governor = getattr(self.server, "governor", None)
+        if governor is not None:
+            admitted, retry_ms = governor.admit_handshake(
+                resume=session is not None
+            )
+            if not admitted:
+                await self._send_refusal(endpoint, retry_ms, governor)
+                return
+
         logger.debug("zeromq peer address: %s", endpoint)
+        peer_uuid = message.sender_uuid
+
+        token = None
+        if sessions is not None:
+            if session is not None:
+                token = session.token
+            else:
+                if sessions.get(peer_uuid) is not None:
+                    # tokenless handshake for a UUID with held state:
+                    # that state belongs to the TOKEN holder — tear it
+                    # down first; this is a brand-new peer
+                    self.server._teardown_peer_state(peer_uuid)
+                token = sessions.mint(peer_uuid, "zeromq").token
 
         push = self.ctx.socket(zmq.PUSH)
         push.setsockopt(zmq.LINGER, 0)
         push.connect(endpoint)
 
-        # Bare handshake echo: nil sender, no parameter (outgoing.rs:108-118).
+        # Handshake echo: nil sender (outgoing.rs:108-118); with
+        # sessions enabled the parameter carries the resume token
+        # (``--session-ttl 0`` keeps the bare no-parameter echo).
         await push.send(
-            serialize_message(Message(instruction=Instruction.HANDSHAKE))
+            serialize_message(
+                Message(instruction=Instruction.HANDSHAKE, parameter=token)
+            )
         )
-
-        peer_uuid = message.sender_uuid
 
         async def send_raw(data: bytes) -> None:
             sock = self._push_sockets.get(peer_uuid)
@@ -257,15 +312,25 @@ class ZmqTransport:
                 failpoints.fire("transport.send")
                 await sock.send(data)
             except Exception:
-                # Failed send ⇒ evict peer (outgoing.rs:66-76).
+                # Failed send ⇒ evict peer (outgoing.rs:66-76) — but
+                # only while THIS binding is still current: a stale
+                # binding's dying send must not evict a resumed one.
                 self.server.metrics.inc("peers.evicted_send_failed")
                 self._drop_socket(peer_uuid)
                 task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
-                    self.server.peer_map.remove(peer_uuid)
+                    self.server.peer_map.remove_if(peer_uuid, peer)
                 )
                 self._evictions.add(task)
                 task.add_done_callback(self._evictions.discard)
                 raise
+
+        old = None
+        if session is not None:
+            # Resume: silently drop the stale old binding (connect-back
+            # socket, delivery shard slot) — parked state untouched —
+            # so the fresh binding below can take its place, possibly
+            # on a different shard.
+            old = self.server.prepare_rebind(peer_uuid)
 
         peer = Peer(
             uuid=peer_uuid,
@@ -286,7 +351,44 @@ class ZmqTransport:
             # single-process mode (or degraded plane): the parent owns
             # the socket, reference semantics unchanged
             self._push_sockets[peer_uuid] = push
-        await self.server.peer_map.insert(peer)
+        if session is not None:
+            sessions.resume(session)
+            if old is not None:
+                # resume over a still-registered stale binding: the
+                # swap is survivor-invisible (no Disconnect/Connect)
+                self.server.peer_map.rebind(peer)
+            else:
+                # parked resume: PeerDisconnect was broadcast at park
+                # time, so the rebind announces like a connect
+                await self.server.peer_map.insert(peer)
+            logger.info(
+                "[%s] zeromq session resumed for %s", parameter, peer_uuid
+            )
+        else:
+            await self.server.peer_map.insert(peer)
+
+    async def _send_refusal(self, endpoint: str, retry_ms: int,
+                            governor) -> None:
+        """One-shot refusal hint: a Handshake whose parameter is
+        ``retry-after:<ms>`` pushed to the refused client's own
+        connect-back address, within the governor's hint budget —
+        beyond it the refusal is silent (cheapest possible shed)."""
+        self.server.metrics.inc("zmq.handshakes_refused")
+        if not governor.take_refusal_hint():
+            return
+        push = self.ctx.socket(zmq.PUSH)
+        push.setsockopt(zmq.LINGER, 200)
+        try:
+            push.connect(endpoint)
+            await push.send(serialize_message(Message(
+                instruction=Instruction.HANDSHAKE,
+                parameter=f"retry-after:{retry_ms}",
+            )))
+            self.server.metrics.inc("zmq.refusal_hints")
+        except Exception:
+            logger.debug("refusal hint to %s failed", endpoint)
+        finally:
+            push.close(linger=200)
 
     def _drop_socket(self, peer_uuid: uuid_mod.UUID) -> None:
         sock = self._push_sockets.pop(peer_uuid, None)
