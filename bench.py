@@ -1012,6 +1012,14 @@ def bench_config5(args) -> dict:
         sweep = _sweep_config5(tpu, cpu, rng, sub_positions, sub_world_ids,
                                peers, args)
 
+    # Temporal-coherence low-churn sustained pass (ROADMAP 2): runs
+    # LAST among the tpu probes — its index churn would desync the CPU
+    # twin the parity probes above compare against.
+    delta_probe = _delta_probe(
+        tpu, peers, sub_positions, sub_world_ids, batches[0], args
+    )
+    log(f"delta ticks: {delta_probe}")
+
     # Headline: the ENGINE-side tick (host encode + H2D enqueue +
     # device compute, link excluded) — the pair probe shows this
     # tunnel hard-serializes independent dispatches (pair_overlap_ratio
@@ -1056,6 +1064,26 @@ def bench_config5(args) -> dict:
         log(f"smoke: staged encode {path_probe['staged']['encode_ms']}"
             f" ms < list encode {path_probe['list']['encode_ms']} ms; "
             f"retraces {retraces}")
+        # ISSUE 13 gates: delta ticks replayed the clean majority of a
+        # low-churn pass, lane-for-lane identical to full recompute,
+        # and the per-tick device wall dropped >= 5x vs the full
+        # recompute path at identical shapes (the acceptance bar;
+        # measured 30x at smoke shapes on the 1-core container)
+        assert delta_probe["parity"], \
+            "smoke: delta ticks diverged from full recompute"
+        assert delta_probe["reuse_fraction"] > 0.8, (
+            "smoke: delta reuse collapsed: "
+            f"{delta_probe['reuse_fraction']}"
+        )
+        assert delta_probe["speedup"] >= 5.0, (
+            "smoke: delta device wall not >= 5x below full recompute: "
+            f"{delta_probe['delta_update_ms']} vs "
+            f"{delta_probe['rebuild_ms']} ms"
+        )
+        log(f"smoke: delta reuse {delta_probe['reuse_fraction']}  "
+            f"update {delta_probe['delta_update_ms']} ms vs rebuild "
+            f"{delta_probe['rebuild_ms']} ms "
+            f"({delta_probe['speedup']}x)")
     return {
         "metric": "local_fanout_engine_tick_ms",
         "value": round(engine_tick_ms, 3),
@@ -1103,6 +1131,10 @@ def bench_config5(args) -> dict:
             "retrace_delta": retrace_delta,
             "precompile": pc_stats,
         },
+        # temporal-coherence pass (ROADMAP 2): reuse_fraction +
+        # delta_update_ms vs rebuild_ms at identical shapes; the
+        # acceptance bar is speedup >= 5 on the full-shape pass
+        "delta": delta_probe,
         "link_rtt_ms": round(rtt_ms, 3),
         "device_compute_ms": round(compute_ms, 4),
         # the engine's own rate, net of the tunnel: what a deployment
@@ -1676,6 +1708,154 @@ def _parity_check(tpu, cpu, peers, batch, samples: int = 64) -> None:
         want_ids = {tpu._peer_ids[p] for p in want}
         assert got == want_ids, f"parity diverged at query {i}"
     log(f"parity check: {samples} sampled queries agree with CPU reference")
+
+
+def _delta_parity_check(args) -> bool:
+    """Dual-backend lane-for-lane parity of delta ticks vs full
+    recompute over a churned schedule (small shapes; the randomized
+    property suite in tests/test_delta_ticks.py is the exhaustive
+    version — this is the bench-smoke pin that the gate asserts)."""
+    from worldql_server_tpu.spatial.quantize import cube_coords_batch
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+    bes = [TpuSpatialBackend(16), TpuSpatialBackend(16)]
+    assert bes[0].configure_delta_ticks("on")
+    n, mq = 512, 128
+    peers = [uuid_mod.UUID(int=i + 1) for i in range(n)]
+    pos = np.random.default_rng(5).uniform(-300, 300, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    for be in bes:
+        be.bulk_add_subscriptions("w", peers, cubes)
+        be.flush()
+    qrng = np.random.default_rng(7)
+    q_pos = pos[qrng.integers(0, n, mq)].copy()
+    wid = np.zeros(mq, np.int32)
+    sid = np.full(mq, -1, np.int32)
+    repl = np.zeros(mq, np.int8)
+    crng = np.random.default_rng(11)
+    for _ in range(12):
+        rows = np.unique(crng.integers(0, mq, 4))
+        q_pos[rows] = pos[crng.integers(0, n, rows.size)]
+        mv = np.unique(crng.integers(0, n, 4))
+        new_cubes = cube_coords_batch(
+            crng.uniform(-300, 300, (mv.size, 3)), 16
+        )
+        for be in bes:
+            be.bulk_move_subscriptions(
+                "w", [peers[i] for i in mv], cubes[mv],
+                [peers[i] for i in mv], new_cubes,
+            )
+        cubes[mv] = new_cubes
+        outs = [
+            be.collect_local_batch(
+                be.dispatch_staged_batch(wid, q_pos, sid, repl)
+            )
+            for be in bes
+        ]
+        if outs[0] != outs[1]:
+            return False
+    return bes[0].delta_reused > 0
+
+
+def _delta_probe(tpu, peers, sub_positions, sub_world_ids, batch,
+                 args) -> dict:
+    """Low-churn sustained delta pass (ROADMAP 2 acceptance): the SAME
+    query batch re-dispatches tick over tick with ~1% fresh query rows
+    and ~0.05% index churn per tick — the steady-MMO regime — once
+    with delta ticks off (full recompute: every tick re-resolves all M
+    queries) and once on (only the dirty partition enters the device
+    batch; clean queries replay). ``delta_update_ms`` vs ``rebuild_ms``
+    is the mean per-tick device wall (compute + H2D launch) of each
+    mode at IDENTICAL shapes; the acceptance bar is a >= 5x drop.
+    Runs LAST in config 5 — the index churn it applies would desync
+    the earlier CPU-reference parity probes."""
+    from worldql_server_tpu.protocol.types import Replication
+    from worldql_server_tpu.spatial.quantize import cube_coords_batch
+
+    ticks = 8 if args.quick else 24
+    warm = 3
+    world_ids, positions, sender_ids, _ = batch
+    m = len(world_ids)
+    wid_col = np.fromiter(
+        (tpu._world_ids.get(f"world_{w}", -1) for w in world_ids),
+        np.int32, count=m,
+    )
+    sid_col = np.fromiter(
+        (tpu._peer_ids.get(peers[s], -1) for s in sender_ids),
+        np.int32, count=m,
+    )
+    repl_col = np.full(m, int(Replication.EXCEPT_SELF), np.int8)
+    n_subs = len(sub_positions)
+    churn_q = max(2, m // 100)
+    churn_s = max(2, n_subs // 2000)
+    sub_cubes = cube_coords_batch(sub_positions, tpu.cube_size)
+
+    def run(mode):
+        tpu.configure_delta_ticks(mode)
+        rng = np.random.default_rng(4242)
+        pos_col = np.ascontiguousarray(positions, np.float64).copy()
+        walls, reuse, dirty, churn_rows = [], [], [], []
+        for t in range(warm + ticks):
+            rows = np.unique(rng.integers(0, m, churn_q))
+            pos_col[rows] = sub_positions[
+                rng.integers(0, n_subs, rows.size)
+            ]
+            mv = np.unique(rng.integers(0, n_subs, churn_s))
+            new_cubes = cube_coords_batch(
+                make_positions(rng, mv.size), tpu.cube_size
+            )
+            for w in np.unique(sub_world_ids[mv]):
+                sel = sub_world_ids[mv] == w
+                tpu.bulk_move_subscriptions(
+                    f"world_{w}",
+                    [peers[i] for i in mv[sel]], sub_cubes[mv[sel]],
+                    [peers[i] for i in mv[sel]], new_cubes[sel],
+                )
+            sub_cubes[mv] = new_cubes
+            tpu.collect_local_batch(tpu.dispatch_staged_batch(
+                wid_col, pos_col, sid_col, repl_col
+            ))
+            if t < warm:
+                continue  # sub-tier compiles land in the warmup
+            timing = tpu.last_device_timing
+            walls.append(
+                timing.get("compute_ms", 0.0) + timing.get("h2d_ms", 0.0)
+            )
+            if mode == "on":
+                st = tpu.last_delta_stats
+                reuse.append(st["reused"] / max(st["batch"], 1))
+                dirty.append(st["dirty_cubes"])
+                churn_rows.append(st["churn_rows"])
+        return walls, reuse, dirty, churn_rows
+
+    rebuild_walls, _, _, _ = run("off")
+    scat0, sort0 = tpu.delta_sync_scatters, tpu.delta_sync_sorts
+    update_walls, reuse, dirty, churn_rows = run("on")
+    tpu.configure_delta_ticks("off")  # leave the shared backend as built
+    # medians: the per-tick wall at steady state — a residual one-off
+    # tier compile (new dirty-count pow2 mid-pass) must not masquerade
+    # as recurring device work in either mode
+    rebuild_ms = float(np.median(rebuild_walls))
+    update_ms = float(np.median(update_walls))
+    reuse_fraction = float(np.mean(reuse)) if reuse else 0.0
+    return {
+        "ticks": ticks,
+        "churn_queries_per_tick": churn_q,
+        "churn_subs_per_tick": churn_s,
+        "reuse_fraction": round(reuse_fraction, 4),
+        # the CI perf-gate leaf (bench_diff direction-aware,
+        # percentage-scaled so a collapse clears the --min-abs floor)
+        "reuse_pct": round(reuse_fraction * 100.0, 2),
+        "dirty_cubes": int(np.mean(dirty)) if dirty else 0,
+        "churn_rows_per_tick": int(np.mean(churn_rows)) if churn_rows
+        else 0,
+        "delta_update_ms": round(update_ms, 4),
+        "rebuild_ms": round(rebuild_ms, 4),
+        "speedup": round(rebuild_ms / max(update_ms, 1e-9), 2),
+        "sync_scatters": tpu.delta_sync_scatters - scat0,
+        "sync_sorts": tpu.delta_sync_sorts - sort0,
+        "parity": 1 if _delta_parity_check(args) else 0,
+    }
 
 
 # --------------------------------------------------------------------

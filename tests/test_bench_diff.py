@@ -163,6 +163,26 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     )
     assert main([str(baseline), str(regressed), *gate]) == 1
 
+    # the ISSUE 13 delta-tick gate: the baseline's config-5 delta
+    # block carries the STABLE reuse leaves (the per-tick ms walls and
+    # their ratio are machine-speed bound and pruned on purpose), and
+    # a collapsed reuse fraction flags on its own — a regression that
+    # silently reverts every tick to full recompute fails the build
+    delta_block = by_config[5]["delta"]
+    assert "reuse_pct" in delta_block and delta_block["parity"] == 1
+    for key in ("delta_update_ms", "rebuild_ms", "speedup"):
+        assert key not in delta_block, key
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 5:
+            rec["delta"]["reuse_pct"] = 0.0
+            rec["delta"]["reuse_fraction"] = 0.0
+    no_reuse = tmp_path / "no_reuse.json"
+    no_reuse.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(no_reuse), *gate]) == 1
+
     # the ISSUE 11 ingest gate: a collapsed columnar throughput flags
     # ON ITS OWN under the same invocation (drop ratio measured against
     # the new value, so threshold 100 == "old more than 2x new")

@@ -824,7 +824,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 17
+    assert len(names) >= 18
     assert names == {
         "async-dangling-task",
         "unbounded-ingest",
@@ -837,6 +837,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "jax-jit-in-loop",
         "jax-traced-branch",
         "full-fetch-on-tick",
+        "full-rebuild-on-tick",
         "per-query-python-loop",
         "host-sync-in-sim-tick",
         "store-on-loop",
@@ -1318,6 +1319,87 @@ def test_unguarded_handshake_pragma_suppresses():
         src, relpath="worldql_server_tpu/transports/zeromq.py",
         select="unguarded-handshake",
     ) == []
+
+
+# endregion
+
+
+# region: full-rebuild-on-tick
+
+ENTITIES_MODULE = "worldql_server_tpu/entities/plane.py"
+
+
+def test_full_rebuild_fires_on_delta_sort_in_sync():
+    src = """
+    class Backend:
+        def _sync_delta(self):
+            self._delta_bundle = {
+                "dev": self._sort_delta(self._delta_buf, 64),
+            }
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-rebuild-on-tick") == [
+        ("full-rebuild-on-tick", 5)
+    ]
+
+
+def test_full_rebuild_fires_on_full_sim_tick_from_dispatch():
+    src = """
+    class EntityPlane:
+        def dispatch_tick(self):
+            return self._dispatch_tick_full(self._cap, 0.0)
+    """
+    assert violations(src, relpath=ENTITIES_MODULE,
+                      select="full-rebuild-on-tick") == [
+        ("full-rebuild-on-tick", 4)
+    ]
+
+
+def test_full_rebuild_fires_on_stale_base_upload_in_flush():
+    src = """
+    class Backend:
+        def flush(self):
+            self._upload_stale_base()
+            self._compact_sync()
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-rebuild-on-tick") == [
+        ("full-rebuild-on-tick", 4), ("full-rebuild-on-tick", 5)
+    ]
+
+
+def test_full_rebuild_quiet_off_tick_path_and_other_modules():
+    src = """
+    class Backend:
+        def _swap_compaction(self):
+            # maintenance path, not a tick-path function
+            self._upload_stale_base()
+
+        def wait_compaction(self):
+            self._compact_sync()
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-rebuild-on-tick") == []
+    src2 = """
+    class Anything:
+        def flush(self):
+            self._upload_stale_base()
+    """
+    # a module with no delta path is out of scope
+    assert violations(
+        src2, relpath="worldql_server_tpu/engine/router.py",
+        select="full-rebuild-on-tick",
+    ) == []
+
+
+def test_full_rebuild_pragma_suppresses():
+    src = """
+    class Backend:
+        def flush(self):
+            self._upload_stale_base()  # wql: allow(full-rebuild-on-tick)
+    """
+    assert violations(src, relpath=TICK_MODULE,
+                      select="full-rebuild-on-tick") == []
 
 
 # endregion

@@ -1,0 +1,603 @@
+"""Delta ticks (ISSUE 13): temporal coherence, parity-pinned.
+
+The contract under test: with ``delta_ticks`` armed, every observable
+result — query fan-out lists lane for lane, entity positions/cubes/
+targets, frames on the wire — is IDENTICAL to the full-recompute
+path across arbitrary churn schedules, while the engine provably
+reuses the clean majority (and the device does sublinear work). The
+off mode stays byte-for-byte the pre-delta pipeline.
+"""
+
+import asyncio
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.metrics import Metrics
+from worldql_server_tpu.engine.peers import Peer, PeerMap
+from worldql_server_tpu.engine.router import Router
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.engine.ticker import TickBatcher
+from worldql_server_tpu.entities.plane import EntityPlane
+from worldql_server_tpu.protocol import deserialize_message
+from worldql_server_tpu.protocol.types import (
+    Entity, Instruction, Message, Vector3,
+)
+from worldql_server_tpu.robustness import failpoints
+from worldql_server_tpu.robustness.overload import OverloadGovernor
+from worldql_server_tpu.storage.memory_store import MemoryRecordStore
+from worldql_server_tpu.robustness.resilient import ResilientBackend
+from worldql_server_tpu.spatial.delta_ticks import (
+    TemporalCoherence, row_signatures,
+)
+from worldql_server_tpu.spatial.quantize import cube_coords_batch
+from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+
+from tests.client_util import ZmqClient, free_port
+from tests.prom_parser import validate_exposition
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# region: TemporalCoherence units
+
+
+def test_coherence_dirty_sequence_is_exact():
+    co = TemporalCoherence()
+    co.note_key(100)
+    seq_then = co.seq
+    co.store(h1=7, h2=77, key=100, seq=seq_then, targets=("a",))
+    # clean cube at the entry's sequence: replays
+    reused, dirty = co.partition([7], [77])
+    assert reused == [["a"]] and dirty == []
+    # a LATER mutation of the cube invalidates exactly that entry
+    co.note_key(100)
+    reused, dirty = co.partition([7], [77])
+    assert reused == [None] and dirty == [0]
+    # a mutation of a DIFFERENT cube does not
+    co.store(h1=7, h2=77, key=100, seq=co.seq, targets=("a",))
+    co.note_key(999)
+    reused, dirty = co.partition([7], [77])
+    assert reused == [["a"]] and dirty == []
+
+
+def test_coherence_h2_mismatch_and_floor_reject():
+    co = TemporalCoherence()
+    co.store(h1=5, h2=50, key=1, seq=co.seq, targets=())
+    # 128-bit verify: an h1 collision with a different h2 recomputes
+    assert co.partition([5], [51]) == ([None], [0])
+    # wholesale invalidation rejects racing inserts stamped before it
+    stale_seq = co.seq
+    co.invalidate_all()
+    co.store(h1=5, h2=50, key=1, seq=stale_seq, targets=())
+    assert co.partition([5], [50]) == ([None], [0])
+
+
+def test_coherence_cache_bound_resets_not_grows():
+    co = TemporalCoherence(max_entries=4)
+    for i in range(10):
+        co.store(h1=i, h2=i, key=i, seq=co.seq, targets=())
+    assert len(co.cache) <= 4
+    assert co.cache_resets >= 1
+
+
+def test_row_signatures_fold_every_column():
+    wid = np.array([3], np.int32)
+    pos = np.array([[1.0, 2.0, 3.0]])
+    sid = np.array([9], np.int32)
+    repl = np.array([0], np.int8)
+    base = row_signatures(wid, pos, sid, repl)
+    for cols in (
+        (wid + 1, pos, sid, repl),
+        (wid, pos + 1e-12, sid, repl),
+        (wid, pos, sid + 1, repl),
+        (wid, pos, sid, repl + 1),
+    ):
+        other = row_signatures(*cols)
+        assert (base[0] != other[0]).all() or (base[1] != other[1]).all()
+    again = row_signatures(wid, pos.copy(), sid, repl)
+    assert base[0][0] == again[0][0] and base[1][0] == again[1][0]
+
+
+# endregion
+
+# region: query-path parity property
+
+
+def _staged(q_pos, sid, m):
+    return (
+        np.zeros(m, np.int32),
+        np.ascontiguousarray(q_pos[:m]),
+        sid[:m],
+        np.zeros(m, np.int8),
+    )
+
+
+def test_delta_query_parity_under_randomized_churn():
+    """>= 200 ticks of randomized churn — moves, joins, leaves, peer
+    removals, query churn, forced query-tier changes — keep the delta
+    path lane-for-lane identical to full recompute, with reuse and
+    the O(K) tombstone scatter provably firing."""
+    rng = np.random.default_rng(1234)
+    n, m = 256, 64
+    bes = [
+        TpuSpatialBackend(16, compact_threshold=64),
+        TpuSpatialBackend(16, compact_threshold=64),
+    ]
+    assert bes[0].configure_delta_ticks("auto")
+    peers = [uuid.UUID(int=i + 1) for i in range(n)]
+    pos = rng.uniform(-250, 250, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    live = np.ones(n, bool)
+    for be in bes:
+        be.bulk_add_subscriptions("w", peers, cubes)
+        be.flush()
+    q_pos = pos[rng.integers(0, n, m)].copy()
+    sid = np.full(m, -1, np.int32)
+
+    for tick in range(210):
+        op = rng.random()
+        if op < 0.22:  # moves through the base+delta path
+            mv = np.unique(rng.integers(0, n, int(rng.integers(1, 5))))
+            mv = mv[live[mv]]
+            if mv.size:
+                new_cubes = cube_coords_batch(
+                    rng.uniform(-250, 250, (mv.size, 3)), 16
+                )
+                for be in bes:
+                    be.bulk_move_subscriptions(
+                        "w", [peers[i] for i in mv], cubes[mv],
+                        [peers[i] for i in mv], new_cubes,
+                    )
+                cubes[mv] = new_cubes
+        elif op < 0.36:  # leaves (tombstones)
+            i = int(rng.integers(0, n))
+            if live[i]:
+                for be in bes:
+                    be.remove_subscription(
+                        "w", peers[i], tuple(int(c) for c in cubes[i])
+                    )
+                live[i] = False
+        elif op < 0.48:  # joins (delta appends)
+            dead = np.flatnonzero(~live)
+            if dead.size:
+                i = int(dead[0])
+                new_cube = cube_coords_batch(
+                    rng.uniform(-250, 250, (1, 3)), 16
+                )
+                for be in bes:
+                    be.bulk_add_subscriptions("w", [peers[i]], new_cube)
+                cubes[i] = new_cube[0]
+                live[i] = True
+        elif op < 0.56:  # wholesale peer removal
+            i = int(rng.integers(0, n))
+            if live[i]:
+                for be in bes:
+                    be.remove_peer(peers[i])
+                live[i] = False
+        elif op < 0.72:  # query churn (fresh positions)
+            rows = rng.integers(0, m, 3)
+            q_pos[rows] = rng.uniform(-250, 250, (3, 3))
+        # forced tier changes: three fixed batch sizes (pow2 tiers)
+        mm = (m, 32, 16)[int(rng.integers(0, 12)) % 3 if tick % 7 == 0
+                         else 0]
+        cols = _staged(q_pos, sid, mm)
+        outs = [
+            be.collect_local_batch(be.dispatch_staged_batch(*cols))
+            for be in bes
+        ]
+        assert outs[0] == outs[1], f"tick {tick} diverged"
+    on = bes[0]
+    assert on.delta_reused > 0, "reuse never fired"
+    assert on.delta_sync_scatters > 0, "tombstone scatter never fired"
+    assert on.delta_recomputed > 0
+    # the off backend never touched the coherence machinery
+    assert bes[1].delta_reused == 0 and bes[1].delta_recomputed == 0
+
+
+def test_delta_off_is_pinned_to_the_pre_delta_pipeline():
+    """--delta-ticks off: the handle shapes, counters and coherence
+    state are untouched — byte-for-byte the old dispatch pipeline."""
+    be = TpuSpatialBackend(16)
+    peers = [uuid.UUID(int=i + 1) for i in range(8)]
+    pos = np.random.default_rng(0).uniform(-50, 50, (8, 3))
+    be.bulk_add_subscriptions("w", peers, cube_coords_batch(pos, 16))
+    be.flush()
+    cols = _staged(pos, np.full(8, -1, np.int32), 8)
+    handle = be.dispatch_staged_batch(*cols)
+    assert handle[1][0] in ("csr", "dense")  # never a "tc" handle
+    be.collect_local_batch(handle)
+    assert be.delta_reused == be.delta_recomputed == 0
+    assert not be._coherence.cache and not be._coherence.dirty
+    assert be.delta_sync_scatters == 0
+
+
+def test_sharded_backend_conservatively_declines_delta():
+    from worldql_server_tpu.parallel.sharded_backend import (
+        ShardedTpuSpatialBackend,
+    )
+
+    assert ShardedTpuSpatialBackend.supports_delta_ticks(
+        object.__new__(ShardedTpuSpatialBackend)
+    ) is False
+
+
+# endregion
+
+# region: resilience (rebuild/failover mid-run)
+
+
+def test_delta_parity_through_resilience_rebuild_and_failover():
+    """A mid-run ResilientBackend rebuild — and later a full failover
+    to the CPU mirror — keeps the delta wrapper's results identical
+    to a full-recompute wrapper fed the same mutations and the same
+    fault schedule (the symmetric x2 failpoint hits both)."""
+    rng = np.random.default_rng(77)
+    n, m = 128, 32
+
+    def make(mode):
+        def factory():
+            inner = TpuSpatialBackend(16)
+            inner.configure_delta_ticks(mode)
+            return inner
+
+        return ResilientBackend(
+            factory(), factory=factory, failover_after=3,
+        )
+
+    bes = [make("on"), make("off")]
+    peers = [uuid.UUID(int=i + 1) for i in range(n)]
+    pos = rng.uniform(-150, 150, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    for be in bes:
+        be.bulk_add_subscriptions("w", peers, cubes)
+        be.flush()
+    q_pos = pos[rng.integers(0, n, m)].copy()
+    sid = np.full(m, -1, np.int32)
+    failpoints.registry.reset()
+    try:
+        for tick in range(30):
+            if tick == 10:
+                # one dispatch failure EACH → both wrappers rebuild
+                failpoints.registry.set("backend.dispatch", "error:1:x2")
+            if tick == 20:
+                # sustained failures → both fail over to the mirror
+                failpoints.registry.set("backend.dispatch", "error:1")
+            if tick in (12, 22):  # churn lands on the fresh inner/mirror
+                mv = np.arange(5)
+                new_cubes = cube_coords_batch(
+                    rng.uniform(-150, 150, (5, 3)), 16
+                )
+                for be in bes:
+                    be.bulk_move_subscriptions(
+                        "w", [peers[i] for i in mv], cubes[mv],
+                        [peers[i] for i in mv], new_cubes,
+                    )
+                cubes[mv] = new_cubes
+            cols = _staged(q_pos, sid, m)
+            outs = [
+                be.collect_local_batch(
+                    be.dispatch_staged_batch(*cols, fallback=None)
+                )
+                for be in bes
+            ]
+            assert outs[0] == outs[1], f"tick {tick} diverged"
+    finally:
+        failpoints.registry.reset()
+    assert bes[0].rebuilds >= 1 and bes[0].failed_over
+    assert bes[1].rebuilds >= 1 and bes[1].failed_over
+
+
+# endregion
+
+# region: overload forced-state tick (ticker-level parity)
+
+
+class _TickerHarness:
+    def __init__(self, delta: str):
+        config = Config()
+        self.backend = TpuSpatialBackend(config.sub_region_size)
+        self.backend.configure_delta_ticks(delta)
+        self.peer_map = PeerMap(on_remove=self.backend.remove_peer)
+        self.gov = OverloadGovernor(max_batch=64, metrics=Metrics())
+        from worldql_server_tpu.engine.staging import QueryStaging
+
+        self.ticker = TickBatcher(
+            self.backend, self.peer_map, 10.0, max_batch=64,
+            governor=self.gov, staging=QueryStaging(self.backend),
+        )
+        self.router = Router(
+            self.peer_map, self.backend, MemoryRecordStore(config),
+            ticker=self.ticker,
+        )
+        self.inboxes = {}
+
+    async def add_peer(self):
+        peer_uuid = uuid.uuid4()
+        inbox = self.inboxes.setdefault(peer_uuid, [])
+
+        async def send_raw(data):
+            inbox.append(deserialize_message(data))
+
+        await self.peer_map.insert(
+            Peer(peer_uuid, "loopback", send_raw, "test")
+        )
+        return peer_uuid
+
+
+def test_delta_parity_through_forced_overload_tick():
+    """An `overload` forced-state tick (governor driven to SHED_HIGH
+    via the deterministic failpoint) admits/sheds identically on the
+    delta and full paths — delivered frames match peer for peer."""
+
+    async def scenario():
+        hs = [_TickerHarness("on"), _TickerHarness("off")]
+        pos = Vector3(1.0, 1.0, 1.0)
+        peer_ids = []
+        for h in hs:
+            a = await h.add_peer()
+            b = await h.add_peer()
+            peer_ids.append((a, b))
+            for p in (a, b):
+                await h.router.handle_message(Message(
+                    instruction=Instruction.AREA_SUBSCRIBE,
+                    sender_uuid=p, world_name="world", position=pos,
+                ))
+        failpoints.registry.reset()
+        try:
+            for phase in ("ok", "shed_high", "ok"):
+                failpoints.registry.set(
+                    "overload.force_state", f"state:{phase}"
+                )
+                for h in hs:
+                    for _ in range(4):
+                        await h.router.handle_message(Message(
+                            instruction=Instruction.LOCAL_MESSAGE,
+                            sender_uuid=peer_ids[hs.index(h)][0],
+                            world_name="world", position=pos,
+                            parameter=phase,
+                        ))
+                    await h.ticker.flush()
+            counts = []
+            for h, (a, b) in zip(hs, peer_ids):
+                got = [
+                    (m.parameter, m.instruction)
+                    for m in h.inboxes[b]
+                ]
+                counts.append(got)
+            assert counts[0] == counts[1]
+            assert hs[0].backend.delta_reused > 0
+        finally:
+            failpoints.registry.reset()
+
+    run(scenario())
+
+
+# endregion
+
+# region: entity-plane parity property
+
+
+def _ent_msg(sender, entities, parameter=None):
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+        world_name="w", entities=entities, parameter=parameter,
+    )
+
+
+def _vel_flex(v):
+    return np.asarray(v, np.float32).astype("<f4").tobytes()
+
+
+def test_delta_sim_parity_under_randomized_churn():
+    """>= 200 sim ticks of randomized churn — client updates, joins,
+    leaves, movers, a forced capacity-tier change, and a mid-run
+    abort — keep the delta plane's live targets, positions, cubes and
+    frame count identical to the full-recompute plane."""
+    rng = np.random.default_rng(31)
+    owner = uuid.UUID(int=4242)
+
+    def make(mode):
+        be = TpuSpatialBackend(16)
+        return EntityPlane(
+            be, None, cube_size=16, k=4, dt=0.05, bounds=400.0,
+            delta_ticks=mode,
+        )
+
+    planes = [make("on"), make("off")]
+    ids = [uuid.uuid4() for _ in range(220)]
+    pos = rng.uniform(-350, 350, (220, 3))
+    vel = np.zeros((220, 3), np.float32)
+    vel[:12] = rng.uniform(-25, 25, (12, 3))  # a few movers, rest idle
+    alive = set(range(200))
+    for pl in planes:
+        pl.ingest(_ent_msg(owner, [
+            Entity(uuid=ids[i], world_name="w",
+                   position=Vector3(*pos[i]),
+                   flex=_vel_flex(vel[i]) if vel[i].any() else None)
+            for i in sorted(alive)
+        ]))
+
+    def tick(pl):
+        handle = pl.dispatch_tick()
+        assert handle is not None
+        return pl.apply(pl.collect_tick(handle))
+
+    next_id = 200
+    for t in range(205):
+        op = rng.random()
+        if op < 0.15 and alive:  # client position update
+            i = sorted(alive)[int(rng.integers(0, len(alive)))]
+            p = rng.uniform(-350, 350, 3)
+            for pl in planes:
+                pl.ingest(_ent_msg(owner, [Entity(
+                    uuid=ids[i], world_name="w", position=Vector3(*p),
+                )]))
+        elif op < 0.25 and alive:  # leave
+            i = sorted(alive)[int(rng.integers(0, len(alive)))]
+            alive.discard(i)
+            for pl in planes:
+                pl.ingest(_ent_msg(owner, [Entity(uuid=ids[i])],
+                                   parameter="entity.remove"))
+        elif op < 0.35 and next_id < 220:  # join
+            i = next_id
+            next_id += 1
+            alive.add(i)
+            for pl in planes:
+                pl.ingest(_ent_msg(owner, [Entity(
+                    uuid=ids[i], world_name="w",
+                    position=Vector3(*pos[i]),
+                )]))
+        if t == 100:
+            # mid-run abort: the in-flight tick drops on BOTH planes
+            for pl in planes:
+                h = pl.dispatch_tick()
+                assert h is not None
+                pl.abort_tick()
+        frames = [tick(pl) for pl in planes]
+        cap = planes[0]._cap
+        assert planes[0]._cap == planes[1]._cap
+        live = planes[0]._live[:cap]
+        assert (live == planes[1]._live[:cap]).all()
+        assert np.array_equal(
+            planes[0]._pos[:cap][live], planes[1]._pos[:cap][live]
+        ), f"tick {t}: positions diverged"
+        assert np.array_equal(
+            planes[0]._cube[:cap][live], planes[1]._cube[:cap][live]
+        ), f"tick {t}: cubes diverged"
+        assert len(frames[0]) == len(frames[1]), f"tick {t}"
+        wires = [sorted(
+            getattr(f, "wire", None) or b"" for f, _ in fr
+        ) for fr in frames]
+        assert wires[0] == wires[1], f"tick {t}: frame bytes diverged"
+    on = planes[0]
+    assert on.delta_sim_ticks > 100
+    assert on.delta_reused > 0
+    assert on.delta_mispredicts == 0
+    assert planes[1].delta_sim_ticks == 0
+
+
+def test_delta_sim_tier_change_falls_back_and_recovers():
+    owner = uuid.UUID(int=9)
+    be = TpuSpatialBackend(16)
+    pl = EntityPlane(be, None, cube_size=16, k=4, delta_ticks="on")
+    rng = np.random.default_rng(2)
+    pl.ingest(_ent_msg(owner, [
+        Entity(uuid=uuid.uuid4(), world_name="w",
+               position=Vector3(*rng.uniform(-100, 100, 3)))
+        for _ in range(40)
+    ]))
+
+    def tick():
+        return pl.apply(pl.collect_tick(pl.dispatch_tick()))
+
+    tick()  # cold → full
+    tick()  # replay
+    assert pl.delta_sim_ticks >= 1
+    before_full = pl.full_sim_ticks
+    # registration burst past the 256 tier → grow → full fallback
+    pl.ingest(_ent_msg(owner, [
+        Entity(uuid=uuid.uuid4(), world_name="w",
+               position=Vector3(*rng.uniform(-100, 100, 3)))
+        for _ in range(300)
+    ]))
+    tick()
+    assert pl._cap > 256
+    assert pl.full_sim_ticks == before_full + 1
+    tick()  # and delta resumes at the new tier
+    assert pl.last_delta_stats.get("fallback") == ""
+
+
+def test_non_pow2_cube_size_disables_entity_delta():
+    be = TpuSpatialBackend(12)
+    pl = EntityPlane(be, None, cube_size=12, delta_ticks="on")
+    assert not pl._delta_ticks
+
+
+# endregion
+
+# region: e2e — mostly-idle world over real ZMQ shows reuse in /metrics
+
+
+def test_e2e_mostly_idle_world_reuse_fraction_in_metrics():
+    """Boot the real server (tpu backend + entity sim + delta auto),
+    park a mostly-idle world on it over real ZMQ, and read
+    ``wql_delta_reuse_fraction > 0.8`` from a strict-parsed /metrics
+    scrape — the ISSUE 13 observability acceptance."""
+
+    async def scenario():
+        http_port = free_port()
+        config = Config(
+            store_url="memory://",
+            http_port=http_port,
+            ws_enabled=False,
+            zmq_server_port=free_port(),
+            zmq_server_host="127.0.0.1",
+        )
+        config.spatial_backend = "tpu"
+        config.tick_interval = 0.02
+        config.entity_sim = True
+        config.entity_k = 4
+        config.delta_ticks = "auto"
+        config.precompile_tiers = False
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            a = await ZmqClient.connect(config.zmq_server_port)
+            b = await ZmqClient.connect(config.zmq_server_port)
+            # two IDLE co-cube entities (frames still flow, nothing
+            # moves) plus a subscription that never changes cubes
+            ea, eb = uuid.uuid4(), uuid.uuid4()
+            await a.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="arena",
+                entities=[Entity(uuid=ea, world_name="arena",
+                                 position=Vector3(1, 2, 3))],
+            ))
+            await b.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="arena",
+                entities=[Entity(uuid=eb, world_name="arena",
+                                 position=Vector3(2, 2, 3))],
+            ))
+            await a.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name="arena", position=Vector3(1, 2, 3),
+            ))
+            plane = server.entity_plane
+            deadline = time.perf_counter() + 10
+            while plane.entity_count < 2:
+                assert time.perf_counter() < deadline
+                await asyncio.sleep(0.02)
+            # let the idle world tick: replay ticks accumulate reuse
+            deadline = time.perf_counter() + 20
+            while plane.delta_reused < 20:
+                assert time.perf_counter() < deadline, plane.stats()
+                await asyncio.sleep(0.05)
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}"
+                ) as resp:
+                    return resp.read().decode()
+
+            text = await asyncio.to_thread(get, "/metrics")
+            types, samples = validate_exposition(text)
+            values = {name: value for name, _, value in samples}
+            assert types["wql_delta_reuse_fraction"] == "gauge"
+            fraction = values["wql_delta_reuse_fraction"]
+            assert fraction > 0.8, f"reuse_fraction {fraction}"
+            assert values.get("wql_delta_sim_reused", 0) > 0
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+# endregion

@@ -34,13 +34,28 @@ POS = Vector3(5.0, 5.0, 5.0)
 #: a capacity tier no other test dispatches at — the first hit MUST
 #: compile fresh kernel variants even inside a shared pytest process
 FRESH_TIER = 1 << 17
+#: enough filler rows to push the delta device buffer past its 1024
+#: floor: every other suite's small backends sit ON the floor, so the
+#: 2048-cap segment shape (and every kernel keyed on it) is unique to
+#: this file — the forced-retrace pin must stay a FIRST hit no matter
+#: which tests warmed the shared jit caches earlier in the process
+_FILLER_ROWS = 1200
 
 
 def make_backend() -> TpuSpatialBackend:
+    import numpy as np
+
     backend = TpuSpatialBackend(16)
     a, b = uuid_mod.uuid4(), uuid_mod.uuid4()
     backend.add_subscription("w", a, POS)
     backend.add_subscription("w", b, POS)
+    filler = [uuid_mod.uuid4() for _ in range(_FILLER_ROWS)]
+    cubes = np.stack([
+        np.arange(_FILLER_ROWS, dtype=np.int64) + 100,
+        np.full(_FILLER_ROWS, 7, np.int64),
+        np.full(_FILLER_ROWS, 7, np.int64),
+    ], axis=1)
+    backend.bulk_add_subscriptions("w", filler, cubes)
     backend._sender = a
     return backend
 

@@ -21,7 +21,14 @@ def assert_green(report):
 def test_catalog_names():
     assert set(CATALOG) == {
         "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
+        "reconnect_storm_replay",
     }
+    # the replay-storm variant is catalogued but NOT CI-smoke-blocking
+    assert CATALOG["reconnect_storm_replay"].ci_smoke is False
+    assert all(
+        CATALOG[n].ci_smoke for n in CATALOG
+        if n != "reconnect_storm_replay"
+    )
 
 
 def test_flash_crowd_smoke():
@@ -50,3 +57,17 @@ def test_battle_royale_smoke():
     # slow-marked: the tpu-backend sim compile makes this the heaviest
     # leg; CI runs it in the dedicated Scenario smoke step
     assert_green(run_scenario("battle_royale", shape="smoke"))
+
+
+@pytest.mark.slow
+def test_reconnect_storm_replay_smoke():
+    """The PR 12 follow-up: a connect storm landing MID-WAL-REPLAY —
+    fat WAL, recovery stretched by the recovery.apply failpoint, storm
+    hammering from the first instant of boot. Zero acked-record loss
+    plus bounded handshake p99. Slow-marked: catalogued for operators
+    and the nightly suite, not CI-blocking smoke."""
+    report = run_scenario("reconnect_storm_replay", shape="smoke")
+    assert_green(report)
+    slo = report["slo"]
+    assert slo["records_recovered"] == slo["wal_records"]
+    assert slo["attempts_during_replay"] > 0

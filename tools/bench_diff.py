@@ -34,7 +34,7 @@ _LOWER_BETTER = (
 #: substrings that mark a metric higher-is-better
 _HIGHER_BETTER = (
     "per_s", "vs_baseline", "speedup", "deliveries", "sends_ok",
-    "queries_per_s",
+    "queries_per_s", "reuse_pct", "reuse_fraction",
 )
 
 

@@ -277,11 +277,15 @@ def _check_per_query_loop(ctx: FileContext) -> Iterator[Violation]:
 
 
 #: sim-tick hot functions of the entity plane (entities/plane.py): the
-#: device dispatch/collect pair a simulation tick flows through. Frame
-#: assembly and index churn (`apply`, `_build_frames`) are host
-#: delivery/index work — O(fan-out)/O(churn) like the router — and
-#: deliberately NOT in this set.
-_SIM_TICK_FUNCS = {"dispatch_tick", "collect_tick"}
+#: device dispatch/collect pair a simulation tick flows through —
+#: including the delta-tick sub-dispatch legs. Frame assembly and
+#: index churn (`apply`, `_build_frames`) are host delivery/index
+#: work — O(fan-out)/O(churn) like the router — and deliberately NOT
+#: in this set.
+_SIM_TICK_FUNCS = {
+    "dispatch_tick", "collect_tick",
+    "_dispatch_tick_full", "_dispatch_tick_delta", "_predict_cubes",
+}
 
 
 def _is_entities_module(relpath: str) -> bool:
@@ -377,6 +381,65 @@ def _check_sim_tick(ctx: FileContext) -> Iterator[Violation]:
                         "a deliberate bounded site with "
                         "`# wql: allow(host-sync-in-sim-tick)`",
                     )
+
+
+#: modules with BOTH a full-rebuild path and a delta path (ROADMAP 2);
+#: tick-path calls into the full path must be designated fallbacks
+_DELTA_MODULES = (
+    "spatial/tpu_backend.py", "parallel/sharded_backend.py",
+    "entities/plane.py",
+)
+#: the per-tick functions a flush/dispatch flows through in those
+#: modules — where a stray full rebuild costs O(N) device work every
+#: tick instead of the delta path's O(churn)
+_DELTA_TICK_FUNCS = {
+    "flush", "_sync_delta", "_dispatch_encoded",
+    "dispatch_staged_batch", "dispatch_local_batch", "_dispatch_delta",
+    "dispatch_tick",
+}
+#: full-hash-rebuild entry points: whole-segment device sorts/uploads
+#: and the full-tier sim kernel leg — each has an O(churn) delta
+#: sibling (tombstone scatter, chunk append, dirty-closure sub-tick)
+_REBUILD_ENTRY_POINTS = {
+    "_sort_delta", "_sort_segment_dev", "_device_compact",
+    "_upload_stale_base", "_upload_base", "_rebuild_base_with",
+    "_compact_sync", "_dispatch_tick_full", "_upload_state",
+}
+
+
+def _check_full_rebuild(ctx: FileContext) -> Iterator[Violation]:
+    """Flag calls to a full-hash-rebuild entry point from tick-path
+    functions of the delta-capable modules. A delta path exists for
+    each (spatial/delta_ticks.py; the entity plane's dirty-closure
+    sub-tick), so every remaining full rebuild on the tick path must
+    be a DESIGNATED fallback site carrying
+    ``# wql: allow(full-rebuild-on-tick)`` — keeping the O(N)-work
+    escape hatches auditable exactly like the host-sync and
+    full-fetch rules keep theirs."""
+    if not ctx.relpath.endswith(_DELTA_MODULES):
+        return
+    scopes = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _DELTA_TICK_FUNCS
+    ]
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            attr = name.rsplit(".", 1)[-1] if name else None
+            if attr in _REBUILD_ENTRY_POINTS:
+                yield from ctx.flag(
+                    FULL_REBUILD,
+                    node,
+                    f"call to full-hash-rebuild entry point `{attr}` "
+                    f"in tick-path function `{scope.name}` — a delta "
+                    "path exists (O(churn) scatter/sub-tick); route "
+                    "the update incrementally, or mark the designated "
+                    "fallback site with "
+                    "`# wql: allow(full-rebuild-on-tick)`",
+                )
 
 
 def _is_jax_jit_ref(node: ast.AST) -> bool:
@@ -526,6 +589,13 @@ SIM_TICK_HAZARD = Rule(
     "must stay one fused kernel; pragma the designated collect points)",
     _check_sim_tick,
 )
+FULL_REBUILD = Rule(
+    "full-rebuild-on-tick",
+    "full-hash-rebuild entry point called from a tick-path function "
+    "where a delta path exists (O(N) device work per tick — use the "
+    "incremental update, or pragma the designated fallback site)",
+    _check_full_rebuild,
+)
 
 RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH,
-         PER_QUERY_LOOP, SIM_TICK_HAZARD]
+         PER_QUERY_LOOP, SIM_TICK_HAZARD, FULL_REBUILD]

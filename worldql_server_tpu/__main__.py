@@ -231,6 +231,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "presenting its session token resume them with "
                         "zero index churn; 0 (default) = sessions off, "
                         "pre-session disconnect semantics byte for byte")
+    p.add_argument("--delta-ticks", choices=["auto", "on", "off"],
+                   dest="delta_ticks",
+                   help="temporal-coherence delta ticks: per-cube "
+                        "dirty bits, a persistent incrementally-"
+                        "updated device hash, and result reuse for "
+                        "clean queries/entities; 'auto' (default) "
+                        "enables where supported (single-chip tpu), "
+                        "'off' pins full recompute byte for byte")
+    p.add_argument("--delta-rebuild-threshold", type=float,
+                   dest="delta_rebuild_threshold",
+                   help="churn fraction above which a delta structure "
+                        "falls back to the full rebuild path "
+                        "(default 0.5)")
     p.add_argument("--session-resume-rate", type=float,
                    dest="session_resume_rate",
                    help="resumes/s the overload governor still admits "
@@ -263,6 +276,7 @@ _OVERRIDES = [
     "overload_min_batch", "overload_peer_rate", "overload_peer_burst",
     "overload_evict_after", "overload_rss_limit_mb",
     "session_ttl", "session_resume_rate",
+    "delta_ticks", "delta_rebuild_threshold",
 ]
 
 

@@ -27,6 +27,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Iterator
 
+from ..robustness import failpoints
 from .wal import (
     HEADER,
     MAGIC,
@@ -157,6 +158,10 @@ async def recover(
     failed = False
     for op, records in ops:
         try:
+            # chaos seam: lets the scenario suite stretch or fail the
+            # boot-time replay deterministically (a reconnect storm
+            # landing mid-replay needs recovery to take a while)
+            await failpoints.afire("recovery.apply")
             if op == "insert":
                 await store.insert_records(records)
             else:

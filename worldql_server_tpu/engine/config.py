@@ -365,6 +365,26 @@ class Config:
     session_resume_rate: float = field(
         default_factory=lambda: float(_env("WQL_SESSION_RESUME_RATE", "200"))
     )
+    # Delta ticks (spatial/delta_ticks.py, ROADMAP 2): temporal
+    # coherence for the tick engine — per-cube dirty bits from the
+    # churn stream, a persistent incrementally-updated device hash,
+    # and result reuse (a query/entity whose neighborhood is clean
+    # replays last tick instead of recomputing). 'auto' (default)
+    # enables it exactly where it is proven: the single-chip TPU
+    # backend and pow2-cube entity planes; 'off' pins the full
+    # recompute pipeline byte for byte; 'on' is auto plus a config
+    # error where delta ticks cannot run (cpu/sharded backends).
+    delta_ticks: str = field(
+        default_factory=lambda: _env("WQL_DELTA_TICKS", "auto")
+    )
+    # Churn fraction above which a delta structure falls back to the
+    # full rebuild path: the entity plane's dirty-closure sub-tick and
+    # the index's tombstone-scatter delta sync both revert past it.
+    delta_rebuild_threshold: float = field(
+        default_factory=lambda: float(
+            _env("WQL_DELTA_REBUILD_THRESHOLD", "0.5")
+        )
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -541,6 +561,19 @@ class Config:
             errors.append(
                 "session_resume_rate must be >= 0 (0 = no resumes "
                 "admitted in REJECT)"
+            )
+        if self.delta_ticks not in ("auto", "on", "off"):
+            errors.append("delta_ticks must be 'auto', 'on' or 'off'")
+        if self.delta_ticks == "on" and self.spatial_backend != "tpu":
+            errors.append(
+                "delta_ticks='on' requires spatial_backend='tpu' (the "
+                "cpu backend resolves per query; the sharded backend "
+                "conservatively runs full recompute) — use 'auto' to "
+                "enable delta ticks only where supported"
+            )
+        if not 0 < self.delta_rebuild_threshold <= 1:
+            errors.append(
+                "delta_rebuild_threshold must be in (0, 1]"
             )
         if self.entity_k < 1:
             errors.append("entity_k must be >= 1")

@@ -30,7 +30,16 @@ def build_backend(config: Config) -> SpatialBackend:
     if config.spatial_backend == "tpu":
         from ..spatial.tpu_backend import TpuSpatialBackend
 
-        return TpuSpatialBackend(config.sub_region_size)
+        backend = TpuSpatialBackend(config.sub_region_size)
+        # delta ticks configure HERE so a resilience rebuild's factory
+        # (which calls build_backend again) re-arms the fresh instance
+        # — its cache starts cold, never stale
+        if config.delta_ticks != "off":
+            backend.configure_delta_ticks(config.delta_ticks)
+            backend.delta_rebuild_threshold = (
+                config.delta_rebuild_threshold
+            )
+        return backend
     if config.spatial_backend == "sharded":
         from ..parallel import (
             ShardedTpuSpatialBackend,
@@ -224,6 +233,8 @@ class WorldQLServer:
                 metrics=self.metrics,
                 tracer=self.tracer,
                 governor=self.governor,
+                delta_ticks=config.delta_ticks,
+                delta_rebuild_threshold=config.delta_rebuild_threshold,
             )
             # wire→SoA columnar fast path (PR 11): transports hand whole
             # recv batches here; entity-update messages batch-decode
@@ -321,6 +332,10 @@ class WorldQLServer:
         )
         if hasattr(self.backend, "device_stats"):
             self.metrics.gauge("spatial_device", self.backend.device_stats)
+        if self.config.delta_ticks != "off":
+            # flattened into delta.* series by render_prometheus —
+            # the e2e acceptance reads delta.reuse_fraction here
+            self.metrics.gauge("delta", self._delta_status)
         if self.ticker is not None:
             self.metrics.gauge(
                 "tick",
@@ -451,6 +466,32 @@ class WorldQLServer:
         if self.governor is None:
             return None
         return self.governor.status()
+
+    def _delta_status(self) -> dict:
+        """Temporal-coherence accounting (the ``delta`` gauge):
+        query-path + sim-path reuse counters and the cumulative
+        reuse fraction — how much of the world the engine did NOT
+        recompute since boot."""
+        q_r = int(getattr(self.backend, "delta_reused", 0))
+        q_c = int(getattr(self.backend, "delta_recomputed", 0))
+        q_f = int(getattr(self.backend, "delta_fallbacks", 0))
+        s_r = s_c = s_f = 0
+        if self.entity_plane is not None:
+            s_r = self.entity_plane.delta_reused
+            s_c = self.entity_plane.delta_recomputed
+            s_f = self.entity_plane.delta_fallbacks
+        total = q_r + q_c + s_r + s_c
+        return {
+            "query_reused": q_r,
+            "query_recomputed": q_c,
+            "query_fallbacks": q_f,
+            "sim_reused": s_r,
+            "sim_recomputed": s_c,
+            "sim_fallbacks": s_f,
+            "reuse_fraction": (
+                round((q_r + s_r) / total, 4) if total else 0.0
+            ),
+        }
 
     def durability_status(self) -> dict | None:
         """Queue depth, WAL state, and last recovery for /healthz and

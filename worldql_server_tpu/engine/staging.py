@@ -31,11 +31,20 @@ Buffers grow by power-of-two on demand and shrink with hysteresis: a
 capacity is halved only after ``SHRINK_AFTER`` consecutive flushes
 used under a quarter of it, so one quiet tick never thrashes a crowd-
 sized allocation.
+
+Delta ticks (spatial/delta_ticks.py) ride these columns: a query's
+reuse identity is the 128-bit content signature of its staged row
+(:func:`row_signatures`, re-exported here as the staging-side half of
+the contract), and the staging-epoch check above doubles as the
+wholesale invalidation — a window that straddles a backend swap never
+reaches the staged (and therefore never the reuse) path at all.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from ..spatial.delta_ticks import row_signatures  # noqa: F401  (re-export)
 
 #: initial (and minimum) rows per buffer
 MIN_CAP = 1024

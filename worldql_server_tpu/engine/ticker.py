@@ -715,6 +715,24 @@ class TickBatcher:
                 fetch_bytes=int(stats.get("fetch_bytes", 0)),
                 compaction_bucket=self.last_compaction_bucket,
             )
+        # delta ticks (spatial/delta_ticks.py): the dispatch's reuse
+        # partition rides the tick trace as `tick.delta` tags and the
+        # delta.* counter series — reused/recomputed query counts,
+        # churn rows consumed, and the fallback reason when the batch
+        # bypassed reuse entirely
+        delta = getattr(self.backend, "last_delta_stats", None)
+        if delta:
+            trace.tag(delta=dict(delta))
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "delta.query_reused", int(delta.get("reused", 0))
+                )
+                self.metrics.inc(
+                    "delta.query_recomputed",
+                    int(delta.get("recomputed", 0)),
+                )
+                if delta.get("fallback"):
+                    self.metrics.inc("delta.query_fallbacks")
         if self._device_telemetry is not None:
             # device timing split onto the tick root + retrace poll;
             # diagnostics must never cost the tick

@@ -76,6 +76,7 @@ from ..ops.tick import EntityState, make_tick_fn
 from ..protocol import entity_wire
 from ..robustness import failpoints
 from ..protocol.types import Entity, Instruction, Message, Vector3
+from ..spatial.hashing import spatial_keys
 from ..spatial.quantize import cube_coords_batch
 from ..utils.names import SanitizeError, sanitize_world_name
 from ..utils.retrace import GUARD
@@ -96,6 +97,10 @@ _DEAD_POS = np.float32(1.0e30)
 #: smallest dirty-row scatter bucket (pow2 ladder floor): below this the
 #: fixed launch cost dominates and finer tiers only multiply compiles
 _SCATTER_MIN_BUCKET = 64
+#: smallest delta-tick sub-batch tier (pow2 ladder floor): the dirty
+#: closure pads up to this before the sub-kernel launches, so steady
+#: low-churn serving reuses a handful of compiled shapes
+_DELTA_MIN_TIER = 64
 #: world-name fallback envelope for wire-path registrations (the world
 #: is always resolved before this is consulted)
 _WIRE_MSG = Message(instruction=Instruction.LOCAL_MESSAGE)
@@ -188,6 +193,8 @@ class EntityPlane:
         tracer=None,
         governor=None,
         wire="auto",
+        delta_ticks: str = "off",
+        delta_rebuild_threshold: float = 0.5,
     ):
         self.backend = backend
         self.peer_map = peer_map
@@ -233,6 +240,46 @@ class EntityPlane:
         self._device_dirty = np.zeros(self._cap, bool)
         self._dev_state: EntityState | None = None
         self._dev_cap = 0
+
+        # Delta sim ticks (ROADMAP 2): instead of re-running the full
+        # integrate→sort→kNN kernel over every slot each tick, gather
+        # the DIRTY-CUBE CLOSURE — all live entities in any cube a
+        # dirty entity occupies now or can reach this tick — into a
+        # pow2 sub-batch, run the SAME tick kernel at that (smaller)
+        # tier, and splice the results over the retained last-tick
+        # arrays; clean entities replay. Requires a pow2 cube size:
+        # the host-side reach prediction replays the device's f32
+        # integration bit-for-bit and quantizes with the golden host
+        # quantizer, whose agreement with the device quantizer is
+        # pinned EXACT for pow2 sizes (tests/test_quantizer_envelope).
+        pow2_cube = cube_size == _next_pow2(cube_size)
+        self._delta_ticks = delta_ticks in ("on", "auto") and pow2_cube
+        if delta_ticks == "on" and not pow2_cube:
+            logger.warning(
+                "delta_ticks='on' needs a power-of-two cube size for "
+                "the exact quantizer envelope (got %d) — running full "
+                "recompute ticks", cube_size,
+            )
+        self.delta_rebuild_threshold = float(delta_rebuild_threshold)
+        #: slots mutated since the last SUCCESSFUL dispatch (the delta
+        #: dirty stream; _device_dirty can't serve — it clears on H2D)
+        self._window_dirty = np.zeros(self._cap, bool)
+        #: (wid, cx, cy, cz) cubes vacated by removals this window —
+        #: the slot's wid/cube columns are wiped at release time
+        self._window_dirty_cubes: list[tuple] = []
+        #: retained last applied tick (the replay source)
+        self._have_last = False
+        self._last_cap = 0
+        self._last_targets: np.ndarray | None = None
+        self._last_counts: np.ndarray | None = None
+        self._last_pos: np.ndarray | None = None
+        self.delta_sim_ticks = 0
+        self.full_sim_ticks = 0
+        self.delta_reused = 0
+        self.delta_recomputed = 0
+        self.delta_fallbacks = 0
+        self.delta_mispredicts = 0
+        self.last_delta_stats: dict = {}
 
         self._n = 0                     # slot high-water mark
         self._free: list[int] = []      # recycled slots below _n
@@ -403,6 +450,7 @@ class EntityPlane:
         # and its rows must ship to the device twin at this dispatch
         self._touched[rows] = True
         self._device_dirty[rows] = True
+        self._window_dirty[rows] = True
         buf.touched[rows] = False
         buf.has_vel[rows] = False
         buf.dirty = False
@@ -578,6 +626,7 @@ class EntityPlane:
             self._vel[slot] = vel
         self._touched[slot] = True
         self._device_dirty[slot] = True
+        self._window_dirty[slot] = True
         if new:
             # index coupling: queryable before the first tick
             self._register_cube(slot)
@@ -673,6 +722,18 @@ class EntityPlane:
         return 1
 
     def _release_slot(self, slot: int, pid: int) -> None:
+        if self._delta_ticks:
+            # the vacated cube must dirty (its remaining residents'
+            # neighborhoods change) and the slot's retained results
+            # must blank — wid/cube wipe below loses both otherwise
+            self._window_dirty_cubes.append((
+                int(self._wid[slot]), int(self._cube[slot, 0]),
+                int(self._cube[slot, 1]), int(self._cube[slot, 2]),
+            ))
+            self._window_dirty[slot] = False  # dead slots never compute
+            if self._have_last:
+                self._last_targets[slot] = -1
+                self._last_counts[slot] = 0
         uuid = self._uuid_of.pop(slot)
         del self._slot_of[uuid]
         self._slot_of_key.pop(uuid.bytes, None)
@@ -736,10 +797,13 @@ class EntityPlane:
         self._touched = grow2(self._touched, False, bool)
         self._uuid_bytes = grow2(self._uuid_bytes, 0, np.uint8, 16)
         self._device_dirty = grow2(self._device_dirty, False, bool)
+        self._window_dirty = grow2(self._window_dirty, False, bool)
         for buf in self._stage:
             buf.grow(cap)
-        # shape change: the next dispatch re-ships the whole tier
+        # shape change: the next dispatch re-ships the whole tier and
+        # the retained last-tick arrays no longer fit — full recompute
         self._dev_state = None
+        self._have_last = False
         self._cap = cap
         logger.info("entity plane grew to capacity tier %d", cap)
 
@@ -811,8 +875,8 @@ class EntityPlane:
     def dispatch_tick(self):
         """Launch one simulation tick from the host columns (event-loop
         thread; tick.sim.integrate span): fold the staged update
-        columns, ship only the touched slots to the device twin, launch
-        the fused integrate+kNN kernel, and enqueue the D2H prefetch.
+        columns, pick the delta or full path, launch the kernel (when
+        any device work is owed), and enqueue the D2H prefetch.
         Returns an opaque handle for ``collect_tick`` or None when idle
         / a previous tick is still in flight (pipelined flushes never
         stack sim ticks — the writeback of tick N is input to tick
@@ -822,6 +886,32 @@ class EntityPlane:
             return None
         t0 = time.perf_counter()
         cap = self._cap
+        handle = None
+        if self._delta_ticks:
+            handle = self._dispatch_tick_delta(cap, t0)
+        if handle is None:
+            # designated fallback: cold replay state, tier change, or
+            # churn past the rebuild threshold — one full-tier tick
+            # re-establishes the retained state delta ticks splice over
+            handle = self._dispatch_tick_full(cap, t0)  # wql: allow(full-rebuild-on-tick)
+        # window clearing happens only on a SUCCESSFUL launch: a
+        # raising dispatch keeps every mark for the retry, and
+        # abort_tick drops _have_last so dirt consumed by a tick that
+        # never applied cannot leak a stale replay
+        self._touched[:cap] = False
+        self._window_dirty[:cap] = False
+        self._window_dirty_cubes.clear()
+        self._tick_inflight = True
+        self.dispatches += 1
+        self.last_integrate_ms = (time.perf_counter() - t0) * 1e3
+        if self.metrics is not None:
+            self.metrics.observe_ms("sim.integrate_ms", self.last_integrate_ms)
+            self.metrics.inc("sim.h2d_rows", self.last_h2d_rows)
+        return handle
+
+    def _dispatch_tick_full(self, cap: int, t0: float) -> dict:
+        """The pre-delta full path: ship dirty slots to the persistent
+        twin, run the fused kernel over the WHOLE capacity tier."""
         state = self._upload_state(cap)
         new_state, targets, counts = self._tick_fn(state)
         # device twin for the NEXT tick: integrated positions; the
@@ -838,18 +928,130 @@ class EntityPlane:
             copy_async = getattr(arr, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
-        self._touched[:cap] = False
-        self._tick_inflight = True
-        self.dispatches += 1
-        self.last_integrate_ms = (time.perf_counter() - t0) * 1e3
-        if self.metrics is not None:
-            self.metrics.observe_ms("sim.integrate_ms", self.last_integrate_ms)
-            self.metrics.inc("sim.h2d_rows", self.last_h2d_rows)
+        self.full_sim_ticks += 1
         return {
+            "mode": "full",
             "pos": new_state.position,
             "targets": targets,
             "counts": counts,
             "cap": cap,
+            "t0": t0,
+        }
+
+    def _note_delta_fallback(self, reason: str) -> None:
+        self.delta_fallbacks += 1
+        self.last_delta_stats = {
+            "reused": 0, "recomputed": 0, "dirty_cubes": 0,
+            "fallback": reason,
+        }
+        if self.metrics is not None:
+            self.metrics.inc("delta.sim_fallbacks")
+
+    def _predict_cubes(self, slots: np.ndarray) -> np.ndarray:
+        """Post-integration cubes of ``slots``, predicted host-side by
+        replaying the device's f32 integrate+reflect bit-for-bit
+        (numpy f32 add/mul/compare are the same IEEE ops XLA emits)
+        and quantizing with the golden host quantizer — EXACT against
+        the device labels for pow2 cube sizes (the plane's delta gate;
+        tests/test_quantizer_envelope pins the agreement)."""
+        dt = np.float32(self.dt)
+        tb = np.float32(2.0 * self.bounds)  # the kernel's weak-f32 2*b
+        b = np.float32(self.bounds)
+        p = self._pos[slots] + self._vel[slots] * dt
+        p = np.where(p > b, tb - p, p)
+        p = np.where(p < -b, -tb - p, p)
+        return cube_coords_batch(p.astype(np.float64), self.cube_size)
+
+    def _dispatch_tick_delta(self, cap: int, t0: float) -> dict | None:
+        """Delta path: build the dirty-cube closure and launch the
+        tick kernel over ONLY it, at a pow2 sub-tier. Returns None to
+        fall back to the full path (cold cache, tier change, or churn
+        past ``delta_rebuild_threshold`` — the rebuild threshold)."""
+        if not self._have_last or self._last_cap != cap:
+            self._note_delta_fallback("cold")
+            return None
+        live = self._live[:cap]
+        n_live = int(np.count_nonzero(live))
+        moving = live & (self._vel[:cap] != 0.0).any(axis=1)
+        dirty = (self._window_dirty[:cap] & live) | moving
+        dirty_slots = np.flatnonzero(dirty)
+        if dirty_slots.size == 0 and not self._window_dirty_cubes:
+            # the world did not change: zero device work, pure replay
+            self.delta_sim_ticks += 1
+            self.delta_reused += n_live
+            self.last_h2d_rows = 0
+            self.last_delta_stats = {
+                "reused": n_live, "recomputed": 0, "dirty_cubes": 0,
+                "fallback": "",
+            }
+            return {"mode": "replay", "cap": cap, "t0": t0}
+        threshold = self.delta_rebuild_threshold * max(n_live, 1)
+        if dirty_slots.size > threshold:
+            self._note_delta_fallback("churn")
+            return None
+        # dirty cubes: every cube a dirty entity occupies now or can
+        # reach this tick, plus cubes vacated by removals
+        wid_col = self._wid[:cap]
+        cube_col = self._cube[:cap]
+        parts = [spatial_keys(wid_col[dirty_slots],
+                              cube_col[dirty_slots], 0)]
+        if dirty_slots.size:
+            parts.append(spatial_keys(
+                wid_col[dirty_slots], self._predict_cubes(dirty_slots), 0
+            ))
+        if self._window_dirty_cubes:
+            arr = np.asarray(self._window_dirty_cubes, np.int64)  # wql: allow(host-sync-in-sim-tick) — host tuple list, not a device array
+            parts.append(spatial_keys(
+                arr[:, 0].astype(np.int32), arr[:, 1:], 0
+            ))
+        dirty_keys = np.unique(np.concatenate(parts))
+        # closure: every live entity in a dirty cube (a same-hash
+        # collision only ADDS members — conservative, never wrong)
+        closure = live & np.isin(
+            spatial_keys(wid_col, cube_col, 0), dirty_keys
+        )
+        rows = np.flatnonzero(closure)
+        tier = max(_DELTA_MIN_TIER, _next_pow2(max(int(rows.size), 1)))
+        if rows.size > threshold or tier >= cap:
+            self._note_delta_fallback("closure")
+            return None
+        # gather the closure into the sub-tier; pad lanes are parked
+        # dead rows (peer -1 → the kernel masks them out of every run)
+        pos_sub = np.full((tier, 3), _DEAD_POS, np.float32)
+        vel_sub = np.zeros((tier, 3), np.float32)
+        wid_sub = np.full(tier, -1, np.int32)
+        pid_sub = np.full(tier, -1, np.int32)
+        n = int(rows.size)
+        pos_sub[:n] = self._pos[rows]
+        vel_sub[:n] = self._vel[rows]
+        wid_sub[:n] = wid_col[rows]
+        pid_sub[:n] = self._pid[rows]
+        state = EntityState(
+            position=jnp.asarray(pos_sub), velocity=jnp.asarray(vel_sub),
+            world=jnp.asarray(wid_sub), peer=jnp.asarray(pid_sub),
+        )
+        new_state, targets, counts = self._tick_fn(state)
+        for arr in (new_state.position, targets, counts):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        self.delta_sim_ticks += 1
+        self.delta_reused += n_live - n
+        self.delta_recomputed += n
+        self.last_h2d_rows = n
+        self.last_delta_stats = {
+            "reused": n_live - n, "recomputed": n,
+            "dirty_cubes": int(dirty_keys.size), "fallback": "",
+        }
+        return {
+            "mode": "delta",
+            "rows": rows,
+            "dirty_keys": dirty_keys,
+            "pos": new_state.position,
+            "targets": targets,
+            "counts": counts,
+            "cap": cap,
+            "tier": tier,
             "t0": t0,
         }
 
@@ -886,6 +1088,22 @@ class EntityPlane:
             )
             compiles += 1
             bucket *= 2
+        if self._delta_ticks:
+            # delta-tick sub-batch ladder: the dirty-closure kernel is
+            # the SAME tick fn at smaller pow2 tiers — walk them so a
+            # low-churn steady state re-traces nothing mid-serving
+            tier = _DELTA_MIN_TIER
+            while tier < cap:
+                if compiles >= max(1, int(max_compiles)):
+                    skipped += 1
+                    tier *= 2
+                    continue
+                z3 = jnp.zeros((tier, 3), jnp.float32)
+                neg = jnp.full(tier, -1, jnp.int32)
+                out = self._tick_fn(EntityState(z3, z3, neg, neg))
+                jax.block_until_ready(out)
+                compiles += 1
+                tier *= 2
         jax.block_until_ready(state)
         delta = GUARD.delta(before)
         stats = {
@@ -911,22 +1129,36 @@ class EntityPlane:
         index coupling follows the golden grid, not the device's f32
         twin."""
         t0 = time.perf_counter()
+        mode = handle.get("mode", "full")
+        if mode == "replay":
+            # nothing was dispatched: the retained tick IS the result
+            return {"mode": "replay", "cap": handle["cap"], "knn_ms": 0.0}
         pos = np.asarray(handle["pos"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
         targets = np.asarray(handle["targets"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
         counts = np.asarray(handle["counts"])  # wql: allow(host-sync-in-sim-tick) — designated collect point
         cubes = cube_coords_batch(pos.astype(np.float64), self.cube_size)
         knn_ms = (time.perf_counter() - t0) * 1e3
-        return {
+        out = {
+            "mode": mode,
             "pos": pos, "targets": targets, "counts": counts,
             "cubes": cubes, "cap": handle["cap"], "knn_ms": knn_ms,
         }
+        if mode == "delta":
+            out["rows"] = handle["rows"]
+            out["dirty_keys"] = handle["dirty_keys"]
+        return out
 
     def abort_tick(self) -> None:
         """Drop an in-flight tick without applying it (cancelled or
-        errored flush): host columns stay authoritative and unchanged,
+        errored flush, or a resilience rebuild/failover swapping the
+        backing index): host columns stay authoritative and unchanged,
         the next dispatch simply re-integrates from them. The device
         twin already holds the dropped tick's integration, so it is
-        invalidated — the next dispatch re-ships the host tier."""
+        invalidated — the next dispatch re-ships the host tier. The
+        delta-tick replay state drops with it: the aborted dispatch
+        consumed the dirty window without ever applying, so the next
+        tick must recompute the world in full."""
+        self._have_last = False
         if self._tick_inflight:
             self._tick_inflight = False
             self._dev_state = None
@@ -944,22 +1176,44 @@ class EntityPlane:
         self._tick_inflight = False
         t0 = time.perf_counter()
         cap = result["cap"]
-        pos, cubes = result["pos"], result["cubes"]
-        targets, counts = result["targets"], result["counts"]
+        mode = result.get("mode", "full")
+        if mode == "replay":
+            # nothing changed since the retained tick: positions,
+            # cubes and the index are already exactly what a full
+            # recompute would produce — only the frame leg runs
+            moved_slots = np.empty(0, np.intp)
+            pos = self._last_pos
+            targets, counts = self._last_targets, self._last_counts
+        elif mode == "delta":
+            pos, targets, counts, moved_slots = self._apply_delta(result)
+        else:
+            pos, cubes = result["pos"], result["cubes"]
+            targets, counts = result["targets"], result["counts"]
 
-        # 1. position writeback — every live slot that the wire did
-        # NOT touch since dispatch (a client update must win over the
-        # concurrent integration it never saw)
-        wb = self._live[:cap] & ~self._touched[:cap]
-        self._pos[:cap][wb] = pos[wb]
+            # 1. position writeback — every live slot that the wire
+            # did NOT touch since dispatch (a client update must win
+            # over the concurrent integration it never saw)
+            wb = self._live[:cap] & ~self._touched[:cap]
+            self._pos[:cap][wb] = pos[wb]
 
-        # 2. index churn: slots whose authoritative cube moved. Only
-        # written-back slots move here — touched slots re-quantize at
-        # the NEXT applied tick from their client-given position.
-        moved = wb & np.any(cubes != self._cube[:cap], axis=1)
-        moved_slots = np.flatnonzero(moved)
-        if moved_slots.size:
-            self._apply_churn(moved_slots, cubes)
+            # 2. index churn: slots whose authoritative cube moved.
+            # Only written-back slots move here — touched slots
+            # re-quantize at the NEXT applied tick from their
+            # client-given position.
+            moved = wb & np.any(cubes != self._cube[:cap], axis=1)
+            moved_slots = np.flatnonzero(moved)
+            if moved_slots.size:
+                self._apply_churn(moved_slots, cubes[moved_slots])
+            # retain this tick as the delta replay source — as
+            # WRITABLE copies: np.asarray of a device buffer is a
+            # read-only zero-copy view, and delta ticks splice their
+            # sub-results into these in place
+            if self._delta_ticks:
+                self._last_pos = np.array(pos)
+                self._last_targets = np.array(targets)
+                self._last_counts = np.array(counts)
+                self._have_last = True
+                self._last_cap = cap
         self.last_churn = int(moved_slots.size)
 
         # 3. neighbor frames: one message per entity with >= 1 target,
@@ -984,27 +1238,91 @@ class EntityPlane:
                 self.metrics.inc("sim.index_moves", int(moved_slots.size))
             if pairs:
                 self.metrics.inc("sim.frames", len(pairs))
+            if self._delta_ticks and self.last_delta_stats:
+                self.metrics.inc(
+                    "delta.sim_reused", self.last_delta_stats["reused"]
+                )
+                self.metrics.inc(
+                    "delta.sim_recomputed",
+                    self.last_delta_stats["recomputed"],
+                )
         if trace is not None:
-            trace.tag(sim={
+            tags = {
                 "entities": len(self._slot_of),
                 "frames": len(pairs),
                 "index_moves": int(moved_slots.size),
                 "integrate_ms": round(self.last_integrate_ms, 3),
                 "knn_ms": round(result["knn_ms"], 3),
                 "apply_ms": round(self.last_apply_ms, 3),
-            })
+            }
+            if self._delta_ticks:
+                tags["delta"] = dict(self.last_delta_stats)
+            trace.tag(sim=tags)
         return pairs
 
+    def _apply_delta(self, result: dict):
+        """Splice a delta sub-tick over the retained last-tick arrays:
+        closure rows take the freshly computed values, clean rows keep
+        (replay) theirs. Returns ``(pos, targets, counts,
+        moved_slots)`` for the shared apply tail — ``pos`` is the
+        device-integrated frame position column, exactly what the full
+        path hands it."""
+        rows = result["rows"]
+        n = int(rows.size)
+        pos_sub = result["pos"][:n]
+        cubes_sub = result["cubes"][:n]
+        self._last_targets[rows] = result["targets"][:n]
+        self._last_counts[rows] = result["counts"][:n]
+        self._last_pos[rows] = pos_sub
+
+        # writeback + churn for closure rows the wire didn't touch
+        # mid-flight (same mask the full path applies tier-wide);
+        # rows removed mid-flight dropped out of `live` already
+        wb = self._live[rows] & ~self._touched[rows]
+        wrows = rows[wb]
+        self._pos[wrows] = pos_sub[wb]
+        moved = np.any(cubes_sub[wb] != self._cube[wrows], axis=1)
+        moved_slots = wrows[moved]
+        if moved_slots.size:
+            self._apply_churn(moved_slots, cubes_sub[wb][moved])
+
+        # defensive closure audit: every written-back row must land in
+        # a cube the dispatch predicted dirty — unreachable inside the
+        # pinned quantizer envelope, but a mispredict would mean some
+        # clean cube replayed stale neighbors, so it forces the next
+        # tick onto the full path instead of trusting the replay state
+        if moved_slots.size:
+            landed = spatial_keys(
+                self._wid[moved_slots], cubes_sub[wb][moved], 0
+            )
+            bad = int(np.count_nonzero(
+                ~np.isin(landed, result["dirty_keys"])
+            ))
+            if bad:
+                self.delta_mispredicts += bad
+                self._have_last = False
+                logger.warning(
+                    "delta tick mispredicted %d cube landings — "
+                    "forcing a full recompute next tick", bad,
+                )
+
+        # the device twin never saw this sub-tick: closure rows are
+        # stale there until the next full-path scatter re-ships them
+        self._device_dirty[wrows] = True
+        return self._last_pos, self._last_targets, self._last_counts, \
+            moved_slots
+
     def _apply_churn(self, moved_slots: np.ndarray,
-                     cubes: np.ndarray) -> None:
+                     new_cubes: np.ndarray) -> None:
         """Move the index rows of slots whose cube changed, through the
-        backend's delta path. Refcount transitions decide which moves
-        actually touch the index (co-located entities of one peer share
-        a row); the surviving adds/removes go down vectorized, grouped
-        by world, via ``bulk_move_subscriptions`` when the backend has
-        it (TPU/sharded) or per-row mutations otherwise."""
+        backend's delta path. ``new_cubes`` are the moved slots' fresh
+        cubes, row-aligned with ``moved_slots``. Refcount transitions
+        decide which moves actually touch the index (co-located
+        entities of one peer share a row); the surviving adds/removes
+        go down vectorized, grouped by world, via
+        ``bulk_move_subscriptions`` when the backend has it
+        (TPU/sharded) or per-row mutations otherwise."""
         old_cubes = self._cube[moved_slots].copy()
-        new_cubes = cubes[moved_slots]
         wids = self._wid[moved_slots]
         pids = self._pid[moved_slots]
         self._cube[moved_slots] = new_cubes
@@ -1164,6 +1482,13 @@ class EntityPlane:
             "last_h2d_rows": self.last_h2d_rows,
             "index_moves": self.index_moves,
             "index_rows": len(self._sub_refs),
+            "delta_ticks": self._delta_ticks,
+            "delta_sim_ticks": self.delta_sim_ticks,
+            "full_sim_ticks": self.full_sim_ticks,
+            "delta_reused": self.delta_reused,
+            "delta_recomputed": self.delta_recomputed,
+            "delta_fallbacks": self.delta_fallbacks,
+            "delta_mispredicts": self.delta_mispredicts,
             "last_integrate_ms": round(self.last_integrate_ms, 3),
             "last_knn_ms": round(self.last_knn_ms, 3),
             "last_apply_ms": round(self.last_apply_ms, 3),

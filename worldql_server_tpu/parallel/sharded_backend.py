@@ -97,6 +97,18 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         self.n_space = mesh.shape["space"]
         self._kernels: dict[tuple, object] = {}
 
+    def supports_delta_ticks(self) -> bool:
+        # Conservatively OFF on the mesh for now: result reuse must be
+        # proven against per-shard flat regions + pmax merges before
+        # it is allowed to skip them ('auto' therefore resolves to the
+        # full-recompute path here — correct, just not yet faster).
+        return False
+
+    def _delta_scatter_supported(self) -> bool:
+        # the sorted-segment tombstone scatter assumes single-device
+        # arrays; the replicated delta twin keeps the full sort path
+        return False
+
     # region: shardings
 
     def _sharding(self, *spec) -> NamedSharding:

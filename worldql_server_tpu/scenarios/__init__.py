@@ -8,12 +8,18 @@ smoke), ``bench.py --config 10`` (the perf-gated suite record) and
 tests/test_scenarios.py.
 """
 
-from .catalog import BattleRoyale, FlashCrowd, GameTick, ReconnectStorm
+from .catalog import (
+    BattleRoyale, FlashCrowd, GameTick, ReconnectStorm,
+    ReconnectStormReplay,
+)
 from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
 
 CATALOG = {
     scenario.name: scenario
-    for scenario in (FlashCrowd, BattleRoyale, ReconnectStorm, GameTick)
+    for scenario in (
+        FlashCrowd, BattleRoyale, ReconnectStorm, GameTick,
+        ReconnectStormReplay,
+    )
 }
 
 __all__ = [
@@ -23,6 +29,7 @@ __all__ = [
     "FlashCrowd",
     "GameTick",
     "ReconnectStorm",
+    "ReconnectStormReplay",
     "Scenario",
     "ScenarioContext",
     "format_report",

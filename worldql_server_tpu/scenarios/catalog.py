@@ -27,7 +27,7 @@ import uuid as uuid_mod
 import numpy as np
 
 from ..engine.config import Config
-from ..protocol.types import Entity, Instruction, Message, Vector3
+from ..protocol.types import Entity, Instruction, Message, Record, Vector3
 from ..robustness import failpoints
 from .client import ZmqPeer, free_port
 from .engine import Check, Scenario, ScenarioContext, pctl
@@ -632,4 +632,153 @@ class GameTick(Scenario):
                   slo["heartbeat_p99_ms"] <= hb_limit,
                   slo["heartbeat_p99_ms"], f"<= {hb_limit} ms"),
             Check("queue_drained", slo["drained"], slo["drained"], True),
+        ]
+
+
+class ReconnectStormReplay(Scenario):
+    """Reconnect storm landing mid-WAL-replay (the PR 12 "still open"
+    note): the broker boots with a FAT WAL — acked records from a
+    previous life that crashed before its checkpoint — while the
+    ``recovery.apply`` failpoint stretches replay, and a connect storm
+    hammers the wire from the FIRST instant of boot (``concurrent_boot``:
+    the server starts as a task; connects fail-and-retry until the
+    transports open, exactly a client fleet reconnecting into a
+    recovering broker). Survival means: recovery applies every acked
+    entry (ZERO acked-record loss, read back from the store), the
+    storm's handshakes land with bounded p99 once serving opens, and
+    the broker answers afterwards. Slow-marked: in the catalog for
+    operators and the nightly suite, NOT in the CI-blocking smoke set.
+    """
+
+    name = "reconnect_storm_replay"
+    description = "connect storm during boot-time WAL replay"
+    ci_smoke = False
+    concurrent_boot = True
+
+    def build_config(self, shape: str) -> Config:
+        import tempfile
+
+        from ..durability.wal import MAGIC, encode_insert, frame_entry
+
+        self._wal_dir = tempfile.mkdtemp(prefix="wql-replay-wal-")
+        self._n_records = 300 if shape == "smoke" else 3000
+        # fabricate the fat WAL directly in the segment format: these
+        # entries were ACKED in the previous life — recovery owes the
+        # store every one of them
+        frames = [MAGIC]
+        for i in range(self._n_records):
+            frames.append(frame_entry(encode_insert([Record(
+                uuid=uuid_mod.UUID(int=i + 1),
+                position=Vector3(1.0, 2.0, 3.0),
+                world_name="arena",
+                data=f"acked-{i}",
+            )])))
+        import os
+
+        with open(os.path.join(self._wal_dir, "wal-00000000.log"),
+                  "wb") as f:
+            f.write(b"".join(frames))
+        return _storm_config(
+            durability="wal",
+            wal_dir=self._wal_dir,
+            session_ttl=30.0,
+            # one failpoint delay per replayed batch: recovery takes
+            # ~n_records * delay — long enough that the whole storm
+            # provably lands inside it (asserted via the fired count)
+            failpoints="recovery.apply=delay:5ms",
+            overload_tick_budget_ms=50.0,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        n = 8 if ctx.smoke else 32
+        handshake_walls: list[float] = []
+        refused = 0
+        attempts_during_replay = 0
+
+        async def storm_one() -> None:
+            nonlocal refused, attempts_during_replay
+            t0 = time.perf_counter()
+            deadline = t0 + 30.0
+            while True:
+                if not ctx.start_task.done():
+                    attempts_during_replay += 1
+                try:
+                    peer = await ZmqPeer.connect(
+                        ctx.config.zmq_server_port, timeout=0.5,
+                    )
+                    if peer.refused:
+                        refused += 1
+                        peer.close()
+                    else:
+                        ctx.clients.append(peer)
+                        handshake_walls.append(
+                            (time.perf_counter() - t0) * 1e3
+                        )
+                        return
+                except Exception:
+                    pass  # transports not up yet (mid-replay) — retry
+                if time.perf_counter() > deadline:
+                    raise AssertionError("storm client never connected")
+                await asyncio.sleep(0.01)
+
+        # the storm starts NOW — the server is still replaying its WAL
+        storm = [asyncio.ensure_future(storm_one()) for _ in range(n)]
+        try:
+            await asyncio.gather(*storm)
+        finally:
+            for task in storm:
+                task.cancel()
+        await ctx.start_task  # boot must have completed under fire
+        replay_fires = failpoints.registry.fired("recovery.apply")
+
+        # zero acked-record loss: every fabricated WAL entry reads
+        # back from the store after recovery
+        stored = await ctx.server.store.get_records_in_region(
+            "arena", Vector3(1.0, 2.0, 3.0)
+        )
+        recovered = len({sr.record.uuid for sr in stored})
+
+        probe = ctx.clients[-1]
+        alive = await ctx.heartbeat_ok(probe)
+        recovery = ctx.server.last_recovery
+        return {
+            "wal_records": self._n_records,
+            "records_recovered": recovered,
+            "replay_batches_fired": replay_fires,
+            "storm_clients": n,
+            "attempts_during_replay": attempts_during_replay,
+            "refused": refused,
+            "handshake_p99_ms": round(
+                pctl(handshake_walls, 0.99) or 0.0, 1
+            ),
+            "recovery_errors": len(recovery.errors) if recovery else -1,
+            "broker_answers": alive,
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        # bounded, not fast: one CI core time-shares the replay, the
+        # storm AND the broker — the bound catches a wedged handshake
+        # path, not scheduler contention
+        p99_limit = 20000.0 if ctx.smoke else 5000.0
+        return [
+            Check("zero_acked_record_loss",
+                  slo["records_recovered"] == slo["wal_records"],
+                  slo["records_recovered"], slo["wal_records"],
+                  "every WAL-acked record readable after recovery"),
+            Check("storm_landed_mid_replay",
+                  slo["attempts_during_replay"] > 0,
+                  slo["attempts_during_replay"], "> 0",
+                  "connect attempts provably hit the recovering boot"),
+            Check("replay_ran", slo["replay_batches_fired"] > 0,
+                  slo["replay_batches_fired"], "> 0"),
+            Check("all_storm_clients_connected",
+                  len(ctx.clients) >= slo["storm_clients"],
+                  len(ctx.clients), f">= {slo['storm_clients']}"),
+            Check("resume_p99_bounded",
+                  slo["handshake_p99_ms"] <= p99_limit,
+                  slo["handshake_p99_ms"], f"<= {p99_limit} ms"),
+            Check("recovery_clean", slo["recovery_errors"] == 0,
+                  slo["recovery_errors"], 0),
+            Check("broker_answers_after_replay_storm",
+                  slo["broker_answers"], slo["broker_answers"], True),
         ]

@@ -133,6 +133,14 @@ class Scenario:
 
     name = "scenario"
     description = ""
+    #: whether the scenario belongs in the DEFAULT CLI set — the
+    #: CI-blocking "Scenario smoke" step runs exactly these; slow or
+    #: special-lifecycle scenarios opt out and run by explicit name
+    ci_smoke = True
+    #: boot the server AS A TASK and hand ``drive`` the in-flight
+    #: start (``ctx.start_task``) — for storms that must land
+    #: mid-boot, e.g. during WAL replay. The drive owns awaiting it.
+    concurrent_boot = False
 
     def build_config(self, shape: str) -> Config:
         raise NotImplementedError
@@ -158,8 +166,13 @@ async def _run_async(scenario: Scenario, shape: str) -> dict:
     failpoints.registry.reset()
     config = scenario.build_config(shape)
     server = WorldQLServer(config, backend=scenario.build_backend())
-    await server.start()
+    start_task = None
+    if scenario.concurrent_boot:
+        start_task = asyncio.ensure_future(server.start())
+    else:
+        await server.start()
     ctx = ScenarioContext(server, config, shape)
+    ctx.start_task = start_task
     t0 = time.perf_counter()
     error = None
     slo: dict = {}
@@ -181,6 +194,13 @@ async def _run_async(scenario: Scenario, shape: str) -> dict:
             except Exception:
                 pass
         failpoints.registry.reset()
+        if start_task is not None:
+            # a concurrent boot must complete (or surface its error)
+            # before teardown — stopping a half-started server leaks
+            try:
+                await start_task
+            except Exception as exc:
+                error = error or f"boot: {type(exc).__name__}: {exc}"
         await server.stop()
     survived = error is None and not server.shutdown_requested.is_set()
     checks.insert(0, Check(

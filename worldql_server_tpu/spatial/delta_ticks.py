@@ -1,0 +1,203 @@
+"""Temporal-coherence state for delta ticks (ROADMAP item 2).
+
+Tick over tick the query set is near-identical and most entities move
+less than one cube — exactly the regime of repeated range queries over
+massive moving objects (arXiv:1411.3212). Yet every tick the engine
+re-resolved EVERY query from scratch. This module holds the state that
+lets a tick skip the world that did not change:
+
+* **per-cube dirty tracking** — every index mutation marks the touched
+  cube's spatial key with a monotonically increasing mutation sequence
+  number, fed from the same churn stream the LSM delta path already
+  sees (the host is the authority; marking costs one dict store per
+  touched cube);
+* **result reuse cache** — a query whose 128-bit content signature
+  (world id, position bits, sender, replication — two independent
+  64-bit mixes, the same collision budget as the index's dual key
+  families) matched a cached entry AND whose cube has not been dirtied
+  since the entry was computed replays the cached fan-out instead of
+  re-entering the device batch. Only dirty queries ship to the device,
+  at a (smaller) power-of-two capacity tier the boot precompile ladder
+  already covers.
+
+Validity invariant: an entry computed at mutation-sequence ``seq``
+reflects every mutation with sequence <= ``seq`` (the dispatch flushes
+them to the device before computing). A later mutation of the entry's
+cube records a larger sequence in ``dirty``, so the check
+``dirty.get(key, -1) <= entry.seq`` is exact — no grace window, no
+staleness bound to document. Wholesale events that rewrite keys or
+membership (reseed, base rebuild, snapshot restore, resilience
+rebuild) call :meth:`invalidate_all`, which raises ``floor`` past any
+in-flight entry's sequence — entries inserted by a worker-thread
+collect that raced the invalidation fail the ``seq >= floor`` check
+and can never be replayed.
+
+Threading: mutations and dispatch partitioning run on the event-loop
+thread; cache inserts run on the ticker's collect worker thread.
+Every shared structure is a plain dict mutated one key at a time with
+immutable tuple values, so a racing read sees either the old or the
+new entry — both valid under the sequence check.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import MIX_GOLDEN, MIX_M1, MIX_M2
+
+#: cache entries above which the cache resets wholesale (a workload of
+#: ever-fresh positions — pure miss traffic — must not grow host memory
+#: without bound; steady serving sits orders of magnitude below this)
+MAX_CACHE_ENTRIES = 1 << 20
+#: dirty-map entries above which tracking resets wholesale (same
+#: rationale; a reset only costs one cold tick of full recompute)
+MAX_DIRTY_ENTRIES = 1 << 21
+
+_M1 = np.uint64(MIX_M1)
+_M2 = np.uint64(MIX_M2)
+_GOLDEN = np.uint64(MIX_GOLDEN)
+#: signature seeds — disjoint from the index's key families (hashing.py
+#: uses the raw seed and seed + KEY2_OFFSET; these fold a distinct
+#: constant first, so a signature can never alias a spatial key stream)
+_SIG_SEED1 = np.uint64(0x9E3779B97F4A7C15)
+_SIG_SEED2 = np.uint64(0xC2B2AE3D27D4EB4F)
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    x = (x ^ (x >> np.uint64(30))) * _M1
+    x = (x ^ (x >> np.uint64(27))) * _M2
+    return x ^ (x >> np.uint64(31))
+
+
+def _fold(seed: np.uint64, world_ids, pos_bits, sender_ids, repls):
+    h = _mix(seed + _GOLDEN)
+    h = _mix(h ^ world_ids)
+    h = _mix(h ^ pos_bits[:, 0])
+    h = _mix(h ^ pos_bits[:, 1])
+    h = _mix(h ^ pos_bits[:, 2])
+    h = _mix(h ^ sender_ids)
+    return _mix(h ^ repls)
+
+
+def row_signatures(
+    world_ids: np.ndarray,
+    positions: np.ndarray,
+    sender_ids: np.ndarray,
+    repls: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """[M] staged query columns → two independent [M] u64 content
+    signatures. Everything that can change a query's fan-out folds in:
+    interned world id, the raw f64 position BITS (so -0.0 vs 0.0 or a
+    NaN payload can never alias), interned sender and replication.
+    Vectorized — one fused numpy pass, no per-row Python."""
+    with np.errstate(over="ignore"):
+        wid = world_ids.astype(np.int64).view(np.uint64)
+        pos_bits = np.ascontiguousarray(
+            positions, dtype=np.float64
+        ).view(np.uint64)
+        sid = sender_ids.astype(np.int64).view(np.uint64)
+        rep = repls.astype(np.int64).view(np.uint64)
+        return (
+            _fold(_SIG_SEED1, wid, pos_bits, sid, rep),
+            _fold(_SIG_SEED2, wid, pos_bits, sid, rep),
+        )
+
+
+class TemporalCoherence:
+    """Dirty-cube sequence map + result-reuse cache for one backend."""
+
+    def __init__(self, max_entries: int = MAX_CACHE_ENTRIES):
+        #: mutation sequence — bumped once per mutation batch
+        self.seq = 0
+        #: entries with ``seq < floor`` are invalid (wholesale events)
+        self.floor = 0
+        #: cube spatial key → sequence of its latest mutation
+        self.dirty: dict[int, int] = {}
+        #: signature h1 → (h2, cube_key, seq, targets_tuple)
+        self.cache: dict[int, tuple] = {}
+        self.max_entries = max_entries
+        #: cubes marked since the last dispatch (tick.delta churn tag)
+        self.window_marks = 0
+        self.cache_resets = 0
+
+    # -- churn stream (event-loop thread) --
+
+    def note_key(self, key: int) -> None:
+        """Mark one cube dirty (single-subscription mutation path)."""
+        self.seq += 1
+        self.dirty[key] = self.seq
+        self.window_marks += 1
+        if len(self.dirty) > MAX_DIRTY_ENTRIES:
+            self.invalidate_all()
+
+    def note_keys(self, keys) -> None:
+        """Mark a mutation batch's cubes dirty: one sequence bump, one
+        C-level dict fill (``keys`` is an int64 array or int list)."""
+        if len(keys) == 0:
+            return
+        self.seq += 1
+        s = self.seq
+        if isinstance(keys, np.ndarray):
+            keys = keys.tolist()
+        self.dirty.update(zip(keys, [s] * len(keys)))
+        self.window_marks += len(keys)
+        if len(self.dirty) > MAX_DIRTY_ENTRIES:
+            self.invalidate_all()
+
+    def invalidate_all(self) -> None:
+        """Wholesale invalidation (reseed/rebuild/restore): every
+        existing entry — including ones a racing worker-thread collect
+        has not inserted yet — becomes unreplayable."""
+        self.seq += 1
+        self.floor = self.seq
+        self.dirty.clear()
+        self.cache.clear()
+        self.cache_resets += 1
+
+    # -- dispatch partition (event-loop thread) --
+
+    def take_window_marks(self) -> int:
+        marks = self.window_marks
+        self.window_marks = 0
+        return marks
+
+    def partition(self, h1_list, h2_list):
+        """→ ``(reused, dirty_rows)``: per-row replayed target lists
+        (None where the row must recompute) and the row indices of the
+        compute batch. One C-speed bulk dict probe plus a per-row
+        validity check against the dirty map."""
+        cache_get = self.cache.get
+        dirty_get = self.dirty.get
+        floor = self.floor
+        reused: list = [None] * len(h1_list)
+        dirty_rows: list[int] = []
+        for i, (h1, h2) in enumerate(zip(h1_list, h2_list)):
+            e = cache_get(h1)
+            if (
+                e is not None
+                and e[0] == h2
+                and e[2] >= floor
+                and dirty_get(e[1], -1) <= e[2]
+            ):
+                reused[i] = list(e[3])
+            else:
+                dirty_rows.append(i)
+        return reused, dirty_rows
+
+    # -- collect merge (worker thread) --
+
+    def store(self, h1: int, h2: int, key: int, seq: int, targets) -> None:
+        if len(self.cache) >= self.max_entries:
+            # ever-fresh signatures (pure miss traffic): reset rather
+            # than grow without bound — one cold tick, never wrong
+            self.cache.clear()
+            self.cache_resets += 1
+        self.cache[h1] = (h2, key, seq, tuple(targets))
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self.cache),
+            "dirty_cubes": len(self.dirty),
+            "seq": self.seq,
+            "cache_resets": self.cache_resets,
+        }

@@ -85,6 +85,12 @@ def precompile_tiers(
     compiled, the same accounting serving retraces are measured by.
     """
     t0 = time.perf_counter()
+    if min_batch is None and getattr(backend, "_delta_ticks", False):
+        # delta ticks dispatch the DIRTY fraction of each batch at its
+        # own (small) query tier — with reuse doing its job those are
+        # exactly the tiers serving lives on, so the ladder walks all
+        # the way down instead of stopping at the max_batch//8 floor
+        min_batch = 8
     flush = getattr(backend, "flush", None)
     if flush is not None:
         flush()

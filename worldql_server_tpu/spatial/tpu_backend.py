@@ -66,11 +66,12 @@ import jax.numpy as jnp
 from ..protocol.types import Replication, Vector3
 from ..utils import retrace
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
+from .delta_ticks import TemporalCoherence, row_signatures
 from .hashing import (
     MIX_M1, MIX_M2, NO_WORLD, PAD_KEY, n_distinct, next_pow2, pad_to,
     spatial_keys, spatial_keys2,
 )
-from .native_keys import encode_queries
+from .native_keys import encode_queries, query_keys
 
 _log = logging.getLogger(__name__)
 
@@ -901,6 +902,12 @@ class TpuSpatialBackend(SpatialBackend):
         # CSR result-capacity hint for the delivery path; grows on
         # overflow (collect_local_batch)
         self._delivery_cap = 4096
+        # the DELTA sub-batch path sizes its CSR results off its own
+        # hint: dirty partitions are orders of magnitude smaller than
+        # full ticks, and letting them decay the main hint would both
+        # thrash capacity tiers while it halves down and starve the
+        # next full-recompute tick into an overflow retry
+        self._delta_delivery_cap = 4096
 
         # On-device result compaction (pack_csr): pack the lanes the
         # decoder will read into a power-of-two bucket sized to the
@@ -944,6 +951,34 @@ class TpuSpatialBackend(SpatialBackend):
         self.staged_dispatches = 0
         self.list_dispatches = 0
 
+        # Delta ticks (ROADMAP 2, spatial/delta_ticks.py): per-cube
+        # dirty tracking from the churn stream + the result-reuse
+        # cache. OFF by default — the dispatch/collect pipeline is
+        # byte-for-byte the pre-delta path until configure_delta_ticks
+        # enables it (server wiring / bench), and every mutation-path
+        # mark is gated on the flag so the disabled overhead is one
+        # branch per mutation batch.
+        self._delta_ticks = False
+        #: churn fraction above which a delta structure falls back to
+        #: the full path: tombstone-scatter delta sync reverts to the
+        #: device re-sort past this fraction of the built log, and the
+        #: entity plane mirrors it for its dirty-closure sub-tick
+        self.delta_rebuild_threshold = 0.5
+        self._coherence = TemporalCoherence()
+        #: host mirror of the device delta sort order ((built, cap),
+        #: row → sorted position), backing the O(K) tombstone scatter
+        #: into the persistent sorted segment
+        self._delta_sort_pos: tuple | None = None
+        self.delta_reused = 0
+        self.delta_recomputed = 0
+        self.delta_fallbacks = 0
+        self.delta_sync_scatters = 0
+        self.delta_sync_sorts = 0
+        #: the LAST delta dispatch's partition (tick.delta span tags)
+        self.last_delta_stats: dict = {}
+        #: the LAST delta-twin sync's path + wall (bench attribution)
+        self.last_delta_sync: dict = {}
+
         # pid → base rows: lazily built per base epoch (argsort of the
         # peer column, O(S log S) once), then each eviction is two
         # binary searches + a small gather instead of an O(S) scan.
@@ -979,6 +1014,24 @@ class TpuSpatialBackend(SpatialBackend):
 
     def supports_staged_dispatch(self) -> bool:
         return True
+
+    def supports_delta_ticks(self) -> bool:
+        """Whether this backend can serve delta ticks (result reuse +
+        incremental delta sync). The sharded backend conservatively
+        says no for now — reuse must be correct before it is fast."""
+        return True
+
+    def configure_delta_ticks(self, mode: str) -> bool:
+        """Arm/disarm delta ticks: ``on``/``auto`` enable when the
+        backend supports them, ``off`` restores the pre-delta pipeline
+        byte for byte. Enabling starts from a cold cache (mutations
+        made while tracking was off were never marked). Returns the
+        resulting state."""
+        want = mode in ("on", "auto") and self.supports_delta_ticks()
+        if want and not self._delta_ticks:
+            self._coherence.invalidate_all()
+        self._delta_ticks = want
+        return want
 
     def interning_maps(self):
         """Enqueue-time interning contract (engine/staging.py): both
@@ -1104,6 +1157,10 @@ class TpuSpatialBackend(SpatialBackend):
             rows_d = np.empty(0, np.intp)
         if rows_b.size == 0 and rows_d.size == 0:
             return False
+        if self._delta_ticks:
+            self._coherence.note_keys(np.concatenate([
+                self._bk[rows_b], self._dk[rows_d]
+            ]))
 
         in_flight = self._compaction is not None
         if rows_b.size:
@@ -1148,6 +1205,8 @@ class TpuSpatialBackend(SpatialBackend):
         self._dp[row] = pid
         self._dn += 1
         self._delta_live += 1
+        if self._delta_ticks:
+            self._coherence.note_key(key)
         self._delta_index[(key, pid)] = row
         self._delta_pid_rows.setdefault(pid, []).append(row)
         self._delta_keyrow.setdefault(key, row)
@@ -1172,6 +1231,8 @@ class TpuSpatialBackend(SpatialBackend):
 
     def _tombstone(self, found: tuple[str, int], key: int, pid: int) -> None:
         seg, row = found
+        if self._delta_ticks:
+            self._coherence.note_key(key)
         in_flight = self._compaction is not None
         if seg == "base":
             self._bp[row] = -1
@@ -1224,6 +1285,8 @@ class TpuSpatialBackend(SpatialBackend):
 
         if new_rows.size == 0:
             return 0
+        if self._delta_ticks:
+            self._coherence.note_keys(keys[new_rows])
         self._bulk_append(
             keys[new_rows], np.full(new_rows.size, wid, np.int32),
             cubes[new_rows], pids[new_rows],
@@ -1284,6 +1347,8 @@ class TpuSpatialBackend(SpatialBackend):
                 rows_found = rows[match]
                 base_hit[qidx[match]] = True
                 if rows_found.size:
+                    if self._delta_ticks:
+                        self._coherence.note_keys(self._bk[rows_found])
                     self._bp[rows_found] = -1
                     self._pending_dead.extend(rows_found.tolist())
                     self._base_dead += int(rows_found.size)
@@ -1297,6 +1362,7 @@ class TpuSpatialBackend(SpatialBackend):
 
         # delta rows: dict lookups for the batch rows the base missed
         delta_removed = []
+        delta_removed_keys: list[int] = []
         if self._delta_index:
             miss = np.flatnonzero(~base_hit)
             for i in miss:
@@ -1306,11 +1372,14 @@ class TpuSpatialBackend(SpatialBackend):
                     continue
                 self._dp[row] = -1
                 delta_removed.append(pair[1])
+                delta_removed_keys.append(pair[0])
                 if row < self._delta_built_n:
                     self._pending_delta_dead.append(row)
                 if in_flight and row < consumed:
                     self._replay.append(pair)
             if delta_removed:
+                if self._delta_ticks:
+                    self._coherence.note_keys(delta_removed_keys)
                 self._delta_live -= len(delta_removed)
                 self._delta_stale = True
                 removed_pids.append(np.asarray(delta_removed, np.int64))
@@ -1590,7 +1659,9 @@ class TpuSpatialBackend(SpatialBackend):
                 _log.warning("background compaction failed, will retry: %s", err)
 
         # 0. deferred base upload (bulk load / restore / sync rebuild)
-        self._upload_stale_base()
+        # — designated full-path site: the base was rebuilt wholesale
+        # off the tick path and owes the device exactly one ship
+        self._upload_stale_base()  # wql: allow(full-rebuild-on-tick)
 
         if not self._dirty:
             return
@@ -1647,7 +1718,7 @@ class TpuSpatialBackend(SpatialBackend):
                 # healthy overrun (churn outpacing one compaction) stays
                 # off the event loop: the oversized delta keeps serving
                 # correctly while the next background fold catches up.
-                self._compact_sync()
+                self._compact_sync()  # wql: allow(full-rebuild-on-tick) — last-resort sync fold (persistent device failure)
             elif (
                 (
                     self._delta_live > threshold
@@ -1661,14 +1732,28 @@ class TpuSpatialBackend(SpatialBackend):
     def _sync_delta(self) -> None:
         """Bring the device delta twin up to date with the host log.
         Transfers only the NEW rows chunk + tombstone indices; the
-        key-sort runs on device (one fused launch per flush)."""
+        key-sort runs on device (one fused launch per flush).
+
+        With delta ticks armed, a flush whose only changes are
+        tombstones skips the re-sort entirely: the persistent SORTED
+        segment takes one O(K) peer scatter at host-mapped sorted
+        positions (keys never change, so the run structure and probe
+        table stay valid — the same contract the base segment's
+        tombstone scatter has always relied on). Past
+        ``delta_rebuild_threshold`` of the built log the full re-sort
+        path takes over (tombstone debt — one sort re-amortizes it)."""
         dn = self._dn
         if dn == 0:
             self._delta_buf = None
             self._delta_buf_cap = 0
             self._delta_built_n = 0
             self._delta_bundle = None
+            self._delta_sort_pos = None
             self._pending_delta_dead.clear()
+            return
+
+        if self._delta_tombstones_only():
+            self._scatter_sorted_tombstones()
             return
 
         built = self._delta_built_n
@@ -1710,13 +1795,100 @@ class TpuSpatialBackend(SpatialBackend):
             self._pending_delta_dead.clear()
 
         self._delta_k = next_pow2(self._delta_max_run, 8)
+        t0 = time.perf_counter()
         self._delta_bundle = {
-            "dev": self._sort_delta(
+            # designated full-rebuild site: new rows were appended (or
+            # tombstone debt crossed the threshold) — the sorted
+            # segment must rebuild from the insertion-order buffer
+            "dev": self._sort_delta(  # wql: allow(full-rebuild-on-tick)
                 self._delta_buf,
                 probe_buckets_for(len(self._delta_key_count)),
             ),
             "cap": self._delta_buf_cap,
         }
+        self._delta_sort_pos = None  # mapping is for the OLD sort state
+        self.delta_sync_sorts += 1
+        self.last_delta_sync = {
+            "path": "sort",
+            "ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "rows": dn,
+        }
+
+    def _delta_tombstones_only(self) -> bool:
+        """True when this flush can skip the delta re-sort: delta
+        ticks armed, a sorted device segment exists and matches the
+        log (no new rows since it was built), the only pending work is
+        tombstones, their volume is under the rebuild threshold, and
+        this backend owns plain single-device segments (the sharded
+        backend's replicated shardings keep the full path)."""
+        pending = len(self._pending_delta_dead)
+        return (
+            self._delta_ticks
+            and pending > 0
+            and self._dn == self._delta_built_n
+            and self._delta_buf is not None
+            and self._delta_bundle is not None
+            and self._delta_scatter_supported()
+            and pending <= max(
+                1, int(self.delta_rebuild_threshold * self._delta_built_n)
+            )
+        )
+
+    def _delta_scatter_supported(self) -> bool:
+        """Single-chip segments take the in-place sorted scatter; the
+        sharded backend overrides to False (replicated shardings)."""
+        return True
+
+    def _scatter_sorted_tombstones(self) -> None:
+        """O(K) incremental update of the persistent device hash: land
+        pending tombstones in BOTH delta twins — the insertion-order
+        buffer (so future sorts/compactions see them) and the sorted
+        serving segment at host-mapped positions (so this flush ships
+        K indices instead of re-sorting the whole log). Keys, run
+        remainders and the probe table are untouched — tombstones
+        rewrite peers only."""
+        t0 = time.perf_counter()
+        rows = np.asarray(self._pending_delta_dead, np.int32)
+        padded = pad_to(rows, next_pow2(rows.size),
+                        np.int32(self._delta_buf_cap))
+        self._delta_buf = (
+            *self._delta_buf[:2],
+            self._scatter_delta_dead(self._delta_buf[2], padded),
+        )
+        pos = self._delta_sorted_positions()
+        sorted_rows = pad_to(
+            pos[rows].astype(np.int32), next_pow2(rows.size),
+            np.int32(self._delta_buf_cap),
+        )
+        dev = self._delta_bundle["dev"]
+        self._delta_bundle = {
+            **self._delta_bundle,
+            "dev": (*dev[:2], _scatter_dead(dev[2], sorted_rows), *dev[3:]),
+        }
+        self._pending_delta_dead.clear()
+        self.delta_sync_scatters += 1
+        self.last_delta_sync = {
+            "path": "scatter",
+            "ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "rows": int(rows.size),
+        }
+
+    def _delta_sorted_positions(self) -> np.ndarray:
+        """Host mirror of the device delta sort: log row → position in
+        the sorted segment. Both sides run a STABLE ascending sort of
+        the identical padded key array (keys never change after
+        append), so the permutations agree exactly. Cached per
+        (built, cap) build state; any event that rewrites log rows
+        (compaction tail shift, clear) resets the cache explicitly."""
+        state = (self._delta_built_n, self._delta_buf_cap)
+        if self._delta_sort_pos is None or self._delta_sort_pos[0] != state:
+            keys = np.full(self._delta_buf_cap, PAD_KEY, np.int64)
+            keys[: self._delta_built_n] = self._dk[: self._delta_built_n]
+            order = np.argsort(keys, kind="stable")
+            pos = np.empty(self._delta_buf_cap, np.int64)
+            pos[order] = np.arange(self._delta_buf_cap)
+            self._delta_sort_pos = (state, pos)
+        return self._delta_sort_pos[1]
 
     # -- delta device-op seams (sharded backend overrides with
     # replicated shardings) --
@@ -1975,6 +2147,7 @@ class TpuSpatialBackend(SpatialBackend):
         self._delta_built_n = 0
         self._pending_delta_dead = []
         self._delta_bundle = None
+        self._delta_sort_pos = None  # log rows shifted — stale mapping
         self._delta_stale = True
         self._dirty = True
 
@@ -1990,6 +2163,11 @@ class TpuSpatialBackend(SpatialBackend):
         reseed), padding host arrays to the device capacity so host row
         indices always mirror the device layout."""
         self._epoch += 1
+        if self._delta_ticks:
+            # wholesale membership/key rewrite: nothing cached before
+            # this instant may ever replay (reseed changes every key;
+            # a bulk fold can carry rows the churn stream never marked)
+            self._coherence.invalidate_all()
         n = int(keys.size)
         self._base_pid_order = None
         # any successful base install (bulk fold, reseed, sync fold)
@@ -2029,6 +2207,7 @@ class TpuSpatialBackend(SpatialBackend):
         self._replay = []
 
     def _clear_delta(self) -> None:
+        self._delta_sort_pos = None
         self._dn = 0
         self._delta_live = 0
         self._delta_index = {}
@@ -2280,6 +2459,17 @@ class TpuSpatialBackend(SpatialBackend):
             (int(q.replication) for q in queries), dtype=np.int8, count=m  # wql: allow(per-query-python-loop) — the legacy list-path encode
         )
         self.list_dispatches += 1
+        if self._delta_ticks:
+            # object-list dispatches (staging desync, CPU-compat API)
+            # bypass the reuse cache: count the fallback so a serving
+            # path stuck off staging is visible in the delta stats
+            self.delta_fallbacks += 1
+            self.last_delta_stats = {
+                "batch": m, "reused": 0, "recomputed": m,
+                "churn_rows": self._coherence.take_window_marks(),
+                "dirty_cubes": len(self._coherence.dirty),
+                "fallback": "list_path",
+            }
         return self._dispatch_encoded(
             m, world_ids, positions, sender_ids, repls, t_start,
             staged=False,
@@ -2298,18 +2488,114 @@ class TpuSpatialBackend(SpatialBackend):
             return (0, None, {})
         t_start = time.perf_counter()
         self.staged_dispatches += 1
+        if self._delta_ticks:
+            return self._dispatch_delta(
+                m, world_ids, positions, sender_ids, repls, t_start
+            )
         return self._dispatch_encoded(
             m, world_ids, positions, sender_ids, repls, t_start,
             staged=True,
         )
 
+    def _dispatch_delta(
+        self, m, world_ids, positions, sender_ids, repls, t_start,
+    ):
+        """Temporal-coherence dispatch (delta ticks armed): partition
+        the staged batch by the reuse cache — rows whose content
+        signature matches a cached entry with a clean cube replay that
+        entry's fan-out; only the DIRTY rows enter the device batch,
+        at their own (smaller) capacity tier. The handle carries the
+        replayed rows and the compute sub-batch; collect merges them
+        back in query order and refreshes the cache."""
+        co = self._coherence
+        h1, h2 = row_signatures(world_ids, positions, sender_ids, repls)
+        h1_list = h1.tolist()
+        h2_list = h2.tolist()
+        reused, dirty_rows = co.partition(h1_list, h2_list)
+        n_dirty = len(dirty_rows)
+        self.delta_reused += m - n_dirty
+        self.delta_recomputed += n_dirty
+        self.last_delta_stats = {
+            "batch": m,
+            "reused": m - n_dirty,
+            "recomputed": n_dirty,
+            "churn_rows": co.take_window_marks(),
+            "dirty_cubes": len(co.dirty),
+            "fallback": "",
+        }
+        seq_now = co.seq
+        if n_dirty == 0:
+            # every row replayed: no device work at all this tick
+            self.flush()  # index mutations still owe their device sync
+            self.last_device_timing = {
+                "encode_ms": (time.perf_counter() - t_start) * 1e3,
+                "h2d_ms": 0.0, "d2h_enqueue_ms": 0.0,
+                "compute_ms": 0.0, "d2h_ms": 0.0,
+                "path": "reuse", "staged": True, "query_cap": 0,
+            }
+            return (m, ("tc", reused, None, None, (), (), (), seq_now),
+                    dict(self.last_device_timing))
+        if n_dirty == m:
+            # cold cache / all-dirty: dispatch the batch unsplit (no
+            # gather cost) but still record results for future reuse
+            dkeys, _ = query_keys(
+                world_ids, positions, self.cube_size, self._seed
+            )
+            inner = self._dispatch_encoded(
+                m, world_ids, positions, sender_ids, repls, t_start,
+                staged=True,
+            )
+            return (inner[0], ("tc", reused, None, inner,
+                               h1_list, h2_list, dkeys.tolist(), seq_now),
+                    inner[2])
+        idx = np.asarray(dirty_rows, np.intp)
+        sub_wid = world_ids[idx]
+        sub_pos = np.ascontiguousarray(positions[idx])
+        sub_sid = sender_ids[idx]
+        sub_repl = repls[idx]
+        dkeys, _ = query_keys(sub_wid, sub_pos, self.cube_size, self._seed)
+        inner = self._dispatch_encoded(
+            n_dirty, sub_wid, sub_pos, sub_sid, sub_repl, t_start,
+            staged=True, delta_sub=True,
+        )
+        return (m, ("tc", reused, idx, inner,
+                    [h1_list[i] for i in dirty_rows],
+                    [h2_list[i] for i in dirty_rows],
+                    dkeys.tolist(), seq_now),
+                inner[2])
+
+    def _collect_delta(self, m, payload) -> list[list[uuid_mod.UUID]]:
+        """Collect half of :meth:`_dispatch_delta`: wait out the dirty
+        sub-batch (if any), splice replayed rows back in query order,
+        and insert the recomputed fan-outs into the reuse cache under
+        the dispatch-time sequence snapshot. Runs on the collect
+        worker thread — cache inserts are single dict stores with
+        immutable values (see delta_ticks.py threading note)."""
+        _, reused, idx, inner, dh1, dh2, dkeys, seq_now = payload
+        if inner is None:
+            return reused
+        sub = self.collect_local_batch(inner)
+        co = self._coherence
+        if idx is None:  # all-dirty: sub IS the batch, in order
+            for j, targets in enumerate(sub):
+                co.store(dh1[j], dh2[j], dkeys[j], seq_now, targets)
+            return sub
+        out = reused
+        for j, i in enumerate(idx.tolist()):
+            out[i] = sub[j]
+            co.store(dh1[j], dh2[j], dkeys[j], seq_now, sub[j])
+        return out
+
     def _dispatch_encoded(
         self, m, world_ids, positions, sender_ids, repls, t_start,
-        *, staged: bool,
+        *, staged: bool, delta_sub: bool = False,
     ):
         """Shared launch tail of both dispatch paths: flush, quantize/
         hash/pad, pick the result layout, launch, enqueue the D2H
-        prefetch. Returns the ``(m, payload, timing)`` handle."""
+        prefetch. Returns the ``(m, payload, timing)`` handle.
+        ``delta_sub`` marks a delta-tick dirty partition: it sizes the
+        CSR result off (and adapts) the sub-path's own capacity hint
+        instead of the full-tick one."""
         self.flush()
         segs, ks, kinds = self._segments()
         if not segs:
@@ -2330,8 +2616,11 @@ class TpuSpatialBackend(SpatialBackend):
         # clamped t_cap) always escapes instead of re-dispatching
         # forever.
         ceiling = next_pow2(m * sum(ks))
+        hint = (
+            self._delta_delivery_cap if delta_sub else self._delivery_cap
+        )
         t_cap = self._csr_effective_cap(next_pow2(max(
-            self._delivery_cap,
+            hint,
             # zone-A floor: one identity row per (padded query, segment)
             CSR_ROW * self._query_cap(m) * len(segs) + 64,
         )), qtuple, segs)
@@ -2342,22 +2631,26 @@ class TpuSpatialBackend(SpatialBackend):
         if t_cap >= ceiling:
             (tgt,) = self._launch(qtuple, segs, ks, kinds)
             timing = self._dispatch_timing(
-                t_start, t_encoded, path="dense", staged=staged, m=m
+                t_start, t_encoded, path="dense", staged=staged, m=m,
+                delta_sub=delta_sub,
             )
             return (m, ("dense", tgt), timing)
         result = self._launch(qtuple, segs, ks, kinds, csr_cap=t_cap)
         timing = self._dispatch_timing(
-            t_start, t_encoded, path="csr", staged=staged, m=m
+            t_start, t_encoded, path="csr", staged=staged, m=m,
+            delta_sub=delta_sub,
         )
         return (m, ("csr", t_cap, result, (qtuple, segs, ks, kinds)),
                 timing)
 
     def _dispatch_timing(self, t_start: float, t_encoded: float, *,
-                         path: str, staged: bool, m: int) -> dict:
+                         path: str, staged: bool, m: int,
+                         delta_sub: bool = False) -> dict:
         """This dispatch's host-side timing legs. The dict RIDES THE
         HANDLE to its own collect — pairing is structural, so an
         errored/dropped collect can never desync attribution at
-        pipeline depth > 1 (the old FIFO deque could)."""
+        pipeline depth > 1 (the old FIFO deque could). ``delta_sub``
+        rides along so the collect adapts the right capacity hint."""
         now = time.perf_counter()
         return {
             "encode_ms": (t_encoded - t_start) * 1e3,
@@ -2368,6 +2661,7 @@ class TpuSpatialBackend(SpatialBackend):
             "d2h_enqueue_ms": self._last_prefetch_ms,
             "path": path,
             "staged": staged,
+            "delta_sub": delta_sub,
             "query_cap": self._query_cap(m),
         }
 
@@ -2380,6 +2674,10 @@ class TpuSpatialBackend(SpatialBackend):
         m, payload, timing = handle
         if payload is None:
             return [[] for _ in range(m)]
+        if payload[0] == "tc":
+            # delta-tick handle: replayed rows + dirty sub-batch; the
+            # inner handle (when any) carries its own timing legs
+            return self._collect_delta(m, payload)
         # timing rides the handle (see _dispatch_timing): copy before
         # merging so a re-collect of the same handle (drain after a
         # cancelled collect) starts from the dispatch-side legs
@@ -2403,9 +2701,13 @@ class TpuSpatialBackend(SpatialBackend):
             # the hint must keep adapting here too, or a flash-crowd
             # inflation would park every batch on the dense ceiling
             # path forever
-            self._adapt_delivery_cap(counts, grow=False)
+            self._adapt_delivery_cap(
+                counts, grow=False,
+                delta_sub=bool(timing.get("delta_sub")),
+            )
             return self._decode_csr(counts, flat, m)
         _, t_cap, (counts, flat, total), ctx = payload
+        delta_sub = bool(timing.get("delta_sub"))
         t_wait = time.perf_counter()
         total = int(total)  # wql: allow(jax-host-sync) — collect point
         # the total is the tick's designated device-wait point: the
@@ -2418,11 +2720,16 @@ class TpuSpatialBackend(SpatialBackend):
             # for future ticks. ``total`` is exact unless it is the
             # t_cap+1 layout-overflow sentinel, so convergence is one
             # tick, not log2 doubling steps.
-            self._delivery_cap = max(
+            grown = max(
                 t_cap * 2 if total == t_cap + 1
                 else next_pow2(2 * total),
-                self._delivery_cap,
+                self._delta_delivery_cap if delta_sub
+                else self._delivery_cap,
             )
+            if delta_sub:
+                self._delta_delivery_cap = grown
+            else:
+                self._delivery_cap = grown
             qtuple, segs, ks, kinds = ctx
             t_fetch = time.perf_counter()
             tgt = np.asarray(  # wql: allow(jax-host-sync, full-fetch-on-tick) — overflow re-resolve
@@ -2440,7 +2747,7 @@ class TpuSpatialBackend(SpatialBackend):
         # its per-batch-shard flat regions
         t_fetch = time.perf_counter()
         counts = np.asarray(counts)  # wql: allow(jax-host-sync) — collect
-        self._adapt_delivery_cap(counts, grow=True)
+        self._adapt_delivery_cap(counts, grow=True, delta_sub=delta_sub)
         packed = self._compact_fetch(
             payload[2][0], flat, total, t_cap
         )
@@ -2518,10 +2825,13 @@ class TpuSpatialBackend(SpatialBackend):
             out.append(lst)
         return out
 
-    def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool) -> None:
+    def _adapt_delivery_cap(self, counts: np.ndarray, *, grow: bool,
+                            delta_sub: bool = False) -> None:
         """Track the capacity the observed tick actually needed. Grows
         immediately, decays by halves (one flash-crowd tick must not
-        inflate every future tick's D2H)."""
+        inflate every future tick's D2H). Delta sub-batches adapt
+        their OWN hint — a dirty partition's tiny footprint must not
+        halve the full-tick hint into an overflow retry."""
         # the footprint is the ZONED layout (match_run_csr) for raw
         # [M, nseg] counts, or plain row padding for the dense
         # fallback's exact [M] counts
@@ -2532,11 +2842,13 @@ class TpuSpatialBackend(SpatialBackend):
                 ((counts + CSR_ROW - 1) // CSR_ROW).sum()
             ) * CSR_ROW
         needed = next_pow2(max(2 * padded, 64))
-        if needed >= self._delivery_cap:
+        attr = "_delta_delivery_cap" if delta_sub else "_delivery_cap"
+        cap = getattr(self, attr)
+        if needed >= cap:
             if grow:
-                self._delivery_cap = needed
+                setattr(self, attr, needed)
         else:
-            self._delivery_cap = max(needed, self._delivery_cap // 2)
+            setattr(self, attr, max(needed, cap // 2))
 
     def _decode_csr(self, counts, flat, m: int) -> list[list[uuid_mod.UUID]]:
         """Walk the CSR layout into per-query UUID lists.
@@ -2693,6 +3005,14 @@ class TpuSpatialBackend(SpatialBackend):
             "last_fetch_bytes": self.last_collect_stats["fetch_bytes"],
             "last_compaction_bucket":
                 self.last_collect_stats["compaction_bucket"],
+            "delta_ticks": self._delta_ticks,
+            "delta_reused": self.delta_reused,
+            "delta_recomputed": self.delta_recomputed,
+            "delta_fallbacks": self.delta_fallbacks,
+            "delta_sync_scatters": self.delta_sync_scatters,
+            "delta_sync_sorts": self.delta_sync_sorts,
+            "delta_cache_entries": len(self._coherence.cache),
+            "delta_cache_resets": self._coherence.cache_resets,
         }
 
     # endregion
