@@ -3322,13 +3322,302 @@ def bench_config10(args) -> dict:
     }
 
 
+async def _cluster_point(n_shards: int, window_s: float,
+                         max_batch: int) -> dict:
+    """One cluster_scaling point: boot a router + ``n_shards`` shard
+    server subprocesses, drive a paced-burst LocalMessage storm spread
+    over one world per shard, and close the books with the EXACT shed
+    audit: offered == admitted + shed-at-router + shed-at-shard
+    (admitted = shard-arrived − shard-shed; the router's forward leg
+    is lossless ZMQ, so offered − router-shed must equal arrived)."""
+    import uuid as uuid_mod
+
+    from worldql_server_tpu.cluster import ClusterRuntime, WorldMap
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.protocol.types import (
+        Instruction as Ins, Message as Msg, Vector3 as V3,
+    )
+    from worldql_server_tpu.scenarios.client import ZmqPeer, free_port_block
+
+    config = Config(
+        store_url="memory://",
+        http_enabled=False, ws_enabled=False,
+        zmq_server_host="127.0.0.1",
+        zmq_server_port=free_port_block(n_shards + 1),
+        spatial_backend="cpu", tick_interval=0.02,
+        max_batch=max_batch, overload="on",
+        supervisor_backoff=0.005,
+        cluster_shards=n_shards,
+    )
+    world_map = WorldMap(n_shards)
+
+    def world_for(shard: int) -> str:
+        for i in range(10_000):
+            name = f"scale{i}"
+            if world_map.shard_of_world(name) == shard:
+                return name
+        raise AssertionError("no world for shard")
+
+    def uuid_for(shard: int) -> uuid_mod.UUID:
+        while True:
+            u = uuid_mod.uuid4()
+            if world_map.shard_of_peer(u) == shard:
+                return u
+
+    worlds = [world_for(i) for i in range(n_shards)]
+    pos = V3(5.0, 5.0, 5.0)
+    runtime = ClusterRuntime(config)
+    await runtime.start()
+    clients: list[ZmqPeer] = []
+    try:
+        async def connect(**kw) -> ZmqPeer:
+            last = None
+            for _ in range(100):
+                try:
+                    peer = await ZmqPeer.connect(
+                        config.zmq_server_port, **kw
+                    )
+                    clients.append(peer)
+                    return peer
+                except Exception as exc:
+                    last = exc
+                    await asyncio.sleep(0.05)
+            raise AssertionError(f"bench client connect failed: {last!r}")
+
+        flooders = [
+            (await connect(), worlds[i % n_shards])
+            for i in range(2 * n_shards)
+        ]
+        for client, world in flooders:
+            await client.send(Msg(
+                instruction=Ins.AREA_SUBSCRIBE, world_name=world,
+                position=pos,
+            ))
+        # cross-shard latency pair (n >= 2): receiver homed on shard
+        # 0, world owned by shard 1 — every frame crosses the 1→0 ring
+        rx = tx = None
+        xshard_ms: list[float] = []
+        if n_shards >= 2:
+            rx = await connect(peer_uuid=uuid_for(0))
+            tx = await connect(peer_uuid=uuid_for(1))
+            for c in (rx, tx):
+                await c.send(Msg(
+                    instruction=Ins.AREA_SUBSCRIBE,
+                    world_name=worlds[1], position=pos,
+                ))
+        await asyncio.sleep(0.3)
+
+        stop = asyncio.Event()
+
+        async def flood(client: ZmqPeer, world: str,
+                        pace_s: float) -> int:
+            sent = 0
+            while not stop.is_set():
+                for _ in range(16):
+                    await client.send(Msg(
+                        instruction=Ins.LOCAL_MESSAGE, world_name=world,
+                        position=pos, parameter="load",
+                    ))
+                    sent += 1
+                await asyncio.sleep(pace_s)
+            return sent
+
+        async def xshard_traffic() -> int:
+            sent = 0
+            while not stop.is_set():
+                await tx.send(Msg(
+                    instruction=Ins.LOCAL_MESSAGE, world_name=worlds[1],
+                    position=pos, parameter=f"x:{time.monotonic_ns()}",
+                ))
+                sent += 1
+                await asyncio.sleep(0.05)
+            return sent
+
+        async def xshard_receiver() -> None:
+            while True:
+                got = await rx.recv(30)
+                if (
+                    got.instruction == Ins.LOCAL_MESSAGE
+                    and got.parameter
+                    and got.parameter.startswith("x:")
+                ):
+                    xshard_ms.append(
+                        (time.monotonic_ns()
+                         - int(got.parameter.split(":", 1)[1])) / 1e6
+                    )
+
+        async def stopper(for_s: float):
+            await asyncio.sleep(for_s)
+            stop.set()
+
+        # settle helper: shard counters arrive on ~1s state pushes —
+        # wait until two consecutive reads agree (queues drained,
+        # books closed) before reading a phase's totals
+        def shard_counters() -> list[dict]:
+            return [
+                dict(runtime.supervisor.shard_state(i).get(
+                    "counters", {}
+                ))
+                for i in range(n_shards)
+            ]
+
+        async def settle() -> list[dict]:
+            prev = shard_counters()
+            deadline = time.perf_counter() + 20
+            while time.perf_counter() < deadline:
+                await asyncio.sleep(1.3)
+                cur = shard_counters()
+                if cur == prev and all(c for c in cur):
+                    return cur
+                prev = cur
+            return prev
+
+        def totals(counters: list[dict]) -> tuple[int, int]:
+            arrived = sum(
+                c.get("messages.local_message", 0) for c in counters
+            )
+            shed = sum(
+                c.get("overload.shed_local", 0)
+                + c.get("overload.drop_oldest", 0)
+                for c in counters
+            )
+            return arrived, shed
+
+        receiver = (
+            asyncio.ensure_future(xshard_receiver())
+            if rx is not None else None
+        )
+        try:
+            # phase 1 — BALANCED: every flooder bursts its own shard's
+            # world; this is the admitted-throughput measurement
+            tasks = [flood(c, w, 0.002) for c, w in flooders]
+            if tx is not None:
+                tasks.append(xshard_traffic())
+            tasks.append(stopper(window_s))
+            results = await asyncio.gather(*tasks)
+            offered_balanced = sum(results[: len(flooders)])
+            offered = offered_balanced
+            if tx is not None:
+                offered += results[len(flooders)]
+            await asyncio.sleep(1.0)  # in-flight frames land
+            arrived1, shed1 = totals(await settle())
+            admitted_balanced = arrived1 - shed1
+
+            # phase 2 — HOTSPOT: the whole fleet converges on shard
+            # 0's world until it REJECTs and the refusals move to the
+            # router tier (the shed-accounting leg of the audit)
+            stop.clear()
+            hot_tasks = [
+                flood(c, worlds[0], 0.001) for c, _ in flooders
+            ]
+            hot_tasks.append(stopper(min(window_s, 1.5)))
+            hot_results = await asyncio.gather(*hot_tasks)
+            offered += sum(hot_results[: len(flooders)])
+            await asyncio.sleep(1.0)
+        finally:
+            if receiver is not None:
+                receiver.cancel()
+                try:
+                    await receiver
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        arrived, shed_shard = totals(await settle())
+        router_counters = runtime.metrics.snapshot()["counters"]
+        shed_router = router_counters.get("cluster.router_shed_local", 0)
+        admitted = arrived - shed_shard
+        audit_exact = offered == admitted + shed_shard + shed_router
+        xs = sorted(xshard_ms)
+        return {
+            "shards": n_shards,
+            "offered": offered,
+            "arrived": arrived,
+            "admitted": admitted,
+            "admitted_per_s": round(admitted_balanced / window_s, 1),
+            "shed_router": shed_router,
+            "shed_shard": shed_shard,
+            "audit_exact": bool(audit_exact),
+            "xshard_frames": len(xs),
+            "xshard_p99_ms": (
+                round(xs[min(len(xs) - 1, int(len(xs) * 0.99))], 2)
+                if xs else None
+            ),
+            "router_forwarded":
+                router_counters.get("cluster.router_forwarded", 0),
+        }
+    finally:
+        for client in clients:
+            try:
+                client.close()
+            except Exception:
+                pass
+        await runtime.stop()
+
+
+def bench_config11(args) -> dict:
+    """Cluster horizontal-serving scaling curve (ISSUE 14): 1→N shard
+    processes behind the router tier on THIS container, admitted
+    LocalMessage throughput and cross-shard delivery p99 per point,
+    with the router-tier shed accounting closed EXACTLY per point
+    (offered == admitted + shed-at-router + shed-at-shard). On a
+    1-core box the shards time-share the core, so the curve measures
+    the serving stack's overhead and accounting honesty, not speedup —
+    the near-linear claim belongs to a multi-core/multi-chip run.
+    ``--smoke`` asserts every point's audit is exact, the router tier
+    provably shed for a drowning shard, and cross-shard delivery
+    flowed. NOTE: shard subprocesses inherit the environment — on a
+    TPU-less box with libtpu installed, JAX_PLATFORMS=cpu must be set
+    (the CI bench step does)."""
+    shard_counts = [1, 2] if args.quick else [1, 2, 4]
+    window_s = 1.5 if args.quick else 5.0
+    max_batch = 32 if args.quick else 256
+    points = []
+    for n in shard_counts:
+        log(f"cluster point: {n} shard(s), {window_s}s window...")
+        point = asyncio.run(_cluster_point(n, window_s, max_batch))
+        log(
+            f"  {n} shard(s): offered {point['offered']:,} -> admitted "
+            f"{point['admitted']:,} ({point['admitted_per_s']:,}/s), "
+            f"router shed {point['shed_router']:,}, shard shed "
+            f"{point['shed_shard']:,}, audit "
+            f"{'EXACT' if point['audit_exact'] else 'BROKEN'}, "
+            f"xshard p99 {point['xshard_p99_ms']} ms"
+        )
+        points.append(point)
+
+    audit_failures = sum(1 for p in points if not p["audit_exact"])
+    if args.smoke:
+        assert audit_failures == 0, (
+            f"smoke: shed accounting broke: {points}"
+        )
+        assert all(p["shed_router"] > 0 for p in points), (
+            "smoke: the router tier never shed for a drowning shard"
+        )
+        assert all(p["admitted"] > 0 for p in points)
+        multi = [p for p in points if p["shards"] >= 2]
+        assert multi and all(p["xshard_frames"] > 0 for p in multi), (
+            "smoke: cross-shard delivery never flowed"
+        )
+        log("smoke: cluster audit exact at every point, router-tier "
+            "shed fired, cross-shard delivery flowed")
+    return {
+        "metric": "cluster_audit_failures",
+        "value": audit_failures,
+        "unit": "count",
+        "audit_failures": audit_failures,
+        "max_admitted_per_s": max(p["admitted_per_s"] for p in points),
+        "points": points,
+        "config": 11,
+    }
+
+
 # --------------------------------------------------------------------
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -3340,7 +3629,11 @@ def main() -> None:
                          "p99 under storm); 10 = adversarial scenario "
                          "suite (flash crowd, battle royale, "
                          "reconnect storm, game tick — survival + SLO "
-                         "checks over real ZMQ)")
+                         "checks over real ZMQ); 11 = cluster_scaling "
+                         "(1→N shard server processes behind the "
+                         "router tier: admitted msgs/s + cross-shard "
+                         "p99 per point, exact router/shard shed "
+                         "audit)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -3379,14 +3672,14 @@ def main() -> None:
         1: bench_config1, 2: bench_config2, 3: bench_config3,
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
-        10: bench_config10,
+        10: bench_config10, 11: bench_config11,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11]
     else:
         selected = [args.config or 5]
     for n in selected:

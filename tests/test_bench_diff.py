@@ -118,13 +118,13 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
     assert main([str(baseline), str(baseline), *gate]) == 0
 
-    # JSON-lines baseline: one record per smoke config (5+8+9+10)
+    # JSON-lines baseline: one record per smoke config (5+8+9+10+11)
     records = [
         json.loads(line)
         for line in baseline.read_text().splitlines() if line.strip()
     ]
     by_config = {rec["config"]: rec for rec in records}
-    assert set(by_config) == {5, 8, 9, 10}
+    assert set(by_config) == {5, 8, 9, 10, 11}
     # config 9's gate leaves are the admission RATES; the volatile
     # fsync-bound record p99s are pruned from the baseline on purpose
     # (the bench still reports them) — pin that they stay pruned
@@ -212,6 +212,24 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(lost), *gate]) == 1
+
+    # the ISSUE 14 cluster gate: the config-11 baseline keeps ONLY the
+    # shed-audit counts (throughput/latency points are 1-core-bound
+    # and pruned), and one point whose offered != admitted +
+    # shed-at-router + shed-at-shard flags on its own ("failures" is
+    # lower-is-better; 0 -> 1 crosses the --min-abs floor)
+    assert by_config[11]["audit_failures"] == 0
+    no_timing_leaves(by_config[11])
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 11:
+            rec["audit_failures"] = 1
+            rec["value"] = 1
+    broken_audit = tmp_path / "broken_cluster_audit.json"
+    broken_audit.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(broken_audit), *gate]) == 1
 
 
 def test_higher_better_drop_ratio_vs_new_value():

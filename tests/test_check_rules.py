@@ -824,9 +824,10 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 18
+    assert len(names) >= 19
     assert names == {
         "async-dangling-task",
+        "blocking-cross-shard",
         "unbounded-ingest",
         "unguarded-handshake",
         "per-entity-python-ingest",
@@ -1400,6 +1401,87 @@ def test_full_rebuild_pragma_suppresses():
     """
     assert violations(src, relpath=TICK_MODULE,
                       select="full-rebuild-on-tick") == []
+
+
+# endregion
+
+
+# region: blocking-cross-shard (ISSUE 14)
+
+
+def test_blocking_cross_shard_fires_on_awaited_recv_in_flush():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            reply = await self.ctl.recv()
+    """
+    assert violations(src, relpath="worldql_server_tpu/engine/ticker.py",
+                      select="blocking-cross-shard") == [
+        ("blocking-cross-shard", 4)
+    ]
+
+
+def test_blocking_cross_shard_fires_on_control_round_trip_in_drain():
+    src = """
+    class ClusterShardExtension:
+        async def drain(self):
+            state = await self.request_state(peer)
+            await self.control_send(x)
+    """
+    assert violations(src, relpath="worldql_server_tpu/cluster/shard.py",
+                      select="blocking-cross-shard") == [
+        ("blocking-cross-shard", 4), ("blocking-cross-shard", 5),
+    ]
+
+
+def test_blocking_cross_shard_fires_on_any_await_in_bus():
+    src = """
+    import asyncio
+
+    class InterShardBus:
+        async def send_frame(self, shard, data):
+            await asyncio.sleep(0)
+    """
+    fired = violations(src, relpath="worldql_server_tpu/cluster/bus.py",
+                       select="blocking-cross-shard")
+    assert ("blocking-cross-shard", 5) in fired   # async def
+    assert ("blocking-cross-shard", 6) in fired   # the await itself
+
+
+def test_blocking_cross_shard_quiet_on_enqueue_and_drain_idiom():
+    src = """
+    class ClusterShardExtension:
+        async def drain(self):
+            records = self.bus.drain(4096)
+            await self.server.peer_map.deliver_batch(records)
+
+        async def _control_loop(self):
+            # control traffic lives OFF the tick path — not flagged
+            data = await loop.sock_recv(self._ctl, 65536)
+    """
+    assert violations(src, relpath="worldql_server_tpu/cluster/shard.py",
+                      select="blocking-cross-shard") == []
+
+
+def test_blocking_cross_shard_honors_pragma_and_scope():
+    src = """
+    class TickBatcher:
+        async def flush(self):
+            reply = await self.ctl.recv()  # wql: allow(blocking-cross-shard)
+    """
+    assert violations(src, relpath="worldql_server_tpu/engine/ticker.py",
+                      select="blocking-cross-shard") == []
+    # outside the scoped modules the same code is not this rule's
+    # business (other rules may still care)
+    src2 = """
+    class Anything:
+        async def flush(self):
+            reply = await self.ctl.recv()
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/transports/zeromq.py",
+        select="blocking-cross-shard",
+    ) == []
 
 
 # endregion

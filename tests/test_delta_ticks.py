@@ -218,14 +218,84 @@ def test_delta_off_is_pinned_to_the_pre_delta_pipeline():
     assert be.delta_sync_scatters == 0
 
 
-def test_sharded_backend_conservatively_declines_delta():
-    from worldql_server_tpu.parallel.sharded_backend import (
-        ShardedTpuSpatialBackend,
+def test_sharded_backend_supports_delta_via_flat_region_replay():
+    """ISSUE 14 satellite (the PR 13 leftover): result reuse runs on
+    the mesh — clean queries replay from the shard-local (host) cache,
+    dirty partitions dispatch through the mesh kernels' per-shard flat
+    regions — pinned lane-for-lane against a full-recompute mesh twin
+    under randomized churn. The delta-SYNC tombstone scatter stays
+    conservatively off (the mesh replicates the delta segment)."""
+    from worldql_server_tpu.parallel import (
+        ShardedTpuSpatialBackend, make_fanout_mesh,
     )
 
-    assert ShardedTpuSpatialBackend.supports_delta_ticks(
-        object.__new__(ShardedTpuSpatialBackend)
-    ) is False
+    rng = np.random.default_rng(77)
+    n, m = 128, 32
+    mesh = make_fanout_mesh(2, 4)
+    bes = [
+        ShardedTpuSpatialBackend(16, mesh, compact_threshold=64),
+        ShardedTpuSpatialBackend(16, mesh, compact_threshold=64),
+    ]
+    assert bes[0].configure_delta_ticks("auto"), \
+        "mesh must accept delta ticks"
+    assert not bes[0]._delta_scatter_supported()
+    peers = [uuid.UUID(int=i + 1) for i in range(n)]
+    pos = rng.uniform(-250, 250, (n, 3))
+    cubes = cube_coords_batch(pos, 16)
+    live = np.ones(n, bool)
+    for be in bes:
+        be.bulk_add_subscriptions("w", peers, cubes)
+        be.flush()
+    q_pos = pos[rng.integers(0, n, m)].copy()
+    sid = np.full(m, -1, np.int32)
+
+    for tick in range(80):
+        op = rng.random()
+        if op < 0.2:  # moves
+            mv = np.unique(rng.integers(0, n, int(rng.integers(1, 4))))
+            mv = mv[live[mv]]
+            if mv.size:
+                new_cubes = cube_coords_batch(
+                    rng.uniform(-250, 250, (mv.size, 3)), 16
+                )
+                for be in bes:
+                    be.bulk_move_subscriptions(
+                        "w", [peers[i] for i in mv], cubes[mv],
+                        [peers[i] for i in mv], new_cubes,
+                    )
+                cubes[mv] = new_cubes
+        elif op < 0.32:  # leaves
+            i = int(rng.integers(0, n))
+            if live[i]:
+                for be in bes:
+                    be.remove_subscription(
+                        "w", peers[i], tuple(int(c) for c in cubes[i])
+                    )
+                live[i] = False
+        elif op < 0.44:  # joins
+            dead = np.flatnonzero(~live)
+            if dead.size:
+                i = int(dead[0])
+                new_cube = cube_coords_batch(
+                    rng.uniform(-250, 250, (1, 3)), 16
+                )
+                for be in bes:
+                    be.bulk_add_subscriptions("w", [peers[i]], new_cube)
+                cubes[i] = new_cube[0]
+                live[i] = True
+        elif op < 0.6:  # query churn
+            rows = rng.integers(0, m, 2)
+            q_pos[rows] = rng.uniform(-250, 250, (2, 3))
+        mm = (m, 16)[1 if tick % 11 == 0 else 0]  # forced tier change
+        cols = _staged(q_pos, sid, mm)
+        outs = [
+            be.collect_local_batch(be.dispatch_staged_batch(*cols))
+            for be in bes
+        ]
+        assert outs[0] == outs[1], f"sharded tick {tick} diverged"
+    assert bes[0].delta_reused > 0, "mesh reuse never fired"
+    assert bes[0].delta_recomputed > 0
+    assert bes[1].delta_reused == 0 and bes[1].delta_recomputed == 0
 
 
 # endregion

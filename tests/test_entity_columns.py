@@ -701,3 +701,102 @@ def test_failover_mid_tick_also_aborts(clean_failpoints):
 
 
 # endregion
+
+
+# region: frame-level reuse (ISSUE 14 satellite — the PR 13 leftover)
+
+
+def _tick_pairs(plane):
+    handle = plane.dispatch_tick()
+    assert handle is not None
+    return plane.apply(plane.collect_tick(handle))
+
+
+def _frame_bytes(pairs):
+    return [(f.wire, tuple(t)) for f, t in pairs]
+
+
+def test_clean_cohorts_replay_frame_bytes(wire):
+    """An idle world's cohorts replay last tick's encoded wire bytes:
+    counted in frames_reused, byte-for-byte identical to a fresh
+    encode of the same state."""
+    plane = make_plane()
+    owner_a, owner_b = uuid.uuid4(), uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(4)]
+    plane.ingest(ent_msg(owner_a, [
+        Entity(uuid=ents[i], position=Vector3(1.0 + i, 1, 1),
+               world_name="w") for i in range(2)
+    ]))
+    plane.ingest(ent_msg(owner_b, [
+        Entity(uuid=ents[2 + i], position=Vector3(3.0 + i, 1, 1),
+               world_name="w") for i in range(2)
+    ]))
+    pairs1 = _tick_pairs(plane)
+    assert pairs1 and plane.frames_native > 0
+    assert plane.frames_reused == 0            # first tick must encode
+
+    pairs2 = _tick_pairs(plane)                # nothing moved
+    assert plane.frames_reused == len(pairs2) > 0
+    assert _frame_bytes(pairs2) == _frame_bytes(pairs1)
+
+    # parity pin: a cold cache re-encodes the SAME bytes the replay
+    # handed out — reuse is a pure skip, never a drift
+    plane._frame_cache = {}
+    reused_before = plane.frames_reused
+    pairs3 = _tick_pairs(plane)
+    assert plane.frames_reused == reused_before  # cold cache: no reuse
+    assert _frame_bytes(pairs3) == _frame_bytes(pairs2)
+
+
+def test_frame_reuse_invalidates_on_movement_and_roster_change(wire):
+    plane = make_plane()
+    owner_a, owner_b = uuid.uuid4(), uuid.uuid4()
+    ents = [uuid.uuid4() for _ in range(4)]
+    # two MIXED-owner cohorts in far-apart cubes (same-owner-only
+    # cubes produce no frames: recipients are except-self per peer)
+    plane.ingest(ent_msg(owner_a, [
+        Entity(uuid=ents[0], position=Vector3(1.0, 1, 1),
+               world_name="w"),
+        Entity(uuid=ents[1], position=Vector3(500.0, 1, 1),
+               world_name="w"),
+    ]))
+    plane.ingest(ent_msg(owner_b, [
+        Entity(uuid=ents[2], position=Vector3(1.5, 1, 1),
+               world_name="w"),
+        Entity(uuid=ents[3], position=Vector3(500.5, 1, 1),
+               world_name="w"),
+    ]))
+    _tick_pairs(plane)
+    _tick_pairs(plane)
+    assert plane.frames_reused > 0
+
+    # a moved entity re-encodes its cohort; frames must carry the NEW
+    # position, not the cached one
+    plane.ingest(ent_msg(owner_a, [
+        Entity(uuid=ents[0], position=Vector3(2.5, 1, 1),
+               world_name="w")
+    ]))
+    pairs = _tick_pairs(plane)
+    moved = [
+        f for f, _ in pairs
+        if any(e.uuid == ents[0] for e in f.entities)
+    ]
+    assert moved, "moved entity still produces a frame"
+    assert any(
+        e.position.x == pytest.approx(2.5)
+        for f in moved for e in f.entities if e.uuid == ents[0]
+    ), "reused stale frame served an old position"
+
+    # roster change clears the cache wholesale: a registration into a
+    # reused slot must never alias cached bytes
+    plane.ingest(ent_msg(owner_b, [Entity(
+        uuid=uuid.uuid4(), position=Vector3(600.0, 1, 1),
+        world_name="w",
+    )]))
+    assert plane._frame_cache == {}
+    reused_before = plane.frames_reused
+    _tick_pairs(plane)
+    assert plane.frames_reused == reused_before
+
+
+# endregion

@@ -21,13 +21,16 @@ def assert_green(report):
 def test_catalog_names():
     assert set(CATALOG) == {
         "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
-        "reconnect_storm_replay",
+        "reconnect_storm_replay", "cluster_flash_crowd",
     }
-    # the replay-storm variant is catalogued but NOT CI-smoke-blocking
+    # the replay-storm variant is catalogued but NOT CI-smoke-blocking;
+    # the cluster variant spawns shard subprocesses and runs in its
+    # own "Cluster smoke" CI step instead of the default set
     assert CATALOG["reconnect_storm_replay"].ci_smoke is False
+    assert CATALOG["cluster_flash_crowd"].ci_smoke is False
     assert all(
         CATALOG[n].ci_smoke for n in CATALOG
-        if n != "reconnect_storm_replay"
+        if n not in ("reconnect_storm_replay", "cluster_flash_crowd")
     )
 
 

@@ -1,0 +1,109 @@
+"""Cluster safety rule (ISSUE 14): cross-shard work is enqueue-and-drain.
+
+The horizontal-serving design hides the inter-shard collective behind
+the local device window: a shard's tick WRITES outbound frames onto
+the peer rings (fire-and-forget ``try_write``) and DRAINS its inbound
+rings between dispatch and collect — it never waits for another shard
+to answer. One awaited inter-shard round trip inside a tick-path
+function re-serializes the cluster: every shard's tick then runs at
+the speed of its slowest peer plus a control-channel RTT, which is
+exactly the TileLoom anti-pattern (collective in FRONT of compute
+instead of behind it) this PR exists to avoid.
+
+Two scopes:
+
+* ``cluster/bus.py`` — the bus is the tick's data plane and must stay
+  fully synchronous: ANY ``await``/``async def`` there is a violation
+  (ring reads/writes are lock-free shared-memory operations; an async
+  bus invites hidden waits).
+* tick-path functions of ``engine/ticker.py`` and
+  ``cluster/shard.py`` (flush/collect/drain/enqueue/deliver family):
+  ``await`` of a call whose name smells like a remote round trip —
+  ``recv``/``request``/``rpc``/``sock_recv``/``ctl``/``control``/
+  ``round_trip`` in the dotted chain — fails lint. Control traffic
+  belongs in the supervised control loop, off the tick path.
+
+Suppress a deliberate case with ``# wql: allow(blocking-cross-shard)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation, dotted_name, enclosing_functions
+
+_BUS_SCOPED = ("cluster/bus.py",)
+_TICK_SCOPED = ("engine/ticker.py", "cluster/shard.py")
+
+#: function names forming the tick path in the scoped modules
+_TICK_PATH = frozenset((
+    "flush", "flush_pipelined", "_collect_deliver",
+    "_collect_deliver_inner", "drain", "enqueue", "_dispatch_batch",
+    "deliver_batch", "_deliver_batch_planed", "_deliver_batch_local",
+    "send_frame", "try_write", "try_write_many",
+))
+
+#: dotted-chain tokens that mark an awaited call as a remote round trip
+_ROUND_TRIP_TOKENS = (
+    "recv", "request", "rpc", "sock_recv", "ctl", "control",
+    "round_trip",
+)
+
+
+def _smells_remote(name: str | None) -> bool:
+    if name is None:
+        return False
+    parts = name.lower().split(".")
+    return any(
+        tok in part for part in parts for tok in _ROUND_TRIP_TOKENS
+    )
+
+
+def _check_blocking_cross_shard(ctx: FileContext) -> Iterator[Violation]:
+    bus_scope = ctx.relpath.endswith(_BUS_SCOPED)
+    tick_scope = ctx.relpath.endswith(_TICK_SCOPED)
+    if bus_scope:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Await, ast.AsyncFunctionDef,
+                                 ast.AsyncFor, ast.AsyncWith)):
+                yield from ctx.flag(
+                    BLOCKING_CROSS_SHARD, node,
+                    "await/async in the inter-shard bus — the tick's "
+                    "data plane is synchronous shared-memory ring "
+                    "work; waits belong to the control loop, never "
+                    "the bus",
+                )
+        return
+    if not tick_scope:
+        return
+    for func, _stack in enclosing_functions(ctx.tree):
+        if func.name not in _TICK_PATH:
+            continue
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Await):
+                continue
+            call = node.value
+            name = (
+                dotted_name(call.func)
+                if isinstance(call, ast.Call) else dotted_name(call)
+            )
+            if _smells_remote(name):
+                yield from ctx.flag(
+                    BLOCKING_CROSS_SHARD, node,
+                    f"`await {name}(...)` inside tick-path "
+                    f"`{func.name}` — an inter-shard round trip here "
+                    "serializes every shard's tick behind its slowest "
+                    "peer; cross-shard work must be enqueue-and-drain "
+                    "(ring try_write + the cluster.drain leg)",
+                )
+
+
+BLOCKING_CROSS_SHARD = Rule(
+    "blocking-cross-shard",
+    "tick-path code must never await an inter-shard round trip; the "
+    "bus stays synchronous — cross-shard work is enqueue-and-drain",
+    _check_blocking_cross_shard,
+)
+
+RULES = [BLOCKING_CROSS_SHARD]

@@ -249,6 +249,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resumes/s the overload governor still admits "
                         "in REJECT (new connects shed at SHED_HIGH+; "
                         "default 200)")
+    p.add_argument("--cluster-shards", type=int, dest="cluster_shards",
+                   help="horizontal serving: boot the router tier plus "
+                        "this many supervised shard server processes "
+                        "(world-sharded engines with per-shard WALs; "
+                        "cross-shard delivery over inter-shard "
+                        "shared-memory rings); 0 (default) = the "
+                        "single-process server, byte for byte")
+    p.add_argument("--cluster-role", choices=["router", "shard"],
+                   dest="cluster_role",
+                   help="cluster process role: 'router' (implied by "
+                        "--cluster-shards N) or 'shard' (spawned by the "
+                        "router-tier supervisor; requires the "
+                        "WQL_CLUSTER_SPEC topology env)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -277,6 +290,7 @@ _OVERRIDES = [
     "overload_evict_after", "overload_rss_limit_mb",
     "session_ttl", "session_resume_rate",
     "delta_ticks", "delta_rebuild_threshold",
+    "cluster_shards", "cluster_role",
 ]
 
 
@@ -395,6 +409,19 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError as exc:
             print(f"config error: {exc}", file=sys.stderr)
             return 1
+
+    if config.cluster_shards > 0:
+        # Router tier: the public listener + the supervised shard
+        # processes. Never constructs a WorldQLServer of its own —
+        # every world lives in exactly one shard.
+        from .cluster import ClusterRuntime
+
+        runtime = ClusterRuntime(config)
+        try:
+            asyncio.run(runtime.run_forever())
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     server = WorldQLServer(config)
     try:

@@ -1,0 +1,512 @@
+"""Router tier: the cluster's client-facing front door.
+
+One thin process owns the PUBLIC ZMQ listener and forwards every
+inbound message to the shard that owns it — world-scoped instructions
+(subscriptions, Local/GlobalMessages, record ops) to
+``WorldMap.shard_of_world``, peer-scoped instructions (handshakes,
+heartbeats) to ``WorldMap.shard_of_peer`` — as the ORIGINAL wire
+bytes (``Message.wire``): the router decodes for routing, never
+re-encodes. Return traffic never touches the router at all: each
+shard's connect-back PUSH goes straight to the client (the reference's
+asymmetric ZMQ pattern scales to N servers for free), and cross-shard
+fan-out rides the inter-shard rings.
+
+The router is also where overload becomes a CLUSTER property. Every
+shard exports its governor level over the control channel (shard.py
+``state`` packets) into the :class:`ShedMirror`; a message bound for a
+shard in REJECT is shed AT THE ROUTER — same admission classes as the
+shard's own governor (records/entity/control never shed; locals and
+globals shed in REJECT; new handshakes shed at SHED_HIGH+ with a
+budgeted jittered retry-after hint, resumes ride through below
+REJECT) — so a drowning shard's refusals cost one decode here instead
+of a socket write, a queue slot and a decode there. Every router-side
+shed is counted per class (``cluster.router_shed_*``): offered ==
+forwarded + shed-at-router, and forwarded == admitted + shed-at-shard,
+the exact-accounting invariant bench config 11 gates.
+
+``ClusterRuntime`` composes the router with the shard-process
+supervisor — ``python -m worldql_server_tpu --cluster-shards N`` boots
+it; scenarios, bench config 11 and the e2e suite embed it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+import uuid as uuid_mod
+
+import zmq
+import zmq.asyncio
+
+from ..engine.metrics import Metrics
+from ..protocol import (
+    DeserializeError,
+    Instruction,
+    Message,
+    deserialize_message,
+    serialize_message,
+)
+from ..utils.names import GLOBAL_WORLD  # noqa: F401  (routing contract doc)
+from .supervisor import ClusterSupervisor, shard_zmq_port
+from .world_map import WorldMap
+
+logger = logging.getLogger(__name__)
+
+#: governor levels mirrored from shard state packets
+_SHED_HIGH = 2
+_REJECT = 3
+
+#: instructions routed by WORLD (owner shard) vs by SENDER (home shard)
+_WORLD_ROUTED = frozenset((
+    Instruction.AREA_SUBSCRIBE, Instruction.AREA_UNSUBSCRIBE,
+    Instruction.LOCAL_MESSAGE, Instruction.GLOBAL_MESSAGE,
+    Instruction.RECORD_CREATE, Instruction.RECORD_READ,
+    Instruction.RECORD_UPDATE, Instruction.RECORD_DELETE,
+))
+
+
+def _connect_host(bind_host: str) -> str:
+    return "127.0.0.1" if bind_host in ("0.0.0.0", "::", "*", "") else bind_host
+
+
+class ShedMirror:
+    """Router-side view of every shard's governor level, fed by the
+    control channel. Stale state degrades to level 0 on a shard
+    restart (the fresh shard re-reports within its first state tick)."""
+
+    def __init__(self, n_shards: int):
+        self.levels = [0] * n_shards
+
+    def note_state(self, shard: int, msg: dict) -> None:
+        self.levels[shard] = int(msg.get("level", 0))
+
+    def reset(self, shard: int) -> None:
+        self.levels[shard] = 0
+
+    def level(self, shard: int) -> int:
+        return self.levels[shard]
+
+
+class ClusterRouter:
+    """The forwarding loop + shed mirror + admin surface. Owns no
+    world state — restartable at any time without data loss."""
+
+    def __init__(self, config, supervisor: ClusterSupervisor,
+                 metrics: Metrics | None = None):
+        self.config = config
+        self.supervisor = supervisor
+        self.n_shards = supervisor.n_shards
+        self.world_map = WorldMap(self.n_shards)
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.mirror = ShedMirror(self.n_shards)
+        self.ctx = zmq.asyncio.Context()
+        self._pull: zmq.asyncio.Socket | None = None
+        self._push: list[zmq.asyncio.Socket] = []
+        self._recv_task: asyncio.Task | None = None
+        self._http_runner = None
+        #: uuid → home shard for every handshaked peer (adoption replay
+        #: state for shard restarts; reaped on peer_gone notices)
+        self._peers: dict[uuid_mod.UUID, int] = {}
+        self._hint_bucket = [50.0, time.monotonic()]
+        self._jitter = random.Random()
+        self.forwarded = 0
+        self._refusals: set[asyncio.Task] = set()
+        self.metrics.gauge("cluster", self.status)
+
+    # region: lifecycle
+
+    async def start(self) -> None:
+        config = self.config
+        self._pull = self.ctx.socket(zmq.PULL)
+        self._pull.setsockopt(zmq.MAXMSGSIZE, config.max_message_size)
+        self._pull.bind(
+            f"tcp://{config.zmq_server_host}:{config.zmq_server_port}"
+        )
+        host = _connect_host(config.zmq_server_host)
+        for i in range(self.n_shards):
+            push = self.ctx.socket(zmq.PUSH)
+            push.setsockopt(zmq.LINGER, 0)
+            # deep enough to ride out a shard restart window at storm
+            # rates; past it the router degrades to counted drops
+            # rather than a wedged recv loop
+            push.setsockopt(zmq.SNDHWM, 100_000)
+            push.connect(f"tcp://{host}:{shard_zmq_port(config, i)}")
+            self._push.append(push)
+        self._recv_task = asyncio.create_task(  # wql: allow(unsupervised-task) — the runtime's run loop awaits/aborts on this task
+            self._recv_loop(), name="cluster-router-recv"
+        )
+        if config.http_enabled:
+            await self._start_http()
+        logger.info(
+            "cluster router listening on %s:%s, %d shards behind it",
+            config.zmq_server_host, config.zmq_server_port, self.n_shards,
+        )
+
+    async def stop(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._recv_task = None
+        for task in list(self._refusals):
+            task.cancel()
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+            self._http_runner = None
+        for push in self._push:
+            push.close(linger=0)
+        self._push.clear()
+        if self._pull is not None:
+            self._pull.close(linger=0)
+            self._pull = None
+        self.ctx.term()
+
+    # endregion
+
+    # region: control-plane hooks (wired by ClusterRuntime)
+
+    def on_shard_message(self, shard: int, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "state":
+            self.mirror.note_state(shard, msg)
+        elif op == "peer_gone":
+            try:
+                peer = uuid_mod.UUID(hex=msg["uuid"])
+            except (KeyError, ValueError):
+                return
+            if self._peers.pop(peer, None) is not None:
+                for i in range(self.n_shards):
+                    if i != shard:
+                        self.supervisor.ctl_send(
+                            i, {"op": "drop", "uuid": peer.hex}
+                        )
+
+    def on_shard_ready(self, shard: int) -> None:
+        """(Re)boot adoption replay: the fresh shard learns every
+        living peer homed elsewhere, so its fan-out reaches the whole
+        cluster from its first tick."""
+        self.mirror.reset(shard)
+        for peer, home in self._peers.items():
+            if home != shard:
+                self.supervisor.ctl_send(
+                    shard, {"op": "adopt", "uuid": peer.hex, "home": home}
+                )
+
+    def on_shard_down(self, shard: int) -> None:
+        """A dead shard's homed peers lost their sockets with it:
+        drop their proxies cluster-wide and forget them — the clients
+        reconnect through the router and re-adopt."""
+        self.mirror.reset(shard)
+        gone = [u for u, h in self._peers.items() if h == shard]
+        for peer in gone:
+            del self._peers[peer]
+            for i in range(self.n_shards):
+                if i != shard:
+                    self.supervisor.ctl_send(
+                        i, {"op": "drop", "uuid": peer.hex}
+                    )
+        if gone:
+            logger.warning(
+                "shard %d down: forgot %d homed peers (clients must "
+                "re-handshake)", shard, len(gone),
+            )
+
+    # endregion
+
+    # region: forwarding
+
+    async def _recv_loop(self) -> None:
+        assert self._pull is not None
+        limit = self.config.max_message_size
+        while True:
+            parts = await self._pull.recv_multipart()
+            try:
+                if sum(len(p) for p in parts) > limit:
+                    self.metrics.inc("cluster.router_oversized")
+                    continue
+                data = parts[0] if len(parts) == 1 else b"".join(parts)
+                self._route(data)
+            except Exception:
+                self.metrics.inc("cluster.router_recv_errors")
+                logger.exception(
+                    "error routing inbound message — dropped"
+                )
+
+    def _route(self, data: bytes) -> None:
+        try:
+            message = deserialize_message(data)
+        except DeserializeError:
+            self.metrics.inc("cluster.router_decode_errors")
+            return
+        instruction = message.instruction
+        if instruction in _WORLD_ROUTED:
+            shard = self.world_map.shard_of_world(message.world_name)
+        elif instruction in (Instruction.HANDSHAKE, Instruction.HEARTBEAT):
+            shard = self.world_map.shard_of_peer(message.sender_uuid)
+        else:
+            # client-bound / unknown instructions die here — the shard
+            # would only log-and-drop them anyway
+            self.metrics.inc("cluster.router_dropped_unroutable")
+            return
+        if not self._admit(message, instruction, shard):
+            return
+        if instruction == Instruction.HANDSHAKE:
+            self._note_handshake(message.sender_uuid, shard)
+        self._forward(shard, message.wire if message.wire is not None
+                      else data)
+
+    def _admit(self, message: Message, instruction, shard: int) -> bool:
+        """The shed mirror: REJECT a drowning shard's sheddable load at
+        the router, before the shard pays a socket read for it. Same
+        class semantics as OverloadGovernor.admit — records, entity
+        updates, subscriptions and heartbeats always pass."""
+        level = self.mirror.level(shard)
+        if level < _SHED_HIGH:
+            return True
+        if instruction == Instruction.HANDSHAKE:
+            resume = message.flex is not None
+            if resume and level < _REJECT:
+                return True
+            if resume:
+                return True  # REJECT resumes: the shard's token bucket decides
+            self.metrics.inc("cluster.router_shed_handshake_new")
+            self._send_refusal(message)
+            return False
+        if level < _REJECT:
+            return True
+        if instruction == Instruction.LOCAL_MESSAGE:
+            if message.entities:
+                return True  # entity updates coalesce at the shard, never shed
+            self.metrics.inc("cluster.router_shed_local")
+            return False
+        if instruction == Instruction.GLOBAL_MESSAGE:
+            if message.entities:
+                return True
+            self.metrics.inc("cluster.router_shed_global")
+            return False
+        return True
+
+    def _forward(self, shard: int, data: bytes) -> None:
+        """Non-blocking forward. A full push queue (shard mid-restart
+        past the 100K backlog) drops + counts — the router's recv loop
+        must never wedge on one dead shard while the others serve."""
+        try:
+            self._push[shard].send(data, flags=zmq.NOBLOCK)
+            self.forwarded += 1
+            self.metrics.inc("cluster.router_forwarded")
+        except zmq.Again:
+            self.metrics.inc("cluster.router_queue_drops")
+
+    def _note_handshake(self, peer: uuid_mod.UUID, home: int) -> None:
+        known = self._peers.get(peer)
+        self._peers[peer] = home
+        if known == home:
+            return
+        for i in range(self.n_shards):
+            if i != home:
+                self.supervisor.ctl_send(
+                    i, {"op": "adopt", "uuid": peer.hex, "home": home}
+                )
+
+    def _send_refusal(self, message: Message) -> None:
+        """Budgeted jittered retry-after hint for a router-shed NEW
+        handshake, pushed to the connect-back address the client just
+        supplied — the ZmqTransport refusal contract, moved to the
+        tier that shed it."""
+        self.metrics.inc("cluster.router_handshakes_refused")
+        now = time.monotonic()
+        bucket = self._hint_bucket
+        bucket[0] = min(bucket[0] + (now - bucket[1]) * 50.0, 50.0)
+        bucket[1] = now
+        if bucket[0] < 1.0 or not message.parameter:
+            return
+        bucket[0] -= 1.0
+        retry_ms = max(1, int(500 * (0.5 + self._jitter.random())))
+        task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task) — one-shot, retained below
+            self._push_refusal(message.parameter, retry_ms)
+        )
+        self._refusals.add(task)
+        task.add_done_callback(self._refusals.discard)
+
+    async def _push_refusal(self, parameter: str, retry_ms: int) -> None:
+        push = self.ctx.socket(zmq.PUSH)
+        push.setsockopt(zmq.LINGER, 200)
+        try:
+            push.connect(f"tcp://{parameter}")
+            await push.send(serialize_message(Message(
+                instruction=Instruction.HANDSHAKE,
+                parameter=f"retry-after:{retry_ms}",
+            )))
+            self.metrics.inc("cluster.router_refusal_hints")
+        except Exception:
+            logger.debug("router refusal hint to %s failed", parameter)
+        finally:
+            push.close(linger=200)
+
+    # endregion
+
+    # region: admin surface
+
+    def status(self) -> dict:
+        """The ``cluster`` gauge + the /healthz aggregation body."""
+        now = time.monotonic()
+        shard_states = {}
+        for i in range(self.n_shards):
+            state = self.supervisor.shard_state(i)
+            shard_states[str(i)] = {
+                "alive": self.supervisor.shard_alive(i),
+                "level": self.mirror.level(i),
+                "state": state.get("state", "unknown"),
+                "peers": state.get("peers", 0),
+                "state_age_s": (
+                    round(now - self.supervisor._shards[i].state_at, 2)
+                    if self.supervisor._shards[i].state_at else None
+                ),
+            }
+        return {
+            "shards": self.n_shards,
+            "alive": self.supervisor.alive_count(),
+            "restarts": self.supervisor.stats()["restarts"],
+            "known_peers": len(self._peers),
+            "forwarded": self.forwarded,
+            "shard_states": shard_states,
+        }
+
+    async def _start_http(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/healthz", self._get_healthz)
+        app.router.add_get("/metrics", self._get_metrics)
+        app.router.add_post("/global_message", self._post_global_message)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(
+            self._http_runner, self.config.http_host, self.config.http_port
+        )
+        await site.start()
+
+    async def _get_healthz(self, request):
+        from aiohttp import web
+
+        body = {"status": "ok", "role": "router", "cluster": self.status()}
+        if self.supervisor.alive_count() < self.n_shards or any(
+            self.mirror.level(i) >= _SHED_HIGH
+            for i in range(self.n_shards)
+        ):
+            body["status"] = "degraded"
+        return web.json_response(body)
+
+    async def _get_metrics(self, request):
+        from aiohttp import web
+
+        if "application/json" in request.headers.get("Accept", ""):
+            return web.json_response(self.metrics.snapshot())
+        return web.Response(
+            text=self.metrics.render_prometheus(),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    async def _post_global_message(self, request):
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+            world_name = body["world_name"]
+            parameter = body.get("parameter")
+            if not isinstance(world_name, str) or not (
+                parameter is None or isinstance(parameter, str)
+            ):
+                raise ValueError("wrong field types")
+        except Exception:
+            return web.Response(status=400)
+        message = Message(
+            instruction=Instruction.GLOBAL_MESSAGE,
+            parameter=parameter,
+            world_name=world_name,
+        )
+        # rides the PRIVATE control channel, not the shard's public
+        # PULL: the transport there drops nil-sender wire messages
+        # (anti-spoofing — only the in-process HTTP surface may inject),
+        # and the control channel is exactly that trusted in-process
+        # surface stretched across the process boundary
+        import base64
+
+        self.supervisor.ctl_send(
+            self.world_map.shard_of_world(world_name),
+            {
+                "op": "inject",
+                "data": base64.b64encode(
+                    serialize_message(message)
+                ).decode(),
+            },
+        )
+        return web.Response(status=204)
+
+    # endregion
+
+
+class ClusterRuntime:
+    """Supervisor + router composition: the thing ``--cluster-shards
+    N`` boots. Also embedded by the scenario engine, bench config 11
+    and the e2e suite (the router runs in the embedding process; the
+    shards are always real subprocesses)."""
+
+    def __init__(self, config, metrics: Metrics | None = None):
+        config.validate()
+        self.config = config
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.supervisor = ClusterSupervisor(
+            config, config.cluster_shards, metrics=self.metrics,
+        )
+        self.router = ClusterRouter(
+            config, self.supervisor, metrics=self.metrics
+        )
+        self.supervisor.on_shard_ready = self.router.on_shard_ready
+        self.supervisor.on_shard_down = self.router.on_shard_down
+        self.supervisor.on_shard_message = self.router.on_shard_message
+        self.shutdown_requested = asyncio.Event()
+        # scenario-engine compatibility surface
+        self.governor = None
+        self.ticker = None
+
+    async def start(self) -> None:
+        await self.supervisor.start()
+        await self.router.start()
+
+    async def stop(self) -> None:
+        await self.router.stop()
+        await self.supervisor.stop()
+
+    async def run_forever(self) -> None:
+        import signal as signal_mod
+
+        await self.start()
+        stop_requested = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        hooked = []
+        for sig in (signal_mod.SIGINT, signal_mod.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_requested.set)
+                hooked.append(sig)
+            except (NotImplementedError, RuntimeError):
+                pass
+        waiters = [
+            asyncio.ensure_future(stop_requested.wait()),  # wql: allow(unsupervised-task)
+            asyncio.ensure_future(self.shutdown_requested.wait()),  # wql: allow(unsupervised-task)
+        ]
+        try:
+            await asyncio.wait(
+                waiters, return_when=asyncio.FIRST_COMPLETED
+            )
+            logger.info("cluster router shutting down")
+        finally:
+            for waiter in waiters:
+                waiter.cancel()
+            for sig in hooked:
+                loop.remove_signal_handler(sig)
+            await self.stop()

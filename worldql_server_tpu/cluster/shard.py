@@ -1,0 +1,347 @@
+"""Shard-side cluster extension: remote peers, ring drain, control.
+
+A cluster shard IS the existing single-process server — same router,
+ticker, WAL, governor, entity plane — plus this extension, attached
+when ``--cluster-role shard`` boots with a ``WQL_CLUSTER_SPEC``
+topology. It adds exactly three things:
+
+* **Remote peer proxies.** Every peer is HOMED on one shard (stable
+  uuid hash — world_map.py); the home shard owns the real connect-back
+  socket. When the router announces a peer homed elsewhere (control
+  ``adopt``), this shard registers a :class:`~..engine.peers.Peer`
+  whose write paths enqueue the frame onto the inter-shard ring toward
+  the home shard — so the UNCHANGED fan-out code (``PeerMap.
+  deliver_batch``, broadcasts, record replies) transparently reaches
+  peers connected anywhere in the cluster. Proxies register/deregister
+  through the SILENT map paths (``rebind``/``detach``): peer lifecycle
+  broadcasts (PeerConnect/Disconnect) are emitted once, by the home
+  shard, and reach every client exactly once — local peers directly,
+  remote ones through the proxies of THAT broadcast.
+* **The cross-shard drain.** Frames arriving on the inbound rings are
+  delivered to local sockets inside the tick, between the local
+  batch's device dispatch and its collect (``cluster.drain`` span) —
+  the TileLoom overlap discipline: the inter-shard leg hides behind
+  the in-flight device window instead of serializing in front of it.
+  Tickerless (immediate-mode) shards run a supervised drain pump
+  instead. The cross-shard leg is enqueue-and-drain ONLY (lint:
+  ``blocking-cross-shard``) — nothing on the tick path ever awaits a
+  remote shard.
+* **The control channel.** AF_UNIX SEQPACKET to the router-tier
+  supervisor: inbound ``adopt``/``drop`` maintain the proxy plane;
+  outbound ``state`` exports the shard's overload-governor level (the
+  router's shed mirror REJECTs at the router before this shard ever
+  sees the bytes) and ``peer_gone`` reports a homed peer's teardown so
+  the router reaps its proxies cluster-wide. Control-channel EOF means
+  the router died: the shard requests its own clean shutdown rather
+  than serving unreachable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import time
+import uuid as uuid_mod
+
+from ..engine.peers import Peer
+from .bus import InterShardBus
+from .world_map import WorldMap
+
+logger = logging.getLogger(__name__)
+
+from .supervisor import CLUSTER_SPEC_ENV  # noqa: E402  (shared env name)
+
+#: inbound ring records consumed per drain call — bounds one tick's
+#: drain leg; the remainder stays queued for the next tick (or the
+#: immediate re-drain when the pump sees pending bytes)
+DRAIN_MAX = 4096
+
+#: governor state export cadence: immediate on a level change, plus a
+#: heartbeat so the router can age out a wedged shard's state
+STATE_INTERVAL_S = 1.0
+STATE_POLL_S = 0.1
+
+
+class _BusFrame:
+    """Ready wire bytes off the inter-shard ring — deliver_batch
+    consumes ``.wire`` and never re-serializes."""
+
+    __slots__ = ("wire",)
+
+    def __init__(self, wire: bytes):
+        self.wire = wire
+
+
+def load_spec(env: dict | None = None) -> dict:
+    raw = (env or os.environ).get(CLUSTER_SPEC_ENV)
+    if not raw:
+        raise RuntimeError(
+            "--cluster-role shard requires the WQL_CLUSTER_SPEC "
+            "topology (set by the router-tier supervisor)"
+        )
+    return json.loads(raw)
+
+
+class ClusterShardExtension:
+    def __init__(self, server, spec: dict | None = None):
+        self.server = server
+        spec = spec if spec is not None else load_spec()
+        self.shard_id = int(spec["shard_id"])
+        self.n_shards = int(spec["n_shards"])
+        self.world_map = WorldMap(self.n_shards)
+        self.bus = InterShardBus(self.shard_id)
+        rings = spec.get("rings") or {"out": {}, "in": {}}
+        self.bus.attach(rings.get("out", {}), rings.get("in", {}))
+        self._ctl_path = spec["ctl_path"]
+        self._ctl: socket.socket | None = None
+        #: uuid → home shard for every remote proxy this shard holds
+        self._remote: dict[uuid_mod.UUID, int] = {}
+        self._last_level_sent: int | None = None
+        self._last_state_push = 0.0
+        self.xshard_frames = 0
+
+    # region: lifecycle
+
+    async def start(self) -> None:
+        """Connect the control channel and announce readiness — called
+        at the END of server.start(), once the ZMQ listener is bound,
+        so the router never forwards into an unbound socket."""
+        ctl = socket.socket(socket.AF_UNIX, socket.SOCK_SEQPACKET)
+        ctl.settimeout(10.0)
+        ctl.connect(self._ctl_path)
+        ctl.setblocking(False)
+        self._ctl = ctl
+        self._ctl_send({"op": "ready", "shard": self.shard_id})
+        self.server.supervisor.spawn(
+            "cluster-control", self._control_loop, critical=True
+        )
+        if self.server.ticker is None:
+            # immediate-mode shard: no tick clock to ride — a
+            # supervised pump drains the inbound rings instead
+            self.server.supervisor.spawn("cluster-drain", self._drain_pump)
+        logger.info(
+            "cluster shard %d/%d attached (%d peer rings)",
+            self.shard_id, self.n_shards, len(self.bus.peers()),
+        )
+
+    async def stop(self) -> None:
+        if self._ctl is not None:
+            self._ctl.close()
+            self._ctl = None
+        self.bus.close()
+
+    # endregion
+
+    # region: remote peer proxies
+
+    def _make_proxy(self, peer_uuid: uuid_mod.UUID, home: int) -> Peer:
+        bus = self.bus
+        metrics = self.server.metrics
+
+        def try_write(framed, _u=peer_uuid, _h=home) -> bool:
+            # fire-and-forget onto the home shard's ring; a full ring
+            # drops (counted) — bounded degradation, never a stalled
+            # tick. Returning True keeps deliver_batch off the awaited
+            # slow path: there is nothing more awaiting could do.
+            if not bus.send_frame(_h, _u, framed.payload,
+                                  time.monotonic_ns()):
+                metrics.inc("cluster.ring_full_drops")
+            return True
+
+        def try_write_many(framed_list, _u=peer_uuid, _h=home) -> bool:
+            now = time.monotonic_ns()
+            for framed in framed_list:
+                if not bus.send_frame(_h, _u, framed.payload, now):
+                    metrics.inc("cluster.ring_full_drops")
+            return True
+
+        async def send_raw(data: bytes, _u=peer_uuid, _h=home) -> None:
+            if not bus.send_frame(_h, _u, data, time.monotonic_ns()):
+                metrics.inc("cluster.ring_full_drops")
+
+        return Peer(
+            uuid=peer_uuid,
+            addr=f"shard-{home}",
+            send_raw=send_raw,
+            kind="cluster-remote",
+            tracks_heartbeat=False,
+            try_write=try_write,
+            try_write_many=try_write_many,
+        )
+
+    def adopt_remote(self, peer_uuid: uuid_mod.UUID, home: int) -> None:
+        """Router announced a peer homed on another shard: register the
+        ring-backed proxy (silently — the home shard owns the lifecycle
+        broadcasts). Re-adoption after a shard restart just replaces
+        the proxy; a peer homed HERE is never proxied."""
+        if home == self.shard_id:
+            return
+        existing = self.server.peer_map.get(peer_uuid)
+        if existing is not None and existing.kind != "cluster-remote":
+            # a real local binding outranks a proxy announcement
+            return
+        self.server.peer_map.rebind(self._make_proxy(peer_uuid, home))
+        self._remote[peer_uuid] = home
+
+    def drop_remote(self, peer_uuid: uuid_mod.UUID) -> None:
+        if self._remote.pop(peer_uuid, None) is None:
+            return
+        existing = self.server.peer_map.get(peer_uuid)
+        if existing is not None and existing.kind == "cluster-remote":
+            self.server.peer_map.detach(peer_uuid)
+
+    def on_peer_torn_down(self, peer_uuid: uuid_mod.UUID) -> None:
+        """Server hook: a peer HOMED here fully tore down (session
+        expiry, eviction, clean disconnect past the TTL) — tell the
+        router so every other shard reaps its proxy."""
+        if peer_uuid in self._remote or self._ctl is None:
+            return
+        self._ctl_send({"op": "peer_gone", "uuid": peer_uuid.hex})
+
+    # endregion
+
+    # region: drain (the tick's cross-shard leg)
+
+    async def drain(self) -> int:
+        """Deliver everything queued on the inbound rings to LOCAL
+        sockets. Called by the ticker between the local batch's device
+        dispatch and collect (the ``cluster.drain`` span), or by the
+        standalone pump on tickerless shards. Returns frames drained."""
+        records = self.bus.drain(DRAIN_MAX)
+        if not records:
+            return 0
+        now_ns = time.monotonic_ns()
+        metrics = self.server.metrics
+        pairs = []
+        for peer_uuid, data, t_ingress in records:
+            pairs.append((_BusFrame(data), (peer_uuid,)))
+            if t_ingress:
+                metrics.observe_ms(
+                    "cluster.xshard_ms", (now_ns - t_ingress) / 1e6
+                )
+        self.xshard_frames += len(records)
+        metrics.inc("cluster.frames_drained", len(records))
+        await self.server.peer_map.deliver_batch(pairs)
+        return len(records)
+
+    async def _drain_pump(self) -> None:
+        interval = max(self.server.config.tick_interval, 0.005)
+        while True:
+            await asyncio.sleep(interval)
+            await self.drain()
+
+    # endregion
+
+    # region: control channel
+
+    def _ctl_send(self, msg: dict) -> bool:
+        if self._ctl is None:
+            return False
+        try:
+            self._ctl.send(json.dumps(msg).encode())
+            return True
+        except (BlockingIOError, InterruptedError):
+            return False  # control is best-effort; state re-pushes
+        except OSError:
+            return False
+
+    def _state_packet(self) -> dict:
+        gov = self.server.governor
+        counters = self.server.metrics.snapshot()["counters"]
+        packet = {
+            "op": "state",
+            "shard": self.shard_id,
+            "level": 0,
+            "state": "ok",
+            "peers": self.server.peer_map.size(),
+            "bus": self.bus.stats(),
+            "counters": {
+                k: v for k, v in counters.items()
+                if k.startswith(("messages.", "overload.", "tick.",
+                                 "cluster."))
+            },
+        }
+        if gov is not None:
+            packet.update(gov.export_state())
+            packet["op"] = "state"  # export_state must not shadow it
+        return packet
+
+    def _maybe_push_state(self) -> None:
+        gov = self.server.governor
+        level = gov.level if gov is not None else 0
+        now = time.monotonic()
+        if (
+            level == self._last_level_sent
+            and now - self._last_state_push < STATE_INTERVAL_S
+        ):
+            return
+        if self._ctl_send(self._state_packet()):
+            self._last_level_sent = level
+            self._last_state_push = now
+
+    async def _control_loop(self) -> None:
+        """Supervised: inbound adopt/drop + the state export clock.
+        Control EOF == the router (and its supervisor) is gone — a
+        shard nobody can reach must hand control back cleanly."""
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                data = await asyncio.wait_for(
+                    loop.sock_recv(self._ctl, 65536), STATE_POLL_S
+                )
+                if not data:
+                    raise ConnectionResetError("router control EOF")
+                await self._handle_control(data)
+            except asyncio.TimeoutError:
+                pass
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                logger.critical(
+                    "cluster control channel lost — router is gone; "
+                    "requesting clean shard shutdown"
+                )
+                self.server.shutdown_requested.set()
+                return
+            self._maybe_push_state()
+
+    async def _handle_control(self, data: bytes) -> None:
+        try:
+            msg = json.loads(data)
+        except ValueError:
+            return
+        op = msg.get("op")
+        if op == "adopt":
+            self.adopt_remote(
+                uuid_mod.UUID(hex=msg["uuid"]), int(msg["home"])
+            )
+        elif op == "drop":
+            self.drop_remote(uuid_mod.UUID(hex=msg["uuid"]))
+        elif op == "inject":
+            # router-side HTTP /global_message: a trusted in-process
+            # injection stretched across the process boundary — the
+            # public PULL would (rightly) drop its nil sender
+            import base64
+
+            from ..protocol import deserialize_message
+
+            try:
+                message = deserialize_message(
+                    base64.b64decode(msg["data"])
+                )
+            except Exception:
+                logger.warning("undecodable control injection dropped")
+                return
+            await self.server.router.handle_message(message)
+
+    # endregion
+
+    def stats(self) -> dict:
+        return {
+            "shard_id": self.shard_id,
+            "n_shards": self.n_shards,
+            "remote_peers": len(self._remote),
+            "xshard_frames": self.xshard_frames,
+            **self.bus.stats(),
+        }

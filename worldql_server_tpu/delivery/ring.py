@@ -82,6 +82,17 @@ class Ring:
     @classmethod
     def attach(cls, name: str) -> "Ring":
         shm = shared_memory.SharedMemory(name=name)
+        # De-register from the ATTACHING process's resource tracker:
+        # the creator owns the block's lifetime (unlink), and on
+        # Python < 3.13 an attach silently registers too — so a dying
+        # attacher's tracker would unlink a ring its peers still use
+        # (cluster shards re-attach the same rings across restarts).
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass  # tracker internals shifted — worst case, a warning
         cap = _CUR.unpack_from(shm.buf, 16)[0]
         return cls(shm, int(cap))
 

@@ -370,10 +370,11 @@ class Config:
     # churn stream, a persistent incrementally-updated device hash,
     # and result reuse (a query/entity whose neighborhood is clean
     # replays last tick instead of recomputing). 'auto' (default)
-    # enables it exactly where it is proven: the single-chip TPU
-    # backend and pow2-cube entity planes; 'off' pins the full
+    # enables it exactly where it is proven: the device backends —
+    # single-chip TPU, and the sharded mesh via per-shard flat-region
+    # replay — and pow2-cube entity planes; 'off' pins the full
     # recompute pipeline byte for byte; 'on' is auto plus a config
-    # error where delta ticks cannot run (cpu/sharded backends).
+    # error where delta ticks cannot run (the cpu backend).
     delta_ticks: str = field(
         default_factory=lambda: _env("WQL_DELTA_TICKS", "auto")
     )
@@ -384,6 +385,24 @@ class Config:
         default_factory=lambda: float(
             _env("WQL_DELTA_REBUILD_THRESHOLD", "0.5")
         )
+    )
+    # Horizontal serving (worldql_server_tpu/cluster, ROADMAP 3):
+    # with cluster_shards > 0 this process boots the ROUTER TIER — the
+    # public ZMQ listener plus N supervised shard server processes,
+    # each running the full engine (own device backend, WAL, entity
+    # plane, governor) over a stable world→shard map, with cross-shard
+    # delivery riding inter-shard shared-memory rings. 0 (the default)
+    # never imports the cluster package: the single-process server is
+    # byte for byte what it always was.
+    cluster_shards: int = field(
+        default_factory=lambda: int(_env("WQL_CLUSTER_SHARDS", "0"))
+    )
+    # Process role inside a cluster: '' (standalone / implied router
+    # when cluster_shards > 0), 'router', or 'shard' (spawned by the
+    # router-tier supervisor with a WQL_CLUSTER_SPEC topology; attaches
+    # the ClusterShardExtension to an otherwise-normal server).
+    cluster_role: str = field(
+        default_factory=lambda: _env("WQL_CLUSTER_ROLE", "")
     )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
@@ -564,16 +583,46 @@ class Config:
             )
         if self.delta_ticks not in ("auto", "on", "off"):
             errors.append("delta_ticks must be 'auto', 'on' or 'off'")
-        if self.delta_ticks == "on" and self.spatial_backend != "tpu":
+        if self.delta_ticks == "on" and self.spatial_backend == "cpu":
             errors.append(
-                "delta_ticks='on' requires spatial_backend='tpu' (the "
-                "cpu backend resolves per query; the sharded backend "
-                "conservatively runs full recompute) — use 'auto' to "
-                "enable delta ticks only where supported"
+                "delta_ticks='on' requires a device spatial backend "
+                "('tpu' or 'sharded') — the cpu backend resolves per "
+                "query; use 'auto' to enable delta ticks only where "
+                "supported"
             )
         if not 0 < self.delta_rebuild_threshold <= 1:
             errors.append(
                 "delta_rebuild_threshold must be in (0, 1]"
+            )
+        if self.cluster_shards < 0:
+            errors.append("cluster_shards must be >= 0 (0 = no cluster)")
+        if self.cluster_role not in ("", "router", "shard"):
+            errors.append("cluster_role must be '', 'router' or 'shard'")
+        if self.cluster_shards > 0:
+            if self.cluster_role == "shard":
+                errors.append(
+                    "cluster_role='shard' cannot itself spawn a cluster "
+                    "— cluster_shards belongs to the router tier"
+                )
+            if not self.zmq_enabled:
+                errors.append(
+                    "cluster serving requires the ZMQ listener — the "
+                    "router tier owns no other client transport"
+                )
+            if self.ws_enabled:
+                errors.append(
+                    "cluster serving is ZMQ-only for now — pass --no-ws "
+                    "(the router tier has no WebSocket listener; shards "
+                    "boot with WS off)"
+                )
+        if self.cluster_role == "router" and self.cluster_shards < 1:
+            errors.append("cluster_role='router' requires cluster_shards >= 1")
+        if self.cluster_role == "shard" and not os.environ.get(
+            "WQL_CLUSTER_SPEC"
+        ):
+            errors.append(
+                "cluster_role='shard' requires the WQL_CLUSTER_SPEC "
+                "topology (set by the router-tier supervisor)"
             )
         if self.entity_k < 1:
             errors.append("entity_k must be >= 1")

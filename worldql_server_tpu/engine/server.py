@@ -55,7 +55,16 @@ def build_backend(config: Config) -> SpatialBackend:
             "sharded spatial backend on mesh batch=%d space=%d",
             mesh.shape["batch"], mesh.shape["space"],
         )
-        return ShardedTpuSpatialBackend(config.sub_region_size, mesh)
+        backend = ShardedTpuSpatialBackend(config.sub_region_size, mesh)
+        # result reuse on the mesh: per-shard flat-region replay
+        # (clean queries replay host-side; dirty partitions dispatch
+        # through the mesh kernels) — armed like the single-chip path
+        if config.delta_ticks != "off":
+            backend.configure_delta_ticks(config.delta_ticks)
+            backend.delta_rebuild_threshold = (
+                config.delta_rebuild_threshold
+            )
+        return backend
     return CpuSpatialBackend(config.sub_region_size)
 
 
@@ -257,6 +266,17 @@ class WorldQLServer:
             # BEFORE the restore so the next dispatch re-ships the
             # host authority instead of scattering onto a stale twin.
             self.backend.on_rebuild = self.entity_plane.abort_tick
+        # Cluster shard extension (worldql_server_tpu/cluster): remote
+        # peer proxies, the inter-shard ring drain and the control
+        # channel to the router tier. Only with --cluster-role shard
+        # (spawned by the router's supervisor, which provides the
+        # WQL_CLUSTER_SPEC topology); standalone servers never import
+        # the cluster package.
+        self.cluster = None
+        if config.cluster_role == "shard":
+            from ..cluster.shard import ClusterShardExtension
+
+            self.cluster = ClusterShardExtension(self)
         self.ticker = None
         self.staging = None
         if config.tick_interval > 0:
@@ -283,6 +303,7 @@ class WorldQLServer:
                 staging=self.staging,
                 entity_plane=self.entity_plane,
                 governor=self.governor,
+                cluster=self.cluster,
             )
         self.precompile_stats: dict | None = None
         # Durability engine: WAL + write-behind pipeline. With
@@ -402,6 +423,10 @@ class WorldQLServer:
             # governor state + shed/coalesce/rate-limit accounting:
             # nothing the overload plane does is invisible to a scrape
             self.metrics.gauge("overload", self.governor.status)
+        if self.cluster is not None:
+            # shard-side cluster accounting: remote proxies held,
+            # ring send/drop/drain counts, cross-shard frames
+            self.metrics.gauge("cluster_shard", self.cluster.stats)
         if self.device_telemetry is not None:
             self.metrics.gauge("device", self.device_telemetry.stats)
         if self.recorder is not None:
@@ -475,11 +500,12 @@ class WorldQLServer:
         q_r = int(getattr(self.backend, "delta_reused", 0))
         q_c = int(getattr(self.backend, "delta_recomputed", 0))
         q_f = int(getattr(self.backend, "delta_fallbacks", 0))
-        s_r = s_c = s_f = 0
+        s_r = s_c = s_f = f_r = 0
         if self.entity_plane is not None:
             s_r = self.entity_plane.delta_reused
             s_c = self.entity_plane.delta_recomputed
             s_f = self.entity_plane.delta_fallbacks
+            f_r = self.entity_plane.frames_reused
         total = q_r + q_c + s_r + s_c
         return {
             "query_reused": q_r,
@@ -488,6 +514,7 @@ class WorldQLServer:
             "sim_reused": s_r,
             "sim_recomputed": s_c,
             "sim_fallbacks": s_f,
+            "frames_reused": f_r,
             "reuse_fraction": (
                 round((q_r + s_r) / total, 4) if total else 0.0
             ),
@@ -548,6 +575,10 @@ class WorldQLServer:
         if self.sessions is not None:
             # a torn-down peer's token must never resume
             self.sessions.discard(uuid)
+        if self.cluster is not None:
+            # a homed peer's full teardown must reap its remote
+            # proxies cluster-wide (router re-broadcasts the drop)
+            self.cluster.on_peer_torn_down(uuid)
         self.backend.remove_peer(uuid)
         if self.governor is not None:
             # token bucket bookkeeping stays bounded by live peers
@@ -661,6 +692,11 @@ class WorldQLServer:
             self.supervisor.spawn(
                 "restored-peer-sweep", self._sweep_restored_peers
             )
+
+        if self.cluster is not None:
+            # LAST: the ZMQ listener is bound, so announcing ready to
+            # the router can never race a forward into a closed socket
+            await self.cluster.start()
 
         self._started.set()
         logger.info("worldql-server-tpu started")
@@ -870,6 +906,7 @@ class WorldQLServer:
         for name in (
             "checkpoint", "stale-sweep", "restored-peer-sweep",
             "session-sweep", "loop-monitor", "overload-governor",
+            "cluster-control", "cluster-drain",
         ):
             handle = self.supervisor.get(name)
             if handle is not None:
@@ -889,6 +926,10 @@ class WorldQLServer:
         for transport in reversed(self._transports):
             await transport.stop()
         self._transports.clear()
+        if self.cluster is not None:
+            # after the ticker drain (its last flush consumed the
+            # final ring records) and transport teardown
+            await self.cluster.stop()
         if self.delivery_plane is not None:
             # after the ticker drain (frames are already in the rings)
             # and transport teardown: workers own their sockets

@@ -71,6 +71,7 @@ class TickBatcher:
         staging=None,
         entity_plane=None,
         governor=None,
+        cluster=None,
     ):
         self.backend = backend
         self.peer_map = peer_map
@@ -111,6 +112,14 @@ class TickBatcher:
         # the queue (rows no longer line up with queued messages);
         # the flag stops further appends until the next resync/swap
         self._staging_desynced = False
+        # Optional cluster.shard.ClusterShardExtension (--cluster-role
+        # shard): every flush drains the inter-shard rings BETWEEN the
+        # local batch's device dispatch and its collect — the
+        # cross-shard collective hides behind the in-flight device
+        # window (``cluster.drain`` span) instead of serializing in
+        # front of it. None (the default) costs one attribute test per
+        # flush.
+        self._cluster = cluster
         # Optional observability.device.DeviceTelemetry: after each
         # collect it tags the tick trace with the device timing split
         # (encode/h2d/compute/d2h) and polls the retrace GUARD so a
@@ -317,10 +326,13 @@ class TickBatcher:
             batch = self._take_batch()
             plane = self._entity_plane
             sim_on = plane is not None and plane.active()
-            if not batch and not sim_on and self._governor is not None:
-                # idle windows are healthy samples — the governor's
-                # road back to OK once load drops
-                self._governor.note_idle(len(self._queue))
+            if not batch and not sim_on:
+                if self._cluster is not None:
+                    await self._cluster.drain()
+                if self._governor is not None:
+                    # idle windows are healthy samples — the governor's
+                    # road back to OK once load drops
+                    self._governor.note_idle(len(self._queue))
             if batch or sim_on:
                 trace = self._begin_trace(len(batch))
                 t0 = time.perf_counter()
@@ -353,6 +365,12 @@ class TickBatcher:
                             # the un-applied sim tick
                             plane.abort_tick()
                         raise
+                if self._cluster is not None:
+                    # between dispatch and the stage's collect — the
+                    # device window — serialized under the flushing
+                    # lock so pipelined stages never interleave drains
+                    with trace.span("cluster.drain") as dspan:
+                        dspan.tag(frames=await self._cluster.drain())
                 stage = self._collect_deliver(
                     batch, handle, self._tail, t0, trace, t_ingress_ns,
                     sim_handle, skip_frames,
@@ -539,6 +557,10 @@ class TickBatcher:
             plane = self._entity_plane
             sim_on = plane is not None and plane.active()
             if not batch and not sim_on:
+                if self._cluster is not None:
+                    # no local work this window — the inter-shard
+                    # rings still owe their drain on the tick clock
+                    await self._cluster.drain()
                 if self._governor is not None:
                     self._governor.note_idle(len(self._queue))
                 return
@@ -567,6 +589,14 @@ class TickBatcher:
                             self.metrics.observe_ms(
                                 "tick.dispatch_ms", self.last_dispatch_ms
                             )
+                if self._cluster is not None:
+                    # cross-shard leg INSIDE the device window: the
+                    # local batch (and sim tick) are already in flight
+                    # on device while the inter-shard rings drain —
+                    # the collective hides behind per-shard compute
+                    with trace.span("cluster.drain") as dspan:
+                        dspan.tag(frames=await self._cluster.drain())
+                if batch:
                     tc = time.perf_counter()
                     with trace.span("tick.collect"):
                         targets = await asyncio.to_thread(

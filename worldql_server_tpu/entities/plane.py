@@ -346,6 +346,16 @@ class EntityPlane:
         self.scatter_fallbacks = 0  # scatter errors → full upload
         self.last_h2d_rows = 0
         self.frames_native = 0
+        # Frame-level reuse (ISSUE 14 satellite, the PR 13 leftover):
+        # a cohort whose membership AND member positions did not
+        # change since last tick replays last tick's encoded wire
+        # bytes instead of re-running wql_encode_entity_frames —
+        # keyed by the cohort key, guarded by exact row/position
+        # byte equality, invalidated wholesale by any slot identity
+        # change (registration/removal clears it: uuid/pid bytes at a
+        # reused slot would otherwise alias a stale frame).
+        self._frame_cache: dict[bytes, tuple] = {}
+        self.frames_reused = 0
 
     # region: wire ingest (router arrival path)
 
@@ -664,6 +674,9 @@ class EntityPlane:
         self._pid[slot] = pid
         self._vel[slot] = 0.0
         self._live[slot] = True
+        # slot identity changed: cached frames keyed on row indices
+        # could alias the new occupant — drop them all
+        self._frame_cache.clear()
         self._peer_slots.setdefault(pid, set()).add(slot)
         # index coupling: a fresh entity is queryable IMMEDIATELY —
         # its row enters the index's delta path in this same turn.
@@ -757,6 +770,9 @@ class EntityPlane:
         # the parked values must reach the device twin
         self._device_dirty[slot] = True
         self._free.append(slot)
+        # slot identity changed (see _alloc_slot): cached frames over
+        # this row are stale the moment the slot is reusable
+        self._frame_cache.clear()
         self.entities_removed += 1
 
     def on_peer_removed(self, peer: uuid_mod.UUID) -> int:
@@ -1406,19 +1422,48 @@ class EntityPlane:
         cohorts, inverse = np.unique(key, axis=0, return_inverse=True)
         pairs = []
         peer_uuids = self._peer_uuids
+        cache = self._frame_cache
+        next_cache: dict[bytes, tuple] = {}
+        reused = 0
         for c in range(cohorts.shape[0]):
             crows = rows[inverse == c]
-            tgt = cohorts[c, 1:]
-            tgt = np.unique(tgt[tgt >= 0])
-            targets_u = [peer_uuids[int(p)] for p in tgt]
-            world = self._world_names[int(cohorts[c, 0])]
-            frames = wire.encode_frames(
-                self._peer_key_arr[self._pid[crows]],
-                self._uuid_bytes[crows],
-                pos[crows].astype(np.float64),
-                world.encode(),
-            )
+            # frame-level reuse: the cohort key pins world + recipient
+            # set; byte-identical member rows and positions pin the
+            # encoded output exactly (sender keys and entity uuids are
+            # per-slot constants within a roster epoch — any slot
+            # alloc/release cleared the cache), so a clean cohort
+            # replays last tick's wire bytes, parity byte for byte
+            key_b = cohorts[c].tobytes()
+            crows_b = crows.tobytes()
+            sub_pos = pos[crows]
+            pos_b = sub_pos.tobytes()
+            cached = cache.get(key_b)
+            if (
+                cached is not None
+                and cached[0] == crows_b
+                and cached[1] == pos_b
+            ):
+                frames, targets_u = cached[2], cached[3]
+                reused += len(frames)
+            else:
+                tgt = cohorts[c, 1:]
+                tgt = np.unique(tgt[tgt >= 0])
+                targets_u = [peer_uuids[int(p)] for p in tgt]
+                world = self._world_names[int(cohorts[c, 0])]
+                frames = wire.encode_frames(
+                    self._peer_key_arr[self._pid[crows]],
+                    self._uuid_bytes[crows],
+                    sub_pos.astype(np.float64),
+                    world.encode(),
+                )
+            next_cache[key_b] = (crows_b, pos_b, frames, targets_u)
             pairs.extend((WireFrame(f), targets_u) for f in frames)
+        # cohorts absent this tick age out with the wholesale swap
+        self._frame_cache = next_cache
+        if reused:
+            self.frames_reused += reused
+            if self.metrics is not None:
+                self.metrics.inc("delta.frames_reused", reused)
         self.frames_native += len(pairs)
         return pairs
 
@@ -1471,6 +1516,7 @@ class EntityPlane:
             "frames": self.frames,
             "frames_skipped": self.frames_skipped,
             "frames_native": self.frames_native,
+            "frames_reused": self.frames_reused,
             "coalesced": self.coalesced,
             "pending": self.staged_count(),
             "wire_rows": self.wire_rows,

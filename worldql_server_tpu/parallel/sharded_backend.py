@@ -98,15 +98,28 @@ class ShardedTpuSpatialBackend(TpuSpatialBackend):
         self._kernels: dict[tuple, object] = {}
 
     def supports_delta_ticks(self) -> bool:
-        # Conservatively OFF on the mesh for now: result reuse must be
-        # proven against per-shard flat regions + pmax merges before
-        # it is allowed to skip them ('auto' therefore resolves to the
-        # full-recompute path here — correct, just not yet faster).
-        return False
+        """Result reuse runs on the mesh via PER-SHARD FLAT-REGION
+        replay (ISSUE 14 satellite, the PR 13 leftover): the reuse
+        cache and its validity tracking are HOST state shared with the
+        single-chip backend (signatures, per-cube dirty sequence,
+        `_install_base` floors — all fed by the same mutation paths
+        this class inherits), so a clean query replays its cached
+        fan-out without touching any device; only the dirty partition
+        dispatches, through the ordinary mesh kernels, whose CSR
+        results are assembled as per-batch-shard flat regions and
+        decoded by this class's own region-walk overrides — the pmax
+        merge happens (or is skipped) per sub-batch exactly as it
+        would for a full tick. Replay correctness therefore never
+        depends on the mesh layout; layout only shapes what the dirty
+        partition computes. Pinned lane-for-lane against the
+        full-recompute mesh by the randomized-churn parity suite."""
+        return True
 
     def _delta_scatter_supported(self) -> bool:
-        # the sorted-segment tombstone scatter assumes single-device
-        # arrays; the replicated delta twin keeps the full sort path
+        # the O(K) tombstone scatter targets the single-device sorted
+        # DELTA segment; the mesh replicates that segment, so delta
+        # sync keeps the full-sort path (orthogonal to result reuse —
+        # reuse replays results, the scatter maintains the hash)
         return False
 
     # region: shardings

@@ -591,6 +591,22 @@ class OverloadGovernor:
 
     # endregion
 
+    def export_state(self) -> dict:
+        """Cluster shed export (cluster/shard.py state packets): the
+        compact view a router tier's :class:`~..cluster.router.
+        ShedMirror` acts on — the level it mirrors for router-side
+        admission plus the shed counters that close the cluster-wide
+        exact-accounting audit (offered == admitted + shed-at-router +
+        shed-at-shard, bench config 11)."""
+        return {
+            "level": self.level,
+            "state": self._state,
+            "admitted_batch": self._admitted,
+            "shed": dict(self.shed),
+            "drop_oldest": self.drop_oldest,
+            "rate_limited": self.rate_limited,
+        }
+
     def status(self) -> dict:
         """The ``overload`` gauge + the /healthz block. Numeric leaves
         flatten into Prometheus gauges."""

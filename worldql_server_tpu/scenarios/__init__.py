@@ -9,8 +9,8 @@ tests/test_scenarios.py.
 """
 
 from .catalog import (
-    BattleRoyale, FlashCrowd, GameTick, ReconnectStorm,
-    ReconnectStormReplay,
+    BattleRoyale, ClusterFlashCrowd, FlashCrowd, GameTick,
+    ReconnectStorm, ReconnectStormReplay,
 )
 from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
 
@@ -18,7 +18,7 @@ CATALOG = {
     scenario.name: scenario
     for scenario in (
         FlashCrowd, BattleRoyale, ReconnectStorm, GameTick,
-        ReconnectStormReplay,
+        ReconnectStormReplay, ClusterFlashCrowd,
     )
 }
 
@@ -26,6 +26,7 @@ __all__ = [
     "CATALOG",
     "BattleRoyale",
     "Check",
+    "ClusterFlashCrowd",
     "FlashCrowd",
     "GameTick",
     "ReconnectStorm",

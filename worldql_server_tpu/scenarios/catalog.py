@@ -29,7 +29,7 @@ import numpy as np
 from ..engine.config import Config
 from ..protocol.types import Entity, Instruction, Message, Record, Vector3
 from ..robustness import failpoints
-from .client import ZmqPeer, free_port
+from .client import ZmqPeer, free_port, free_port_block
 from .engine import Check, Scenario, ScenarioContext, pctl
 
 
@@ -781,4 +781,252 @@ class ReconnectStormReplay(Scenario):
                   slo["recovery_errors"], 0),
             Check("broker_answers_after_replay_storm",
                   slo["broker_answers"], slo["broker_answers"], True),
+        ]
+
+
+class ClusterFlashCrowd(Scenario):
+    """Cluster hotspot (ISSUE 14, ROADMAP 5's multi-process leftover):
+    a flash crowd drowns ONE shard's world behind the router tier.
+    Survival means the overload stays CONTAINED — the hot shard
+    escalates and its refusals move to the ROUTER (shed before the
+    shard ever sees the bytes), the cold shard keeps serving at OK the
+    whole time, every record offered during the storm lands (records
+    are never shed at either tier), cross-shard delivery keeps a
+    bounded p99 under the storm, and the hot shard walks back to OK
+    once the crowd disperses."""
+
+    name = "cluster_flash_crowd"
+    description = "hotspot world drowns one shard; router sheds for it"
+    #: spawns shard subprocesses — runs in the dedicated "Cluster
+    #: smoke" CI step (and by explicit name), not the default set
+    ci_smoke = False
+
+    def build_config(self, shape: str) -> Config:
+        return Config(
+            store_url="memory://",
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1",
+            zmq_server_port=free_port_block(3),
+            spatial_backend="cpu", tick_interval=0.02,
+            max_batch=32, overload="on",
+            overload_recover_ticks=5,
+            supervisor_backoff=0.005,
+            cluster_shards=2,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        import uuid as uuid_mod
+
+        runtime = ctx.server
+        world_map = runtime.router.world_map
+        n_flood = 6 if ctx.smoke else 16
+        storm_s = 1.5 if ctx.smoke else 6.0
+        n_records = 12 if ctx.smoke else 60
+
+        def world_for(shard: int, stem: str) -> str:
+            for i in range(10_000):
+                name = f"{stem}{i}"
+                if world_map.shard_of_world(name) == shard:
+                    return name
+            raise AssertionError("no world for shard")
+
+        def uuid_for(shard: int) -> uuid_mod.UUID:
+            while True:
+                u = uuid_mod.uuid4()
+                if world_map.shard_of_peer(u) == shard:
+                    return u
+
+        hot = world_for(0, "hotspot")      # owned by shard 0
+        cold = world_for(1, "steady")      # owned by shard 1
+        hot_pos = Vector3(5.0, 5.0, 5.0)
+        cold_pos = Vector3(900.0, 5.0, 5.0)
+
+        flooders = [await ctx.connect() for _ in range(n_flood)]
+        # the cold pair: receiver homed on shard 0, so every cold-world
+        # frame (resolved on shard 1, the owner) crosses the 1→0 ring
+        rx = await ctx.connect(peer_uuid=uuid_for(0))
+        tx = await ctx.connect(peer_uuid=uuid_for(1))
+        for c in flooders:
+            await c.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name=hot, position=hot_pos,
+            ))
+        for c in (rx, tx):
+            await c.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name=cold, position=cold_pos,
+            ))
+        await asyncio.sleep(0.3)
+
+        counters = runtime.metrics.snapshot()["counters"]
+        shed_before = counters.get("cluster.router_shed_local", 0)
+        levels = {"hot": 0, "cold": 0}
+        xshard_ms: list[float] = []
+        stop = asyncio.Event()
+
+        async def flood(client: ZmqPeer) -> int:
+            # paced: far beyond the hot shard's 2×max_batch admission
+            # cap (REJECT holds for the whole storm) without starving
+            # the 1-core router's event loop of the cold traffic this
+            # scenario measures against it
+            sent = 0
+            while not stop.is_set():
+                for _ in range(16):
+                    await client.send(Message(
+                        instruction=Instruction.LOCAL_MESSAGE,
+                        world_name=hot, position=hot_pos,
+                        parameter="crowd",
+                    ))
+                    sent += 1
+                await asyncio.sleep(0.002)
+            return sent
+
+        async def cold_traffic() -> int:
+            sent = 0
+            while not stop.is_set():
+                await tx.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name=cold, position=cold_pos,
+                    parameter=f"x:{time.monotonic_ns()}",
+                ))
+                sent += 1
+                await asyncio.sleep(0.05)
+            return sent
+
+        async def cold_receiver() -> None:
+            while True:
+                got = await rx.recv(30)
+                if (
+                    got.instruction == Instruction.LOCAL_MESSAGE
+                    and got.parameter
+                    and got.parameter.startswith("x:")
+                ):
+                    t_sent = int(got.parameter.split(":", 1)[1])
+                    xshard_ms.append(
+                        (time.monotonic_ns() - t_sent) / 1e6
+                    )
+
+        async def sampler() -> None:
+            while not stop.is_set():
+                levels["hot"] = max(
+                    levels["hot"], runtime.router.mirror.level(0)
+                )
+                levels["cold"] = max(
+                    levels["cold"], runtime.router.mirror.level(1)
+                )
+                await asyncio.sleep(0.02)
+
+        async def record_stream() -> list:
+            created = []
+            for i in range(n_records):
+                world, pos = ((hot, hot_pos) if i % 2 == 0
+                              else (cold, cold_pos))
+                rec = uuid_mod.uuid4()
+                await tx.send(Message(
+                    instruction=Instruction.RECORD_CREATE,
+                    world_name=world,
+                    records=[Record(uuid=rec, position=pos,
+                                    world_name=world, data=f"r{i}")],
+                ))
+                created.append((world, rec))
+                await asyncio.sleep(storm_s / n_records)
+            return created
+
+        receiver = asyncio.ensure_future(cold_receiver())
+        try:
+            async def stopper():
+                await asyncio.sleep(storm_s)
+                stop.set()
+
+            results = await asyncio.gather(
+                *(flood(c) for c in flooders), cold_traffic(),
+                record_stream(), sampler(), stopper(),
+            )
+            offered = sum(results[:n_flood])
+            cold_sent = results[n_flood]
+            created = results[n_flood + 1]
+            # let in-flight cold frames land before closing the books
+            await asyncio.sleep(1.0)
+        finally:
+            receiver.cancel()
+            try:
+                await receiver
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        # recovery: the hot shard must walk back to OK and re-report
+        recovered = False
+        deadline = time.perf_counter() + (15 if ctx.smoke else 30)
+        while time.perf_counter() < deadline:
+            if runtime.router.mirror.level(0) == 0:
+                recovered = True
+                break
+            await asyncio.sleep(0.1)
+
+        # zero record loss: every record offered during the storm is
+        # readable back through the router (records are never shed)
+        async def readable(world, pos, want: set) -> int:
+            deadline = time.perf_counter() + 20
+            seen: set = set()
+            while time.perf_counter() < deadline and not want <= seen:
+                await rx.send(Message(
+                    instruction=Instruction.RECORD_READ,
+                    world_name=world, position=pos,
+                ))
+                try:
+                    reply = await rx.recv_until(
+                        Instruction.RECORD_REPLY, 5
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                seen |= {r.uuid for r in reply.records}
+            return len(want & seen)
+
+        hot_want = {r for w, r in created if w == hot}
+        cold_want = {r for w, r in created if w == cold}
+        hot_found = await readable(hot, hot_pos, hot_want)
+        cold_found = await readable(cold, cold_pos, cold_want)
+
+        counters = runtime.metrics.snapshot()["counters"]
+        return {
+            "offered": offered,
+            "cold_sent": cold_sent,
+            "cold_received": len(xshard_ms),
+            "router_shed_local":
+                counters.get("cluster.router_shed_local", 0) - shed_before,
+            "router_forwarded":
+                counters.get("cluster.router_forwarded", 0),
+            "hot_peak_level": levels["hot"],
+            "cold_peak_level": levels["cold"],
+            "records_offered": len(created),
+            "records_found": hot_found + cold_found,
+            "xshard_p99_ms": round(pctl(xshard_ms, 0.99) or 0.0, 2),
+            "hot_recovered": recovered,
+            "broker_answers": await ctx.heartbeat_ok(tx),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        p99_limit = 2_000 if ctx.smoke else 500
+        return [
+            Check("hot_shard_escalated", slo["hot_peak_level"] >= 2,
+                  slo["hot_peak_level"], ">= 2 (shed_high)"),
+            Check("router_shed_for_hot_shard",
+                  slo["router_shed_local"] > 0,
+                  slo["router_shed_local"], "> 0",
+                  "REJECT moved to the router tier"),
+            Check("cold_shard_stayed_ok", slo["cold_peak_level"] == 0,
+                  slo["cold_peak_level"], 0),
+            Check("zero_record_loss",
+                  slo["records_found"] == slo["records_offered"],
+                  slo["records_found"], slo["records_offered"],
+                  "records are never shed at either tier"),
+            Check("xshard_delivery_flowed", slo["cold_received"] > 0,
+                  slo["cold_received"], "> 0"),
+            Check("xshard_p99_bounded",
+                  slo["xshard_p99_ms"] <= p99_limit,
+                  slo["xshard_p99_ms"], f"<= {p99_limit} ms"),
+            Check("hot_shard_recovered_to_ok", slo["hot_recovered"],
+                  slo["hot_recovered"], True),
+            Check("broker_answers_after_storm", slo["broker_answers"],
+                  slo["broker_answers"], True),
         ]

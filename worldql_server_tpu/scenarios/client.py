@@ -30,6 +30,29 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def free_port_block(n: int, attempts: int = 64) -> int:
+    """A base port with ``base..base+n`` all currently free — cluster
+    configs derive shard listener ports as ``base + 1 + shard_id``."""
+    for _ in range(attempts):
+        socks = []
+        try:
+            first = socket.socket()
+            first.bind(("127.0.0.1", 0))
+            base = first.getsockname()[1]
+            socks.append(first)
+            for off in range(1, n + 1):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("could not find a free port block")
+
+
 class ZmqPeer:
     """One scenario client. ``token`` carries the session token from
     the handshake echo; ``retry_after_ms`` is set instead when the

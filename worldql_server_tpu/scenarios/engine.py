@@ -165,7 +165,16 @@ async def _run_async(scenario: Scenario, shape: str) -> dict:
     # them into the next scenario or the embedding process
     failpoints.registry.reset()
     config = scenario.build_config(shape)
-    server = WorldQLServer(config, backend=scenario.build_backend())
+    if getattr(config, "cluster_shards", 0) > 0:
+        # cluster scenarios drive the ROUTER TIER — shard server
+        # subprocesses plus the in-process router — through the same
+        # Scenario surface (the runtime mirrors the server's
+        # metrics/shutdown contract; ticker/governor are per shard)
+        from ..cluster import ClusterRuntime
+
+        server = ClusterRuntime(config)
+    else:
+        server = WorldQLServer(config, backend=scenario.build_backend())
     start_task = None
     if scenario.concurrent_boot:
         start_task = asyncio.ensure_future(server.start())
