@@ -3368,6 +3368,9 @@ async def _cluster_point(n_shards: int, window_s: float,
     pos = V3(5.0, 5.0, 5.0)
     runtime = ClusterRuntime(config)
     await runtime.start()
+    # prime the per-core efficiency gauge's sampling window: the final
+    # read then rates Δdeliveries / Δcpu-seconds over the load phases
+    runtime.router.federation.deliveries_per_s_per_core()
     clients: list[ZmqPeer] = []
     try:
         async def connect(**kw) -> ZmqPeer:
@@ -3394,9 +3397,13 @@ async def _cluster_point(n_shards: int, window_s: float,
                 position=pos,
             ))
         # cross-shard latency pair (n >= 2): receiver homed on shard
-        # 0, world owned by shard 1 — every frame crosses the 1→0 ring
+        # 0, world owned by shard 1 — every frame crosses the 1→0 ring.
+        # Latency is NOT timed harness-side anymore: the shards close
+        # cluster.e2e_ms / cluster.xshard_ms live at socket-write-
+        # complete and the router federates them (ISSUE 15); the
+        # receiver below only drains its socket.
         rx = tx = None
-        xshard_ms: list[float] = []
+        xshard_received = 0
         if n_shards >= 2:
             rx = await connect(peer_uuid=uuid_for(0))
             tx = await connect(peer_uuid=uuid_for(1))
@@ -3434,6 +3441,7 @@ async def _cluster_point(n_shards: int, window_s: float,
             return sent
 
         async def xshard_receiver() -> None:
+            nonlocal xshard_received
             while True:
                 got = await rx.recv(30)
                 if (
@@ -3441,10 +3449,7 @@ async def _cluster_point(n_shards: int, window_s: float,
                     and got.parameter
                     and got.parameter.startswith("x:")
                 ):
-                    xshard_ms.append(
-                        (time.monotonic_ns()
-                         - int(got.parameter.split(":", 1)[1])) / 1e6
-                    )
+                    xshard_received += 1
 
         async def stopper(for_s: float):
             await asyncio.sleep(for_s)
@@ -3523,11 +3528,18 @@ async def _cluster_point(n_shards: int, window_s: float,
                     pass
 
         arrived, shed_shard = totals(await settle())
-        router_counters = runtime.metrics.snapshot()["counters"]
+        snapshot = runtime.metrics.snapshot()
+        router_counters = snapshot["counters"]
         shed_router = router_counters.get("cluster.router_shed_local", 0)
         admitted = arrived - shed_shard
         audit_exact = offered == admitted + shed_shard + shed_router
-        xs = sorted(xshard_ms)
+        # ISSUE 15: latency leaves come from the LIVE federated
+        # histograms the shards closed at socket-write-complete —
+        # the router's one /metrics registry, not harness clocks
+        latency = snapshot["latency"]
+        e2e = latency.get("cluster.e2e_ms") or {}
+        xshard = latency.get("cluster.xshard_ms") or {}
+        per_core = runtime.router.federation.deliveries_per_s_per_core()
         return {
             "shards": n_shards,
             "offered": offered,
@@ -3537,11 +3549,16 @@ async def _cluster_point(n_shards: int, window_s: float,
             "shed_router": shed_router,
             "shed_shard": shed_shard,
             "audit_exact": bool(audit_exact),
-            "xshard_frames": len(xs),
-            "xshard_p99_ms": (
-                round(xs[min(len(xs) - 1, int(len(xs) * 0.99))], 2)
-                if xs else None
+            "cluster_e2e_frames": int(e2e.get("count", 0)),
+            "cluster_e2e_p99_ms": (
+                round(e2e["p99_ms"], 2) if e2e.get("count") else None
             ),
+            "xshard_frames": int(xshard.get("count", 0)),
+            "xshard_received": xshard_received,
+            "xshard_p99_ms": (
+                round(xshard["p99_ms"], 2) if xshard.get("count") else None
+            ),
+            "deliveries_per_s_per_core": per_core,
             "router_forwarded":
                 router_counters.get("cluster.router_forwarded", 0),
         }
@@ -3563,9 +3580,15 @@ def bench_config11(args) -> dict:
     1-core box the shards time-share the core, so the curve measures
     the serving stack's overhead and accounting honesty, not speedup —
     the near-linear claim belongs to a multi-core/multi-chip run.
+    Latency leaves (``cluster_e2e_p99_ms`` / ``xshard_p99_ms``) read
+    the LIVE federated histograms the shards close at socket-write-
+    complete (ISSUE 15), not harness-side clocks, and
+    ``deliveries_per_s_per_core`` is the ROADMAP item 4 efficiency
+    gauge (Δdeliveries ÷ Δcpu-seconds across the fleet).
     ``--smoke`` asserts every point's audit is exact, the router tier
-    provably shed for a drowning shard, and cross-shard delivery
-    flowed. NOTE: shard subprocesses inherit the environment — on a
+    provably shed for a drowning shard, cross-shard delivery flowed,
+    and the live histograms + per-core gauge advanced. NOTE: shard
+    subprocesses inherit the environment — on a
     TPU-less box with libtpu installed, JAX_PLATFORMS=cpu must be set
     (the CI bench step does)."""
     shard_counts = [1, 2] if args.quick else [1, 2, 4]
@@ -3581,7 +3604,10 @@ def bench_config11(args) -> dict:
             f"router shed {point['shed_router']:,}, shard shed "
             f"{point['shed_shard']:,}, audit "
             f"{'EXACT' if point['audit_exact'] else 'BROKEN'}, "
-            f"xshard p99 {point['xshard_p99_ms']} ms"
+            f"e2e p99 {point['cluster_e2e_p99_ms']} ms (live hist, "
+            f"{point['cluster_e2e_frames']:,} frames), xshard p99 "
+            f"{point['xshard_p99_ms']} ms, "
+            f"{point['deliveries_per_s_per_core']:,}/s/core"
         )
         points.append(point)
 
@@ -3598,14 +3624,31 @@ def bench_config11(args) -> dict:
         assert multi and all(p["xshard_frames"] > 0 for p in multi), (
             "smoke: cross-shard delivery never flowed"
         )
+        # ISSUE 15: the latency leaves must come from the LIVE
+        # federated histograms — frames closed on the shards, merged
+        # at the router — and the per-core gauge must have rated
+        assert all(p["cluster_e2e_frames"] > 0 for p in points), (
+            "smoke: no shard ever closed the router-ingress frame "
+            "clock (cluster.e2e_ms empty in the federated registry)"
+        )
+        assert all(
+            p["xshard_p99_ms"] is not None for p in multi
+        ), "smoke: live cluster.xshard_ms histogram never advanced"
+        assert any(
+            p["deliveries_per_s_per_core"] > 0 for p in points
+        ), "smoke: deliveries_per_s_per_core never rated"
         log("smoke: cluster audit exact at every point, router-tier "
-            "shed fired, cross-shard delivery flowed")
+            "shed fired, cross-shard delivery flowed, live e2e/xshard "
+            "histograms + per-core gauge advanced")
     return {
         "metric": "cluster_audit_failures",
         "value": audit_failures,
         "unit": "count",
         "audit_failures": audit_failures,
         "max_admitted_per_s": max(p["admitted_per_s"] for p in points),
+        "deliveries_per_s_per_core": max(
+            p["deliveries_per_s_per_core"] for p in points
+        ),
         "points": points,
         "config": 11,
     }

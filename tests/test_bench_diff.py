@@ -23,6 +23,9 @@ def test_direction_heuristics():
     assert direction("delivery.ring_full_drops") == -1
     assert direction("workers.lost_frames") == -1
     assert direction("deliveries_per_s") == 1
+    # the ISSUE 15 per-core efficiency leaf gates higher-is-better
+    assert direction("deliveries_per_s_per_core") == 1
+    assert direction("points.1.cluster_e2e_p99_ms") == -1
     assert direction("vs_baseline") == 1
     assert direction("zipf.occupied_cubes") == 0
 
@@ -220,6 +223,13 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     # lower-is-better; 0 -> 1 crosses the --min-abs floor)
     assert by_config[11]["audit_failures"] == 0
     no_timing_leaves(by_config[11])
+    # the ISSUE 15 observability leaves are runner-bound too: the
+    # bench reports cluster_e2e_p99_ms / xshard_p99_ms (live federated
+    # histograms) and deliveries_per_s_per_core per round, but none of
+    # them belong in the checked-in gate record ("per_core" dodges the
+    # *_s suffix check above, so pin it by name)
+    assert "deliveries_per_s_per_core" not in by_config[11]
+    assert "points" not in by_config[11]
     bad = copy.deepcopy(records)
     for rec in bad:
         if rec["config"] == 11:
@@ -230,6 +240,52 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(broken_audit), *gate]) == 1
+
+
+def test_cluster_observability_leaves_gate_structurally(tmp_path):
+    """The ISSUE 15 bench satellite: a config-11 round carries the
+    live-histogram latency leaves + the per-core efficiency gauge, and
+    a collapsed deliveries_per_s_per_core (or an exploded federated
+    e2e p99) flags under the CI gate invocation on its own."""
+    gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
+    old_rec = {
+        "config": 11, "audit_failures": 0, "value": 0,
+        "deliveries_per_s_per_core": 5000.0,
+        "points": [{
+            "shards": 2, "cluster_e2e_p99_ms": 10.0,
+            "xshard_p99_ms": 8.0, "deliveries_per_s_per_core": 5000.0,
+        }],
+    }
+    # structural presence: every new leaf survives flattening (a
+    # silently dropped leaf would stop gating without failing anything)
+    flat = flatten(old_rec)
+    assert {
+        "deliveries_per_s_per_core",
+        "points.0.cluster_e2e_p99_ms",
+        "points.0.xshard_p99_ms",
+        "points.0.deliveries_per_s_per_core",
+    } <= set(flat)
+    old = tmp_path / "old11.json"
+    old.write_text(json.dumps(old_rec))
+    good = tmp_path / "good11.json"
+    good.write_text(json.dumps(old_rec))
+    assert main([str(old), str(good), *gate]) == 0
+    # per-core throughput collapsed >2x (ratio measured vs the NEW
+    # value for higher-better leaves) → red
+    import copy as copy_mod
+
+    bad_rec = copy_mod.deepcopy(old_rec)
+    bad_rec["deliveries_per_s_per_core"] = 2000.0
+    bad_rec["points"][0]["deliveries_per_s_per_core"] = 2000.0
+    bad = tmp_path / "bad11.json"
+    bad.write_text(json.dumps(bad_rec))
+    assert main([str(old), str(bad), *gate]) == 1
+    # federated e2e p99 exploded >2x → red
+    slow_rec = copy_mod.deepcopy(old_rec)
+    slow_rec["points"][0]["cluster_e2e_p99_ms"] = 25.0
+    slow = tmp_path / "slow11.json"
+    slow.write_text(json.dumps(slow_rec))
+    assert main([str(old), str(slow), *gate]) == 1
 
 
 def test_higher_better_drop_ratio_vs_new_value():

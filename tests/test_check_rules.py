@@ -824,10 +824,11 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     from tools.check import all_rules
 
     names = {r.name for r in all_rules()}
-    assert len(names) >= 19
+    assert len(names) >= 20
     assert names == {
         "async-dangling-task",
         "blocking-cross-shard",
+        "untraced-forward",
         "unbounded-ingest",
         "unguarded-handshake",
         "per-entity-python-ingest",
@@ -1461,6 +1462,92 @@ def test_blocking_cross_shard_quiet_on_enqueue_and_drain_idiom():
     """
     assert violations(src, relpath="worldql_server_tpu/cluster/shard.py",
                       select="blocking-cross-shard") == []
+
+
+# region: untraced-forward (ISSUE 15)
+
+CLUSTER_ROUTER_PATH = "worldql_server_tpu/cluster/router.py"
+CLUSTER_BUS_PATH = "worldql_server_tpu/cluster/bus.py"
+
+
+def test_untraced_forward_fires_on_ctxless_forward_and_push_send():
+    src = """
+    class ClusterRouter:
+        def _route(self, data):
+            self._forward(shard, data)
+
+        def _forward(self, shard, data):
+            self._push[shard].send(data, flags=NOBLOCK)
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="untraced-forward") == [
+        ("untraced-forward", 4), ("untraced-forward", 7),
+    ]
+
+
+def test_untraced_forward_quiet_when_ctx_threads_through():
+    src = """
+    class ClusterRouter:
+        def _route(self, data):
+            ctx = (new_trace_id(), t_ingress_ns)
+            self._forward(shard, data, ctx)
+
+        def _forward(self, shard, data, ctx):
+            self._push[shard].send(
+                tracectx.wrap(data, ctx[0], ctx[1]), flags=NOBLOCK
+            )
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="untraced-forward") == []
+
+
+def test_untraced_forward_fires_on_ctxless_ring_write_in_bus():
+    src = """
+    class InterShardBus:
+        def send_frame(self, target, peer, data, t_ingress_ns=0):
+            ring = self._tx.get(target)
+            return ring.try_write(peer.bytes + data, b"", t_ingress_ns)
+    """
+    assert violations(src, relpath=CLUSTER_BUS_PATH,
+                      select="untraced-forward") == [
+        ("untraced-forward", 5),
+    ]
+
+
+def test_untraced_forward_quiet_on_ctx_header_ring_write():
+    src = """
+    class InterShardBus:
+        def send_frame(self, target, peer, data, t_ingress_ns=0, ctx=None):
+            ring = self._tx.get(target)
+            ctx_header = _CTX.pack(*(ctx or (0, 0))) + peer.bytes
+            return ring.try_write(ctx_header + data, b"", t_ingress_ns)
+    """
+    assert violations(src, relpath=CLUSTER_BUS_PATH,
+                      select="untraced-forward") == []
+
+
+def test_untraced_forward_honors_pragma_and_scope():
+    src = """
+    class ClusterRouter:
+        async def _push_refusal(self, parameter, retry_ms):
+            await push.send(refusal_bytes)  # wql: allow(untraced-forward)
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="untraced-forward") == []
+    # the delivery plane's ring writes are a different conduit with
+    # its own rules — out of this rule's scope
+    src2 = """
+    class DeliveryPlane:
+        def _submit(self, shard, frame, slots_le):
+            return shard.ring.try_write(frame, slots_le)
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/delivery/plane.py",
+        select="untraced-forward",
+    ) == []
+
+
+# endregion
 
 
 def test_blocking_cross_shard_honors_pragma_and_scope():

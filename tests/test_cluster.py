@@ -53,7 +53,37 @@ from worldql_server_tpu.protocol.types import (
 )
 from worldql_server_tpu.scenarios.client import ZmqPeer
 
+from tests.prom_parser import parse_exposition, validate_exposition
+
 POS = Vector3(5.0, 5.0, 5.0)
+
+
+def _http_text(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _monotone_series(text: str) -> dict:
+    """Federated-series snapshot for monotonicity checks: every
+    counter sample and histogram bucket/count of the cluster.* family,
+    keyed by (name, le) — gauges are excluded (they may move down)."""
+    types, samples = parse_exposition(text)
+    out = {}
+    for name, labels, value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                break
+        kind = types.get(base) or types.get(name)
+        if kind not in ("counter", "histogram"):
+            continue
+        if name.endswith("_sum"):
+            continue  # float sums jitter; counts are the contract
+        if not name.startswith("wql_cluster"):
+            continue
+        out[(name, labels.get("le", ""))] = value
+    return out
 
 
 def _port_block(n: int, attempts: int = 64) -> int:
@@ -128,6 +158,15 @@ async def _wait(predicate, timeout_s: float, what: str, interval=0.1):
 def _http_json(url: str) -> dict:
     with urllib.request.urlopen(url, timeout=10) as resp:
         return json.loads(resp.read())
+
+
+def _maybe(fn):
+    """Poll helper: a predicate's transient error (scrape racing a
+    shard restart, half-federated series) reads as not-ready."""
+    try:
+        return fn()
+    except Exception:
+        return None
 
 
 async def _drain_cluster_e2e(tmp_path):
@@ -301,6 +340,144 @@ async def _drain_cluster_e2e(tmp_path):
             "dispatch→collect device window",
         )
 
+        # --- ISSUE 15: ONE federated /metrics for the fleet ---------
+        # drive w1 locals too so BOTH shards close the router-ingress
+        # frame clock (shard 1 on its local delivery leg, shard 0 on
+        # the ring drain of A's copies)
+        for i in range(10):
+            await a.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name=w1,
+                position=POS, parameter=f"fed-{i}",
+            ))
+            await asyncio.sleep(0.01)
+        await recv_param(b, Instruction.LOCAL_MESSAGE, "fed-9")
+        metrics_url = f"http://127.0.0.1:{config.http_port}/metrics"
+
+        def federated_series():
+            text = _http_text(metrics_url)
+            validate_exposition(text)  # strict-parse, no collisions
+            _, samples = parse_exposition(text)
+            counts = {
+                name: value for name, labels, value in samples
+                if not labels
+            }
+            # per-shard AND aggregate e2e series advancing, plus the
+            # cross-shard histogram and the per-core efficiency gauge
+            if (
+                counts.get("wql_cluster_e2e_seconds_count", 0) > 0
+                and counts.get(
+                    "wql_cluster_shard_0_e2e_seconds_count", 0) > 0
+                and counts.get(
+                    "wql_cluster_shard_1_e2e_seconds_count", 0) > 0
+                and counts.get("wql_cluster_xshard_seconds_count", 0) > 0
+                and "wql_deliveries_per_s_per_core" in counts
+            ):
+                return counts
+            return None
+
+        # the router's HTTP runs on THIS loop — every fetch must go
+        # off-thread (the existing healthz idiom)
+        fed_counts = None
+        fed_deadline = time.monotonic() + 30
+        while time.monotonic() < fed_deadline:
+            fed_counts = await asyncio.to_thread(_maybe, federated_series)
+            if fed_counts:
+                break
+            await asyncio.sleep(0.5)
+        assert fed_counts, (
+            "per-shard + aggregate cluster.e2e_ms series never "
+            "advanced in the router's federated /metrics"
+        )
+        assert (
+            fed_counts["wql_cluster_e2e_seconds_count"]
+            >= fed_counts["wql_cluster_shard_0_e2e_seconds_count"]
+        )
+        before_kill = _monotone_series(
+            await asyncio.to_thread(_http_text, metrics_url)
+        )
+
+        # --- ISSUE 15: /debug/cluster — one Chrome trace, three
+        # processes, a cross-shard frame's router→home→remote chain
+        # sharing ONE trace id --------------------------------------
+        def chain_trace_ids():
+            dump = _http_json(
+                f"http://127.0.0.1:{config.http_port}/debug/cluster"
+            )
+            shards = dump.get("shards", {})
+            if set(shards) != {"0", "1"}:
+                return None
+            router_ids = {
+                s["tags"].get("trace_id")
+                for t in dump["router"]["traces"]
+                for s in t.get("spans", ())
+                if s["name"] == "router.forward"
+            }
+            # home shard (1): the w1 local's recv tree is tagged
+            home_ids = {
+                s["tags"].get("trace_id")
+                for t in shards["1"].get("loose", ())
+                for s in t.get("spans", ())
+                if "trace_id" in (s.get("tags") or {})
+            }
+            # remote shard (0): stitched ring spans under tick traces
+            remote_ids = {
+                s["tags"].get("trace_id")
+                for t in shards["0"].get("ticks", ())
+                for s in t.get("spans", ())
+                if s["name"] in ("router.forward", "cluster.ring_dwell")
+            }
+            chain = (router_ids & home_ids & remote_ids) - {None}
+            return chain or None
+
+        async def drive_and_find_chain():
+            for attempt in range(10):
+                for i in range(6):
+                    # locals in w0 keep shard 0 ticking WITH a batch
+                    # (only traced ticks get the stitched ring spans)…
+                    await a.send(Message(
+                        instruction=Instruction.LOCAL_MESSAGE,
+                        world_name=w0, position=POS,
+                        parameter=f"chainload-{attempt}-{i}",
+                    ))
+                    # …while B's globals in w1 cross the 1→0 ring into
+                    # those ticks — the frames whose chain we assert
+                    await b.send(Message(
+                        instruction=Instruction.GLOBAL_MESSAGE,
+                        world_name=w1,
+                        parameter=f"chainx-{attempt}-{i}",
+                    ))
+                    await asyncio.sleep(0.01)
+                await recv_param(
+                    a, Instruction.GLOBAL_MESSAGE,
+                    f"chainx-{attempt}-5",
+                )
+                await asyncio.sleep(0.3)
+                chain = await asyncio.to_thread(_maybe, chain_trace_ids)
+                if chain:
+                    return chain
+            return None
+
+        chain = await drive_and_find_chain()
+        assert chain, (
+            "no cross-shard frame's trace id chained across router, "
+            "home-shard and remote-shard spans in /debug/cluster"
+        )
+
+        # chrome format: one NAMED pid lane per process
+        chrome = await asyncio.to_thread(
+            _http_json,
+            f"http://127.0.0.1:{config.http_port}"
+            "/debug/cluster?format=chrome",
+        )
+        events = chrome["traceEvents"]
+        lanes = {
+            e["args"]["name"]: e["pid"] for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        assert {"router", "shard-0", "shard-1"} <= set(lanes)
+        assert len(set(lanes.values())) == 3  # three real pids
+        assert any(e.get("ph") == "X" for e in events)
+
         # --- session resume over a LIVE home shard: A hard-drops and
         # resumes by token — no re-subscribe, rows intact on BOTH
         # shards ------------------------------------------------------
@@ -359,6 +536,37 @@ async def _drain_cluster_e2e(tmp_path):
         # cross-shard traffic flows again through the restarted shard
         # (proxy re-adoption replayed by the router)
         await local_roundtrip("post-restart")
+
+        # --- ISSUE 15: federated series stay MONOTONE across the
+        # SIGKILL→restart (the restarted shard re-baselines; merged
+        # counts only ever grow — no counter-reset sawtooth) ---------
+        async def monotone_after_restart():
+            text = await asyncio.to_thread(_http_text, metrics_url)
+            after = _monotone_series(text)
+            for key, value in before_kill.items():
+                if key not in after or after[key] < value:
+                    return None
+            # and the aggregate e2e count moved FORWARD on the
+            # post-restart traffic, through the fresh baseline
+            if (
+                after[("wql_cluster_e2e_seconds_count", "")]
+                <= before_kill[("wql_cluster_e2e_seconds_count", "")]
+            ):
+                return None
+            return after
+
+        mono_deadline = time.monotonic() + 30
+        after_restart = None
+        while time.monotonic() < mono_deadline:
+            after_restart = await monotone_after_restart()
+            if after_restart:
+                break
+            await local_roundtrip(f"mono-{int(time.monotonic()*1e3)}")
+            await asyncio.sleep(0.7)
+        assert after_restart, (
+            "federated cluster.* series regressed (or stalled) across "
+            "the shard SIGKILL→restart"
+        )
 
         # HTTP /global_message injected at the ROUTER reaches wire
         # subscribers — it rides the private control channel, because

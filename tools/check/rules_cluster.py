@@ -106,4 +106,116 @@ BLOCKING_CROSS_SHARD = Rule(
     _check_blocking_cross_shard,
 )
 
-RULES = [BLOCKING_CROSS_SHARD]
+
+# ---------------------------------------------------------------------
+# untraced-forward (rule 20, ISSUE 15): cross-process hops carry the
+# trace context
+# ---------------------------------------------------------------------
+#
+# The cluster frame clock only works if EVERY hop threads the context:
+# the router's forward stamps it as a framed prefix, and the bus's
+# ring writes carry it in the frame header. One forwarding site that
+# drops it silently punches a hole in cluster.e2e_ms and the
+# router→home→remote trace chain — the frame still arrives, so
+# nothing functional fails, which is exactly why a lint rule (not a
+# test) has to guard it. Two scopes:
+#
+# * ``cluster/router.py`` — message-forwarding call sites (the
+#   ``_forward`` helper and any ``send`` on a shard push socket) must
+#   reference a trace-context argument (``ctx``/``trace``/``wrap``
+#   in the argument expressions).
+# * ``cluster/bus.py`` — ring ``try_write`` calls must thread the
+#   context into the frame the same way.
+#
+# Deliberate context-free sends (the router's client-bound refusal
+# hint) carry ``# wql: allow(untraced-forward)``.
+
+_FORWARD_SCOPED = ("cluster/router.py",)
+_RING_SCOPED = ("cluster/bus.py",)
+
+#: identifier fragments that mark an argument as carrying the context
+_CTX_TOKENS = ("ctx", "trace", "wrap")
+
+
+def _mentions_ctx(call: ast.Call) -> bool:
+    for sub in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(sub):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.arg):
+                name = node.arg
+            if name is not None and any(
+                tok in name.lower() for tok in _CTX_TOKENS
+            ):
+                return True
+    return False
+
+
+def _chain_mentions(node: ast.AST, token: str) -> bool:
+    """True when any Name/Attribute in the (possibly subscripted)
+    receiver chain contains ``token`` — ``self._push[shard].send``
+    has no plain dotted name, but its chain mentions "push"."""
+    for sub in ast.walk(node):
+        name = (
+            sub.id if isinstance(sub, ast.Name)
+            else sub.attr if isinstance(sub, ast.Attribute) else None
+        )
+        if name is not None and token in name.lower():
+            return True
+    return False
+
+
+def _check_untraced_forward(ctx: FileContext) -> Iterator[Violation]:
+    router_scope = ctx.relpath.endswith(_FORWARD_SCOPED)
+    ring_scope = ctx.relpath.endswith(_RING_SCOPED)
+    if not (router_scope or ring_scope):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        leaf = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else None
+        )
+        if leaf is None:
+            continue
+        if router_scope:
+            is_forward = leaf == "_forward"
+            is_push_send = (
+                leaf == "send"
+                and isinstance(func, ast.Attribute)
+                and _chain_mentions(func.value, "push")
+            )
+            if (is_forward or is_push_send) and not _mentions_ctx(node):
+                yield from ctx.flag(
+                    UNTRACED_FORWARD, node,
+                    f"`{leaf}(...)` forwards a message to a shard "
+                    "without threading the trace context — the frame "
+                    "clock (cluster.e2e_ms) and the router→home→remote "
+                    "trace chain silently lose this hop; pass the "
+                    "(trace_id, t_ingress) ctx / tracectx.wrap the "
+                    "payload",
+                )
+        if ring_scope and leaf == "try_write" and not _mentions_ctx(node):
+            yield from ctx.flag(
+                UNTRACED_FORWARD, node,
+                "ring `try_write(...)` in the inter-shard bus without "
+                "the trace context in the frame header — the remote "
+                "shard can no longer close the router-ingress clock "
+                "or stitch this frame; pack the ctx into the frame",
+            )
+
+
+UNTRACED_FORWARD = Rule(
+    "untraced-forward",
+    "router forwards and inter-shard ring writes must thread the "
+    "cluster trace context — an untraced hop silently punches a hole "
+    "in cluster.e2e_ms and the cross-process trace chain",
+    _check_untraced_forward,
+)
+
+RULES = [BLOCKING_CROSS_SHARD, UNTRACED_FORWARD]

@@ -157,6 +157,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "<slow-tick-dir>/slow-ticks.jsonl, CRITICAL "
                         "log); 0 dumps every tick; implies --trace "
                         "(default: no dumping)")
+    p.add_argument("--slow-frame-ms", type=float, dest="slow_frame_ms",
+                   help="cluster shards: auto-dump any cross-shard "
+                        "frame whose router-ingress→socket-write wall "
+                        "exceeds this many ms (stitched stage chain to "
+                        "<slow-tick-dir>/slow-frames.jsonl, CRITICAL "
+                        "log); 0 dumps every frame (default: no "
+                        "dumping)")
     p.add_argument("--flight-recorder-depth", type=int,
                    dest="flight_recorder_depth",
                    help="tick traces kept in the flight-recorder ring "
@@ -282,7 +289,8 @@ _OVERRIDES = [
     "checkpoint_interval", "delivery_workers", "delivery_ring_bytes",
     "failpoints", "failpoints_seed", "resilience", "failover_after",
     "supervisor_budget", "supervisor_backoff",
-    "slow_tick_ms", "flight_recorder_depth", "slow_tick_dir",
+    "slow_tick_ms", "slow_frame_ms", "flight_recorder_depth",
+    "slow_tick_dir",
     "entity_k", "entity_bounds", "entity_max",
     "max_batch", "overload", "overload_tick_budget_ms",
     "overload_deadline_k", "overload_recover_ticks",

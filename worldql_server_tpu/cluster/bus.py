@@ -9,12 +9,19 @@ carries a full N×(N−1) mesh of lock-free byte conduits with no broker
 in the middle.
 
 Bus records are delivery frames for peers homed on the consuming
-shard: ``[16-byte target uuid][wire bytes]`` in the ring's frame slot
-(the slot list stays empty — slot ids are a delivery-plane concept;
-here the target is a wire-level uuid). The ring's two monotonic-ns
-stamps ride along unchanged: ``t_ingress`` is the SENDING shard's tick
-frame clock, so the consuming shard can close an honest cross-shard
-dispatch→drain latency (``cluster.xshard_ms``).
+shard: ``[u64 trace_id][u64 t_router_ingress_ns][16-byte target uuid]
+[wire bytes]`` in the ring's frame slot (the slot list stays empty —
+slot ids are a delivery-plane concept; here the target is a wire-level
+uuid). The leading 16 bytes are the CLUSTER TRACE CONTEXT
+(cluster/tracectx.py — zeros when the frame was never router-stamped),
+carried INSIDE the frame so the delivery ring's own record layout is
+untouched. The ring's two monotonic-ns stamps ride along unchanged:
+``t_ingress`` is the SENDING shard's enqueue clock and
+``t_ring_write`` the ring's own write stamp, so the consuming shard
+closes two honest clocks at socket-write-complete:
+``cluster.xshard_ms`` (home-shard-enqueue→remote-shard-write) and —
+when the context is present — ``cluster.e2e_ms``
+(router-ingress→remote-shard-write).
 
 The cardinal rule (enforced by the ``blocking-cross-shard`` lint
 rule): tick-path code never awaits an inter-shard ROUND TRIP. Sends
@@ -33,6 +40,7 @@ to a torn conduit.
 from __future__ import annotations
 
 import logging
+import struct
 import uuid as uuid_mod
 
 from ..delivery.ring import Ring
@@ -40,6 +48,11 @@ from ..delivery.ring import Ring
 logger = logging.getLogger(__name__)
 
 UUID_LEN = 16
+
+#: per-frame cluster trace context: [u64 trace_id][u64 t_router_ingress]
+_CTX = struct.Struct("<QQ")
+CTX_LEN = _CTX.size
+HEADER_LEN = CTX_LEN + UUID_LEN
 
 
 class InterShardBus:
@@ -84,19 +97,25 @@ class InterShardBus:
 
     def send_frame(
         self, target_shard: int, peer: uuid_mod.UUID, data: bytes,
-        t_ingress_ns: int = 0,
+        t_ingress_ns: int = 0, ctx: tuple | None = None,
     ) -> bool:
         """Enqueue one delivery frame toward ``peer``'s home shard.
         Fire-and-forget: a full ring (peer shard down or drowning)
         DROPS the frame — counted, never blocking the caller's tick.
         Record ops never ride this path (they route to the owner shard
         at the router), so a bus drop can only cost pub/sub frames,
-        exactly like the delivery plane's ring_full_drops."""
+        exactly like the delivery plane's ring_full_drops. ``ctx`` is
+        the frame's cluster trace context ``(trace_id,
+        t_router_ingress_ns)`` — it rides the frame header so the
+        remote shard closes the router-ingress clock and stitches the
+        frame into its tick trace; None writes a zeroed header."""
         ring = self._tx.get(target_shard)
         if ring is None:
             self.dropped += 1
             return False
-        if ring.try_write(peer.bytes + data, b"", t_ingress_ns):
+        trace_id, t_ctx = ctx if ctx is not None else (0, 0)
+        ctx_header = _CTX.pack(trace_id, t_ctx) + peer.bytes
+        if ring.try_write(ctx_header + data, b"", t_ingress_ns):
             self.sent += 1
             return True
         self.dropped += 1
@@ -106,7 +125,11 @@ class InterShardBus:
         """Consume up to ``max_records`` inbound frames across all
         peer rings (round-robin by ring, bounded so one chatty peer
         shard cannot monopolize a tick) →
-        ``[(peer_uuid, wire_bytes, t_ingress_ns), ...]``."""
+        ``[(peer_uuid, wire_bytes, t_enqueue_ns, t_ring_write_ns,
+        trace_id, t_router_ingress_ns), ...]`` — the two ring stamps
+        plus the frame-header trace context, everything the consuming
+        shard needs to close both cross-process clocks at
+        socket-write-complete."""
         out: list = []
         budget = max_records
         for ring in self._rx.values():
@@ -114,14 +137,18 @@ class InterShardBus:
                 rec = ring.read_record()
                 if rec is None:
                     break
-                frame, _slots, t_ingress, _t_write = rec
-                if len(frame) <= UUID_LEN:
+                frame, _slots, t_ingress, t_write = rec
+                if len(frame) <= HEADER_LEN:
                     logger.warning("runt inter-shard record dropped")
                     continue
+                trace_id, t_ctx = _CTX.unpack_from(frame)
                 out.append((
-                    uuid_mod.UUID(bytes=frame[:UUID_LEN]),
-                    frame[UUID_LEN:],
+                    uuid_mod.UUID(bytes=frame[CTX_LEN:HEADER_LEN]),
+                    frame[HEADER_LEN:],
                     t_ingress,
+                    t_write,
+                    trace_id,
+                    t_ctx,
                 ))
                 budget -= 1
         self.drained += len(out)

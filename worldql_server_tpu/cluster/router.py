@@ -24,6 +24,17 @@ shed is counted per class (``cluster.router_shed_*``): offered ==
 forwarded + shed-at-router, and forwarded == admitted + shed-at-shard,
 the exact-accounting invariant bench config 11 gates.
 
+The router is also the cluster's observability front door (ISSUE 15):
+every forward is stamped with a trace context (``tracectx.py`` —
+64-bit trace id + router-ingress monotonic-ns clock the shards close
+at socket-write-complete), shard telemetry folds restart-monotone into
+ONE federated ``/metrics`` (``federation.py``: per-shard
+``cluster.shard.<i>.*`` series + cluster aggregates + the live
+``deliveries_per_s_per_core`` gauge), ``/healthz`` carries per-shard
+telemetry freshness, and ``GET /debug/cluster`` splices every
+process's flight-recorder snapshot into one Chrome trace with named
+pid lanes.
+
 ``ClusterRuntime`` composes the router with the shard-process
 supervisor — ``python -m worldql_server_tpu --cluster-shards N`` boots
 it; scenarios, bench config 11 and the e2e suite embed it.
@@ -32,7 +43,9 @@ it; scenarios, bench config 11 and the e2e suite embed it.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
 import random
 import time
 import uuid as uuid_mod
@@ -41,6 +54,7 @@ import zmq
 import zmq.asyncio
 
 from ..engine.metrics import Metrics
+from ..observability import FlightRecorder, Tracer
 from ..protocol import (
     DeserializeError,
     Instruction,
@@ -49,6 +63,8 @@ from ..protocol import (
     serialize_message,
 )
 from ..utils.names import GLOBAL_WORLD  # noqa: F401  (routing contract doc)
+from . import tracectx
+from .federation import MetricsFederation
 from .supervisor import ClusterSupervisor, shard_zmq_port
 from .world_map import WorldMap
 
@@ -113,7 +129,33 @@ class ClusterRouter:
         self._jitter = random.Random()
         self.forwarded = 0
         self._refusals: set[asyncio.Task] = set()
+        # Cluster observability (ISSUE 15): trace ids minted per
+        # inbound message ride every forward as a framed prefix; with
+        # tracing on the forwards also record router.forward spans
+        # into this process's own flight recorder (loose ring — the
+        # router has no tick clock), served at /debug/cluster.
+        self._trace_rng = random.Random()
+        self.tracer = Tracer(enabled=config.trace_enabled)
+        self.recorder = None
+        if config.trace_enabled:
+            self.recorder = FlightRecorder(
+                depth=config.flight_recorder_depth,
+                metrics=self.metrics,
+            )
+            self.tracer.on_trace = self.recorder.record
+        # metrics federation: shard state packets fold into THIS
+        # registry (aggregates + cluster.shard.<i>.* series), so the
+        # router's /metrics is the one scrape for the whole fleet
+        self.federation = MetricsFederation(self.metrics, self.n_shards)
+        #: in-flight /debug/cluster dump collections: req_id → slot
+        self._dump_reqs: dict[int, dict] = {}
+        self._dump_seq = 0
         self.metrics.gauge("cluster", self.status)
+        self.metrics.gauge("cluster_federation", self.federation.stats)
+        self.metrics.gauge(
+            "deliveries_per_s_per_core",
+            self.federation.deliveries_per_s_per_core,
+        )
 
     # region: lifecycle
 
@@ -173,6 +215,9 @@ class ClusterRouter:
         op = msg.get("op")
         if op == "state":
             self.mirror.note_state(shard, msg)
+            self.federation.ingest(shard, msg)
+        elif op == "dump_chunk":
+            self._note_dump_chunk(msg)
         elif op == "peer_gone":
             try:
                 peer = uuid_mod.UUID(hex=msg["uuid"])
@@ -190,6 +235,10 @@ class ClusterRouter:
         living peer homed elsewhere, so its fan-out reaches the whole
         cluster from its first tick."""
         self.mirror.reset(shard)
+        # restart-monotone federation: the fresh shard's cumulatives
+        # re-baseline from zero, so merged series only ever grow
+        self.federation.reset(shard)
+        self.federation.note_pid(shard, self.supervisor.shard_pid(shard))
         for peer, home in self._peers.items():
             if home != shard:
                 self.supervisor.ctl_send(
@@ -237,6 +286,10 @@ class ClusterRouter:
                 )
 
     def _route(self, data: bytes) -> None:
+        # the frame clock opens at ROUTER ingress — every shard-side
+        # close (home delivery, remote ring drain) measures the same
+        # router-ingress→socket-write window, cluster.e2e_ms
+        t_ingress_ns = time.monotonic_ns()
         try:
             message = deserialize_message(data)
         except DeserializeError:
@@ -256,8 +309,18 @@ class ClusterRouter:
             return
         if instruction == Instruction.HANDSHAKE:
             self._note_handshake(message.sender_uuid, shard)
-        self._forward(shard, message.wire if message.wire is not None
-                      else data)
+        ctx = (tracectx.new_trace_id(self._trace_rng), t_ingress_ns)
+        payload = message.wire if message.wire is not None else data
+        if self.tracer.enabled:
+            with self.tracer.span(
+                "router.forward",
+                trace_id=tracectx.trace_id_hex(ctx[0]),
+                shard=shard,
+                instruction=instruction.name,
+            ):
+                self._forward(shard, payload, ctx)
+        else:
+            self._forward(shard, payload, ctx)
 
     def _admit(self, message: Message, instruction, shard: int) -> bool:
         """The shed mirror: REJECT a drowning shard's sheddable load at
@@ -290,12 +353,17 @@ class ClusterRouter:
             return False
         return True
 
-    def _forward(self, shard: int, data: bytes) -> None:
-        """Non-blocking forward. A full push queue (shard mid-restart
-        past the 100K backlog) drops + counts — the router's recv loop
-        must never wedge on one dead shard while the others serve."""
+    def _forward(self, shard: int, data: bytes, ctx: tuple) -> None:
+        """Non-blocking forward, trace context framed on (``ctx`` is
+        ``(trace_id, t_ingress_ns)`` — the ``untraced-forward`` lint
+        rule keeps every forwarding site threading it). A full push
+        queue (shard mid-restart past the 100K backlog) drops +
+        counts — the router's recv loop must never wedge on one dead
+        shard while the others serve."""
         try:
-            self._push[shard].send(data, flags=zmq.NOBLOCK)
+            self._push[shard].send(
+                tracectx.wrap(data, ctx[0], ctx[1]), flags=zmq.NOBLOCK
+            )
             self.forwarded += 1
             self.metrics.inc("cluster.router_forwarded")
         except zmq.Again:
@@ -337,7 +405,7 @@ class ClusterRouter:
         push.setsockopt(zmq.LINGER, 200)
         try:
             push.connect(f"tcp://{parameter}")
-            await push.send(serialize_message(Message(
+            await push.send(serialize_message(Message(  # wql: allow(untraced-forward) — client-bound refusal hint, not a shard forward
                 instruction=Instruction.HANDSHAKE,
                 parameter=f"retry-after:{retry_ms}",
             )))
@@ -355,17 +423,35 @@ class ClusterRouter:
         """The ``cluster`` gauge + the /healthz aggregation body."""
         now = time.monotonic()
         shard_states = {}
+        stale = 0
         for i in range(self.n_shards):
             state = self.supervisor.shard_state(i)
+            slot = self.supervisor._shards[i]
+            alive = self.supervisor.shard_alive(i)
+            age = self.federation.telemetry_age_s(i)
+            # telemetry freshness (the PR 7 stats_stale idiom): a
+            # wedged-but-alive shard whose metrics exports went silent
+            # must not look healthy. A shard that never reported this
+            # incarnation counts from its boot.
+            is_stale = alive and self.federation.telemetry_stale(
+                i,
+                alive_for_s=(now - slot.born) if slot.born else None,
+            )
+            if is_stale:
+                stale += 1
             shard_states[str(i)] = {
-                "alive": self.supervisor.shard_alive(i),
+                "alive": alive,
                 "level": self.mirror.level(i),
                 "state": state.get("state", "unknown"),
                 "peers": state.get("peers", 0),
                 "state_age_s": (
-                    round(now - self.supervisor._shards[i].state_at, 2)
-                    if self.supervisor._shards[i].state_at else None
+                    round(now - slot.state_at, 2)
+                    if slot.state_at else None
                 ),
+                "telemetry_age_s": (
+                    round(age, 3) if age is not None else None
+                ),
+                "telemetry_stale": is_stale,
             }
         return {
             "shards": self.n_shards,
@@ -373,6 +459,7 @@ class ClusterRouter:
             "restarts": self.supervisor.stats()["restarts"],
             "known_peers": len(self._peers),
             "forwarded": self.forwarded,
+            "telemetry_stale": stale,
             "shard_states": shard_states,
         }
 
@@ -382,6 +469,7 @@ class ClusterRouter:
         app = web.Application()
         app.router.add_get("/healthz", self._get_healthz)
         app.router.add_get("/metrics", self._get_metrics)
+        app.router.add_get("/debug/cluster", self._get_debug_cluster)
         app.router.add_post("/global_message", self._post_global_message)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -393,10 +481,15 @@ class ClusterRouter:
     async def _get_healthz(self, request):
         from aiohttp import web
 
-        body = {"status": "ok", "role": "router", "cluster": self.status()}
-        if self.supervisor.alive_count() < self.n_shards or any(
-            self.mirror.level(i) >= _SHED_HIGH
-            for i in range(self.n_shards)
+        cluster = self.status()
+        body = {"status": "ok", "role": "router", "cluster": cluster}
+        if (
+            self.supervisor.alive_count() < self.n_shards
+            or cluster["telemetry_stale"]
+            or any(
+                self.mirror.level(i) >= _SHED_HIGH
+                for i in range(self.n_shards)
+            )
         ):
             body["status"] = "degraded"
         return web.json_response(body)
@@ -410,6 +503,100 @@ class ClusterRouter:
             text=self.metrics.render_prometheus(),
             content_type="text/plain", charset="utf-8",
         )
+
+    # region: cluster flight recorder (GET /debug/cluster)
+
+    def _note_dump_chunk(self, msg: dict) -> None:
+        """Control-channel reader hook: reassemble one shard's chunked
+        flight-recorder dump."""
+        slot = self._dump_reqs.get(msg.get("req_id"))
+        if slot is None:
+            return  # late chunk for a timed-out request — dropped
+        try:
+            slot["parts"][int(msg["seq"])] = str(msg.get("data", ""))
+            slot["n"] = int(msg["n"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if len(slot["parts"]) >= slot["n"]:
+            slot["event"].set()
+
+    async def collect_shard_dump(
+        self, shard: int, timeout: float = 8.0
+    ) -> dict | None:
+        """Pull one shard's flight-recorder snapshot over the control
+        channel (request → chunked response). None on a dead shard or
+        a timeout — the cluster dump degrades to the processes that
+        answered, never errors."""
+        if not self.supervisor.shard_alive(shard):
+            return None
+        self._dump_seq += 1
+        req_id = self._dump_seq
+        slot = {"parts": {}, "n": 1 << 30, "event": asyncio.Event()}
+        self._dump_reqs[req_id] = slot
+        try:
+            if not self.supervisor.ctl_send(
+                shard, {"op": "dump", "req_id": req_id}
+            ):
+                return None
+            try:
+                await asyncio.wait_for(slot["event"].wait(), timeout)
+            except asyncio.TimeoutError:
+                logger.warning(
+                    "shard %d flight-recorder dump timed out", shard
+                )
+                return None
+            blob = "".join(
+                slot["parts"][i] for i in range(slot["n"])
+            )
+            return json.loads(blob)
+        except Exception:
+            logger.exception("shard %d dump collection failed", shard)
+            return None
+        finally:
+            self._dump_reqs.pop(req_id, None)
+
+    async def _get_debug_cluster(self, request):
+        """ONE flight recorder for the fleet: every shard's snapshot
+        pulled over the control channel and spliced with the router's
+        own spans. ``?format=chrome`` renders Trace Event Format with
+        one NAMED pid lane per process (router / shard-N), so a
+        cross-shard frame's router→home→remote chain reads off one
+        timeline — the spans share its trace id."""
+        from aiohttp import web
+
+        dumps = await asyncio.gather(
+            *(self.collect_shard_dump(i) for i in range(self.n_shards))
+        )
+        own: list[dict] = []
+        if self.recorder is not None:
+            own = self.recorder.snapshot() + self.recorder.loose_snapshot()
+        if request.query.get("format") == "chrome":
+            from ..observability.export import chrome_trace
+
+            events = chrome_trace(
+                own, pid=os.getpid(), process_name="router"
+            )["traceEvents"]
+            for i, dump in enumerate(dumps):
+                if not dump:
+                    continue
+                events.extend(chrome_trace(
+                    list(dump.get("ticks") or [])
+                    + list(dump.get("loose") or []),
+                    pid=int(dump.get("pid") or (1_000_000 + i)),
+                    process_name=f"shard-{i}",
+                )["traceEvents"])
+            return web.json_response(
+                {"traceEvents": events, "displayTimeUnit": "ms"}
+            )
+        return web.json_response({
+            "router": {"pid": os.getpid(), "traces": own},
+            "shards": {
+                str(i): dump for i, dump in enumerate(dumps)
+                if dump is not None
+            },
+        })
+
+    # endregion
 
     async def _post_global_message(self, request):
         from aiohttp import web

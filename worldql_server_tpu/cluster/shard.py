@@ -34,6 +34,29 @@ topology. It adds exactly three things:
   the router reaps its proxies cluster-wide. Control-channel EOF means
   the router died: the shard requests its own clean shutdown rather
   than serving unreachable.
+
+Cluster observability (ISSUE 15) rides the same three surfaces:
+
+* Every router-forwarded message carries a trace context
+  (``tracectx.py``: 64-bit trace id + router-ingress monotonic-ns
+  stamp). The shard closes that clock at socket-write-complete —
+  locally delivered frames through the ticker's post-delivery
+  :meth:`close_frames`, ring-drained frames inside :meth:`drain` —
+  into the live ``cluster.e2e_ms`` histogram, and closes
+  ``cluster.xshard_ms`` (home-shard-enqueue → remote-shard-write)
+  for every drained frame. A frame slower than ``--slow-frame-ms``
+  auto-dumps its stitched router→home→remote stage chain as one JSON
+  line (the PR 5 slow-tick discipline, per cross-shard frame).
+* ``state`` packets piggyback cumulative histogram/counter snapshots
+  (``Metrics.export_histograms``); the router diffs consecutive
+  packets and merges them restart-monotone into ONE federated
+  /metrics (cluster/federation.py).
+* A control ``dump`` request chunks the shard's FlightRecorder
+  snapshot back to the router, which splices every process's spans
+  into one Chrome trace at ``GET /debug/cluster``. Drained-frame
+  segments are stitched as ``router.forward`` / ``cluster.ring_dwell``
+  spans under the receiving shard's tick trace at export time (the
+  PR 7 delivery-plane stitcher idiom).
 """
 
 from __future__ import annotations
@@ -45,8 +68,11 @@ import os
 import socket
 import time
 import uuid as uuid_mod
+from collections import deque
 
 from ..engine.peers import Peer
+from ..robustness import failpoints
+from . import tracectx
 from .bus import InterShardBus
 from .world_map import WorldMap
 
@@ -63,6 +89,28 @@ DRAIN_MAX = 4096
 #: heartbeat so the router can age out a wedged shard's state
 STATE_INTERVAL_S = 1.0
 STATE_POLL_S = 0.1
+
+#: histogram series piggybacked on state packets for the router-side
+#: metrics federation — bounded by prefix so a packet stays well under
+#: the control channel's 64 KiB datagram read
+FED_HIST_PREFIXES = (
+    "cluster.", "frame.", "tick.", "delivery.", "broadcast.",
+)
+
+#: drained-frame segments retained for flight-recorder stitching and
+#: counter packets kept per state push (the PR 7 ≤128-segment bound)
+SEGMENT_DEPTH = 512
+
+#: slow-frame dumps are the pathological path, but a drain can carry
+#: thousands of frames — bound the per-drain dump burst (the rest are
+#: counted, never silent)
+SLOW_FRAME_DUMPS_PER_DRAIN = 8
+
+SLOW_FRAME_FILENAME = "slow-frames.jsonl"
+
+#: control-channel dump chunking: JSON-escaped chunk + envelope must
+#: stay under the supervisor's 64 KiB sock_recv
+DUMP_CHUNK_CHARS = 24_000
 
 
 class _BusFrame:
@@ -102,6 +150,13 @@ class ClusterShardExtension:
         self._last_level_sent: int | None = None
         self._last_state_push = 0.0
         self.xshard_frames = 0
+        #: drained-frame telemetry segments for trace stitching:
+        #: (trace_id, t_router_ingress, t_enqueue, t_ring_write,
+        #: t_read, t_done) — monotonic ns, zeros where unknown
+        self._segments: deque = deque(maxlen=SEGMENT_DEPTH)
+        self.slow_frame_ms = getattr(server.config, "slow_frame_ms", None)
+        self.slow_frames_dumped = 0
+        self.slow_frames_skipped = 0
 
     # region: lifecycle
 
@@ -145,16 +200,19 @@ class ClusterShardExtension:
             # fire-and-forget onto the home shard's ring; a full ring
             # drops (counted) — bounded degradation, never a stalled
             # tick. Returning True keeps deliver_batch off the awaited
-            # slow path: there is nothing more awaiting could do.
+            # slow path: there is nothing more awaiting could do. The
+            # framed payload's trace context rides the frame header so
+            # the REMOTE shard closes the router-ingress clock.
             if not bus.send_frame(_h, _u, framed.payload,
-                                  time.monotonic_ns()):
+                                  time.monotonic_ns(), ctx=framed.ctx):
                 metrics.inc("cluster.ring_full_drops")
             return True
 
         def try_write_many(framed_list, _u=peer_uuid, _h=home) -> bool:
             now = time.monotonic_ns()
             for framed in framed_list:
-                if not bus.send_frame(_h, _u, framed.payload, now):
+                if not bus.send_frame(_h, _u, framed.payload, now,
+                                      ctx=framed.ctx):
                     metrics.inc("cluster.ring_full_drops")
             return True
 
@@ -203,28 +261,88 @@ class ClusterShardExtension:
 
     # endregion
 
+    # region: trace context (the router-stamped frame clock)
+
+    @staticmethod
+    def unwrap(data: bytes) -> tuple[int, int, bytes]:
+        """Strip the router's trace-context prefix (transport hook —
+        the transports never import the cluster package directly)."""
+        return tracectx.unwrap(data)
+
+    def close_frames(self, messages) -> None:
+        """Close the router-ingress clock for locally-delivered frames
+        — called by the ticker AFTER a tick's batched delivery
+        completes (socket-write-complete, the conservative PR 7
+        close). Messages without a context (entity frames, locally
+        injected traffic) cost one attribute read each."""
+        now_ns = time.monotonic_ns()
+        metrics = self.server.metrics
+        for message in messages:
+            ctx = getattr(message, "trace_ctx", None)
+            if ctx is not None and ctx[1]:
+                metrics.observe_ms(
+                    "cluster.e2e_ms", (now_ns - ctx[1]) / 1e6
+                )
+
+    # endregion
+
     # region: drain (the tick's cross-shard leg)
 
     async def drain(self) -> int:
         """Deliver everything queued on the inbound rings to LOCAL
         sockets. Called by the ticker between the local batch's device
         dispatch and collect (the ``cluster.drain`` span), or by the
-        standalone pump on tickerless shards. Returns frames drained."""
+        standalone pump on tickerless shards. Returns frames drained.
+
+        Both cross-process clocks close HERE, after the delivery
+        completes (socket-write-complete): ``cluster.xshard_ms`` from
+        the home shard's enqueue stamp and ``cluster.e2e_ms`` from the
+        router-ingress stamp in the frame's trace context. Per-frame
+        segments feed the flight-recorder stitcher, and a frame whose
+        e2e wall blows ``--slow-frame-ms`` dumps its stitched
+        router→home→remote stage chain as one JSON line."""
+        t0_ns = time.monotonic_ns()
+        # chaos site: a delay stretches the remote leg (ring dwell) —
+        # the slow-frame acceptance drives its dump deterministically
+        await failpoints.afire("cluster.ring_deliver")
         records = self.bus.drain(DRAIN_MAX)
         if not records:
             return 0
-        now_ns = time.monotonic_ns()
+        t_read_ns = time.monotonic_ns()
         metrics = self.server.metrics
-        pairs = []
-        for peer_uuid, data, t_ingress in records:
-            pairs.append((_BusFrame(data), (peer_uuid,)))
-            if t_ingress:
-                metrics.observe_ms(
-                    "cluster.xshard_ms", (now_ns - t_ingress) / 1e6
-                )
+        pairs = [
+            (_BusFrame(data), (peer_uuid,))
+            for peer_uuid, data, _te, _tw, _tid, _tc in records
+        ]
         self.xshard_frames += len(records)
         metrics.inc("cluster.frames_drained", len(records))
         await self.server.peer_map.deliver_batch(pairs)
+        t_done_ns = time.monotonic_ns()
+        tracing = self.server.tracer.enabled
+        slow_ms = self.slow_frame_ms
+        dumps_left = SLOW_FRAME_DUMPS_PER_DRAIN
+        for _peer, _data, t_enqueue, t_write, trace_id, t_ctx in records:
+            if t_enqueue:
+                metrics.observe_ms(
+                    "cluster.xshard_ms", (t_done_ns - t_enqueue) / 1e6
+                )
+            if t_ctx:
+                total_ms = (t_done_ns - t_ctx) / 1e6
+                metrics.observe_ms("cluster.e2e_ms", total_ms)
+                if slow_ms is not None and total_ms >= slow_ms:
+                    if dumps_left > 0:
+                        dumps_left -= 1
+                        self._dump_slow_frame(
+                            trace_id, t_ctx, t_enqueue, t_write,
+                            t_read_ns, t_done_ns, total_ms,
+                        )
+                    else:
+                        self.slow_frames_skipped += 1
+            if tracing:
+                self._segments.append((
+                    trace_id, t_ctx, t_enqueue, t_write, t_read_ns,
+                    t_done_ns,
+                ))
         return len(records)
 
     async def _drain_pump(self) -> None:
@@ -232,6 +350,136 @@ class ClusterShardExtension:
         while True:
             await asyncio.sleep(interval)
             await self.drain()
+
+    def _frame_stages(
+        self, t_ctx: int, t_enqueue: int, t_write: int, t_read: int,
+        t_done: int,
+    ) -> dict[str, float]:
+        """One cross-shard frame's wall, attributed to named stages:
+        ``router.forward`` (router ingress → home-shard ring enqueue —
+        the forward hop plus the home shard's decode/queue/resolve),
+        ``cluster.ring_dwell`` (ring write → remote drain read) and
+        ``cluster.deliver`` (drain read → socket-write-complete). The
+        only unattributed sliver is the enqueue→ring-write gap, a few
+        µs of struct packing — ≥90% attribution by construction."""
+        stages = {}
+        if t_ctx and t_enqueue:
+            stages["router.forward"] = (t_enqueue - t_ctx) / 1e6
+        if t_write:
+            stages["cluster.ring_dwell"] = (t_read - t_write) / 1e6
+        stages["cluster.deliver"] = (t_done - t_read) / 1e6
+        return stages
+
+    def _dump_slow_frame(
+        self, trace_id: int, t_ctx: int, t_enqueue: int, t_write: int,
+        t_read: int, t_done: int, total_ms: float,
+    ) -> None:
+        """The PR 5 slow-tick auto-dump, per cross-shard frame: one
+        JSON line with the stitched stage chain + a CRITICAL log."""
+        self.slow_frames_dumped += 1
+        metrics = self.server.metrics
+        metrics.inc("cluster.slow_frame_dumps")
+        stages = self._frame_stages(
+            t_ctx, t_enqueue, t_write, t_read, t_done
+        )
+        record = {
+            "dumped_at_unix_s": round(time.time(), 6),
+            "slow_frame_ms_threshold": self.slow_frame_ms,
+            "shard": self.shard_id,
+            "trace_id": tracectx.trace_id_hex(trace_id),
+            "total_ms": round(total_ms, 3),
+            "stages": {k: round(v, 3) for k, v in stages.items()},
+        }
+        dump_dir = self.server.config.slow_tick_dir
+        path = os.path.join(dump_dir, SLOW_FRAME_FILENAME)
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+            where = path
+        except Exception:
+            logger.exception("slow-frame dump write failed")
+            where = "<dump write failed>"
+        attributed = sum(stages.values())
+        logger.critical(
+            "SLOW CLUSTER FRAME: %.1f ms (threshold %.1f ms) trace %s — "
+            "stages %s attribute %.1f ms (%.0f%%); dumped to %s",
+            total_ms, self.slow_frame_ms, record["trace_id"],
+            {k: round(v, 1) for k, v in sorted(stages.items())},
+            attributed,
+            100.0 * attributed / total_ms if total_ms else 0.0,
+            where,
+        )
+
+    # endregion
+
+    # region: trace stitching (flight-recorder export hook)
+
+    def chain_stitcher(self, prev):
+        """Compose this extension's stitcher with whatever the
+        recorder already has (the delivery plane claims the slot when
+        ``--delivery-workers`` > 0)."""
+        if prev is None:
+            return self.stitch
+
+        def chained(trace):
+            out = list(prev(trace) or [])
+            out.extend(self.stitch(trace) or [])
+            return out
+
+        return chained
+
+    def stitch(self, trace) -> list[dict]:
+        """Graft ``router.forward`` + ``cluster.ring_dwell`` spans for
+        every drained frame whose read stamp falls inside this tick's
+        ``cluster.drain`` window — the cross-shard legs of the frame,
+        reconstructed from the trace-context and ring stamps (all
+        CLOCK_MONOTONIC on one host, the PR 7 stitching precedent).
+        Bounded per trace; a miss degrades to local spans only."""
+        with trace._lock:
+            drains = [s for s in trace.spans if s.name == "cluster.drain"]
+        if not drains or not self._segments:
+            return []
+        out: list[dict] = []
+        base = trace.perf_start
+        for ds in drains:
+            w0 = ds.t0 - 1e-4
+            w1 = ds.t0 + ds.dur_ms / 1e3 + 1e-4
+            for (trace_id, t_ctx, t_enqueue, t_write, t_read,
+                 t_done) in self._segments:
+                t_read_s = t_read / 1e9
+                if not (w0 <= t_read_s <= w1):
+                    continue
+                tid_hex = tracectx.trace_id_hex(trace_id)
+                if t_ctx and t_enqueue:
+                    out.append({
+                        # negative ids offset past the delivery plane's
+                        # synthetic range: stitched spans never collide
+                        # with the trace's own positive ids
+                        "id": -(1000 + len(out) + 1),
+                        "parent": ds.id,
+                        "name": "router.forward",
+                        "t0_ms": round((t_ctx / 1e9 - base) * 1e3, 3),
+                        "dur_ms": round((t_enqueue - t_ctx) / 1e6, 3),
+                        "tags": {"trace_id": tid_hex},
+                        "thread": "cluster",
+                    })
+                if t_write:
+                    out.append({
+                        "id": -(1000 + len(out) + 1),
+                        "parent": ds.id,
+                        "name": "cluster.ring_dwell",
+                        "t0_ms": round((t_write / 1e9 - base) * 1e3, 3),
+                        "dur_ms": round((t_read - t_write) / 1e6, 3),
+                        "tags": {
+                            "trace_id": tid_hex,
+                            "deliver_ms": round((t_done - t_read) / 1e6, 3),
+                        },
+                        "thread": "cluster",
+                    })
+                if len(out) >= 64:
+                    return out
+        return out
 
     # endregion
 
@@ -250,7 +498,8 @@ class ClusterShardExtension:
 
     def _state_packet(self) -> dict:
         gov = self.server.governor
-        counters = self.server.metrics.snapshot()["counters"]
+        metrics = self.server.metrics
+        counters = metrics.snapshot()["counters"]
         packet = {
             "op": "state",
             "shard": self.shard_id,
@@ -261,8 +510,13 @@ class ClusterShardExtension:
             "counters": {
                 k: v for k, v in counters.items()
                 if k.startswith(("messages.", "overload.", "tick.",
-                                 "cluster."))
+                                 "cluster.", "broadcast."))
             },
+            # cumulative histogram snapshots for the router's metrics
+            # federation — diffed packet-to-packet into merge_histogram
+            # deltas there, so the federated series stay monotone
+            # across shard restarts (a fresh shard re-baselines)
+            "hist": metrics.export_histograms(FED_HIST_PREFIXES),
         }
         if gov is not None:
             packet.update(gov.export_state())
@@ -277,6 +531,13 @@ class ClusterShardExtension:
             level == self._last_level_sent
             and now - self._last_state_push < STATE_INTERVAL_S
         ):
+            return
+        try:
+            # chaos site: an armed error silences this shard's
+            # telemetry exports while the process stays alive — the
+            # router's telemetry_stale freshness probe must see it
+            failpoints.fire("cluster.state_push")
+        except failpoints.FailpointError:
             return
         if self._ctl_send(self._state_packet()):
             self._last_level_sent = level
@@ -318,6 +579,10 @@ class ClusterShardExtension:
             )
         elif op == "drop":
             self.drop_remote(uuid_mod.UUID(hex=msg["uuid"]))
+        elif op == "dump":
+            # router-side GET /debug/cluster: chunk this shard's
+            # flight-recorder snapshot back over the control channel
+            await self._send_dump(int(msg.get("req_id", 0)))
         elif op == "inject":
             # router-side HTTP /global_message: a trusted in-process
             # injection stretched across the process boundary — the
@@ -335,6 +600,48 @@ class ClusterShardExtension:
                 return
             await self.server.router.handle_message(message)
 
+    async def _send_dump(self, req_id: int) -> None:
+        """Chunk the flight-recorder snapshot to the router (the
+        control channel's 64 KiB datagrams can't carry a whole
+        Chrome-trace worth of spans in one packet). Tracing off sends
+        an empty-but-well-formed dump so the router never times out on
+        a healthy shard."""
+        recorder = getattr(self.server, "recorder", None)
+        payload = {
+            "shard": self.shard_id,
+            "pid": os.getpid(),
+            "ticks": recorder.snapshot() if recorder is not None else [],
+            "loose": (
+                recorder.loose_snapshot() if recorder is not None else []
+            ),
+        }
+        try:
+            blob = json.dumps(payload)
+        except (TypeError, ValueError):
+            logger.exception("flight-recorder dump not serializable")
+            blob = json.dumps({
+                "shard": self.shard_id, "pid": os.getpid(),
+                "ticks": [], "loose": [],
+            })
+        chunks = [
+            blob[i:i + DUMP_CHUNK_CHARS]
+            for i in range(0, len(blob), DUMP_CHUNK_CHARS)
+        ] or [""]
+        for seq, chunk in enumerate(chunks):
+            packet = {
+                "op": "dump_chunk", "req_id": req_id, "seq": seq,
+                "n": len(chunks), "data": chunk,
+            }
+            deadline = time.monotonic() + 2.0
+            while not self._ctl_send(packet):
+                if time.monotonic() >= deadline:
+                    logger.warning(
+                        "dump chunk %d/%d to router timed out",
+                        seq + 1, len(chunks),
+                    )
+                    return
+                await asyncio.sleep(0.01)
+
     # endregion
 
     def stats(self) -> dict:
@@ -343,5 +650,7 @@ class ClusterShardExtension:
             "n_shards": self.n_shards,
             "remote_peers": len(self._remote),
             "xshard_frames": self.xshard_frames,
+            "slow_frames_dumped": self.slow_frames_dumped,
+            "slow_frames_skipped": self.slow_frames_skipped,
             **self.bus.stats(),
         }

@@ -147,6 +147,9 @@ def shard_argv(config, shard_id: int) -> list[str]:
         argv.append("--trace")
     if config.slow_tick_ms is not None:
         argv += ["--slow-tick-ms", str(config.slow_tick_ms)]
+    if config.slow_frame_ms is not None:
+        argv += ["--slow-frame-ms", str(config.slow_frame_ms)]
+    if config.slow_tick_ms is not None or config.slow_frame_ms is not None:
         argv += ["--slow-tick-dir",
                  os.path.join(config.slow_tick_dir, f"shard-{shard_id}")]
     if config.index_snapshot:
@@ -437,6 +440,12 @@ class ClusterSupervisor:
 
     def shard_alive(self, idx: int) -> bool:
         return self._shards[idx].alive
+
+    def shard_pid(self, idx: int) -> int | None:
+        """The current incarnation's pid (None before first boot) —
+        the federation's /proc CPU accounting reads it."""
+        proc = self._shards[idx].proc
+        return proc.pid if proc is not None else None
 
     def alive_count(self) -> int:
         return sum(1 for s in self._shards if s.alive)

@@ -243,6 +243,20 @@ class Config:
             if os.environ.get("WQL_SLOW_TICK_MS") else None
         )
     )
+    # Cluster slow-frame auto-dump (cluster/shard.py, ISSUE 15): a
+    # cross-shard frame whose router-ingress→socket-write wall exceeds
+    # this many ms dumps its stitched router→home→remote stage chain
+    # as one JSON line to <slow_tick_dir>/slow-frames.jsonl with a
+    # CRITICAL log. Only meaningful on cluster shards (forwarded from
+    # the router's config); unset/None disables dumping. Unlike
+    # slow_tick_ms it does NOT imply tracing — the frame clocks are
+    # always live in cluster mode.
+    slow_frame_ms: float | None = field(
+        default_factory=lambda: (
+            float(os.environ["WQL_SLOW_FRAME_MS"])
+            if os.environ.get("WQL_SLOW_FRAME_MS") else None
+        )
+    )
     flight_recorder_depth: int = field(
         default_factory=lambda: int(_env("WQL_FLIGHT_RECORDER_DEPTH", "64"))
     )
@@ -522,6 +536,12 @@ class Config:
             errors.append("flight_recorder_depth must be >= 1")
         if self.slow_tick_ms is not None and not self.slow_tick_dir:
             errors.append("slow_tick_ms requires slow_tick_dir")
+        if self.slow_frame_ms is not None and self.slow_frame_ms < 0:
+            errors.append(
+                "slow_frame_ms must be >= 0 (0 = dump every frame)"
+            )
+        if self.slow_frame_ms is not None and not self.slow_tick_dir:
+            errors.append("slow_frame_ms requires slow_tick_dir")
         if self.failpoints:
             # fail at config time, not at the first armed boundary
             from ..robustness.failpoints import FailpointSpecError, parse_spec

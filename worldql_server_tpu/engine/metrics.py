@@ -163,6 +163,26 @@ class Metrics:
                 hist = self.histograms[name] = Histogram()
             hist.merge_counts(counts, total, sum_ms, max_ms)
 
+    def export_histograms(self, prefixes: tuple[str, ...]) -> dict:
+        """Raw cumulative bucket state of every histogram whose name
+        starts with one of ``prefixes`` — the shard-side half of the
+        cluster metrics federation: snapshots ride the ~1s control
+        state packets and the router diffs consecutive packets into
+        ``merge_histogram`` deltas (the delivery-worker idiom, now
+        process-to-process). Copied under the lock so a concurrent
+        observer can't tear a packet."""
+        with self._lock:
+            return {
+                name: {
+                    "counts": list(hist.counts),
+                    "total": hist.total,
+                    "sum_ms": hist.sum_ms,
+                    "max_ms": hist.max_ms,
+                }
+                for name, hist in self.histograms.items()
+                if name.startswith(prefixes)
+            }
+
     @contextmanager
     def time_ms(self, name: str):
         """Histogram-timed block: ``with metrics.time_ms("x_ms"): ...``

@@ -39,11 +39,17 @@ class FramedPayload:
     times — server→client WS frames are unmasked and therefore
     byte-identical for every recipient."""
 
-    __slots__ = ("payload", "cache")
+    __slots__ = ("payload", "cache", "ctx")
 
     def __init__(self, payload: bytes):
         self.payload = payload
         self.cache: dict[str, bytes] = {}
+        # Cluster trace context (trace_id, t_router_ingress_ns) copied
+        # from Message.trace_ctx at framing time, so a shard's ring
+        # proxy can thread it onto the inter-shard bus and the REMOTE
+        # shard closes the same router-ingress clock at its own socket
+        # write. None everywhere outside a cluster shard.
+        self.ctx: tuple | None = None
 
 
 #: synchronous fast-path writer a transport may attach to its peers:
@@ -251,6 +257,9 @@ class PeerMap:
 
     async def _broadcast(self, message: Message, peers: Iterable[Peer]) -> None:
         framed = FramedPayload(serialize_message(message))
+        ctx = getattr(message, "trace_ctx", None)
+        if ctx is not None:
+            framed.ctx = ctx
         n, errors = 0, 0
         slow: list[Peer] = []
         for p in peers:
@@ -373,6 +382,9 @@ class PeerMap:
             framed = FramedPayload(
                 serialize_message(message) if data is None else data
             )
+            ctx = getattr(message, "trace_ctx", None)
+            if ctx is not None:
+                framed.ctx = ctx
             for u in uuids:
                 p = self._map.get(u)
                 if p is None:

@@ -277,6 +277,14 @@ class WorldQLServer:
             from ..cluster.shard import ClusterShardExtension
 
             self.cluster = ClusterShardExtension(self)
+            if self.recorder is not None:
+                # graft router.forward/cluster.ring_dwell spans for
+                # drained cross-shard frames under the tick trace at
+                # export time — composed with the delivery plane's
+                # stitcher when both are built
+                self.recorder.stitcher = self.cluster.chain_stitcher(
+                    self.recorder.stitcher
+                )
         self.ticker = None
         self.staging = None
         if config.tick_interval > 0:

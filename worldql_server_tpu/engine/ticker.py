@@ -477,6 +477,11 @@ class TickBatcher:
                     except Exception:
                         logger.exception("tick delivery failed")
                         break
+            if self._cluster is not None and pairs:
+                # close the router-ingress clock (cluster.e2e_ms) for
+                # every delivered frame carrying a trace context —
+                # socket-write-complete, the conservative PR 7 close
+                self._cluster.close_frames(m for m, _ in pairs)
             self._account(
                 batch, t0, deliver_ms=(time.perf_counter() - td) * 1e3,
                 trace=trace,
@@ -637,6 +642,10 @@ class TickBatcher:
                 )
                 with trace.span("tick.deliver"):
                     await asyncio.shield(deliver_task)
+                if self._cluster is not None and pairs:
+                    # cluster.e2e_ms close at socket-write-complete
+                    # (see _collect_deliver_inner)
+                    self._cluster.close_frames(m for m, _ in pairs)
             except asyncio.CancelledError:
                 if sim_handle is not None:
                     # un-applied sim tick (cancel landed before or

@@ -23,14 +23,33 @@ import threading
 logger = logging.getLogger(__name__)
 
 
-def chrome_trace(traces: list[dict], pid: int | None = None) -> dict:
-    """Trace Event Format JSON for a list of ``Trace.as_dict()`` dicts."""
+def chrome_trace(
+    traces: list[dict],
+    pid: int | None = None,
+    process_name: str | None = None,
+) -> dict:
+    """Trace Event Format JSON for a list of ``Trace.as_dict()`` dicts.
+
+    ``process_name`` labels the pid lane with a human-readable name
+    (``process_name`` metadata event — "router", "shard-0", …) so a
+    multi-process splice (``GET /debug/cluster``) reads as named
+    process tracks instead of bare pids; thread lanes are named the
+    same way (``thread_name``, e.g. ``delivery-worker-N``)."""
     import os
 
     if pid is None:
         pid = os.getpid()
     events: list[dict] = []
     tids: dict[str, int] = {}
+    if process_name is not None:
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "ts": 0,
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        })
     for trace in traces:
         base_us = trace.get("start_unix_s", 0.0) * 1e6
         for span in trace.get("spans", ()):

@@ -142,6 +142,12 @@ class Message:
     #: skip the encoder entirely. Never set on mutated/constructed
     #: messages; ``with_`` clears it.
     wire: bytes | None = field(default=None, compare=False, repr=False)
+    #: cluster trace context ``(trace_id, t_router_ingress_ns)`` set by
+    #: a shard's transport after stripping the router's framed prefix
+    #: (cluster/tracectx.py); excluded from equality and never
+    #: serialized. None everywhere outside a cluster shard — the
+    #: single-process paths pay one attribute read at most.
+    trace_ctx: tuple | None = field(default=None, compare=False, repr=False)
 
     def with_(self, **kwargs) -> "Message":
         """Copy with replacements (Rust struct-update syntax analog).

@@ -147,8 +147,18 @@ class HttpTransport:
         if request.query.get("format") == "chrome":
             from ..observability.export import chrome_trace
 
+            # named pid lane (satellite of ISSUE 15): a shard's dump
+            # says which shard it is, a standalone server says so too
+            cluster = getattr(self.server, "cluster", None)
+            process_name = (
+                f"shard-{cluster.shard_id}" if cluster is not None
+                else "worldql-server"
+            )
             return web.json_response(
-                chrome_trace(ticks + recorder.loose_snapshot())
+                chrome_trace(
+                    ticks + recorder.loose_snapshot(),
+                    process_name=process_name,
+                )
             )
         return web.json_response({
             "recorder": recorder.stats(),

@@ -206,12 +206,22 @@ class ZmqTransport:
             # recv→decode→route under one span tree: the decode and the
             # router's handle span nest inside "zmq.recv", so a slow
             # inbound message shows WHERE it spent its wall time
-            with tracer.span("zmq.recv", bytes=len(data)):
-                await self._decode_route(data, tracer)
+            with tracer.span("zmq.recv", bytes=len(data)) as rspan:
+                await self._decode_route(data, tracer, rspan)
         else:
             await self._decode_route(data, None)
 
-    async def _decode_route(self, data: bytes, tracer) -> None:
+    async def _decode_route(self, data: bytes, tracer, rspan=None) -> None:
+        # Cluster shards receive every message through the router,
+        # which frames a trace context on (cluster/tracectx.py):
+        # strip it BEFORE the codec (fan-out re-broadcasts the
+        # unwrapped bytes) and thread it onto the Message so delivery
+        # closes the router-ingress clock at socket-write-complete.
+        # Non-cluster servers pay one attribute test.
+        cluster = getattr(self.server, "cluster", None)
+        trace_id = t_ctx = 0
+        if cluster is not None:
+            trace_id, t_ctx, data = cluster.unwrap(data)
         try:
             failpoints.fire("codec.decode")
             if tracer is not None:
@@ -222,6 +232,13 @@ class ZmqTransport:
         except DeserializeError:
             logger.debug("dropping invalid zmq message: deserialize error")
             return
+        if trace_id:
+            message.trace_ctx = (trace_id, t_ctx)
+            if rspan is not None:
+                # the cross-process chain key: this span tree carries
+                # the same trace id the router's forward span and the
+                # remote shard's stitched ring spans carry
+                rspan.tag(trace_id=format(trace_id, "016x"))
 
         if message.sender_uuid in self.server.peer_map:
             if message.instruction != Instruction.HANDSHAKE:
