@@ -175,6 +175,11 @@ class ZmqClient:
         message.sender_uuid = self.uuid
         await self.push.send(serialize_message(message))
 
+    async def send_raw(self, data: bytes) -> None:
+        """Send pre-serialized (possibly router-framed) bytes as-is —
+        lets a test impersonate the cluster router's forward leg."""
+        await self.push.send(data)
+
     async def recv(self, timeout: float = 2.0) -> Message:
         data = await asyncio.wait_for(self.pull.recv(), timeout)
         return deserialize_message(data)

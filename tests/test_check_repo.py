@@ -49,3 +49,26 @@ def test_no_runtime_artifacts_committed():
         f"runtime artifacts committed: {offenders} — delete them and "
         "keep .gitignore covering *.db / wal-*.log"
     )
+
+
+def test_package_is_domain_clean():
+    """The interprocedural tier (rules 21-24) gates the repo too: the
+    whole package goes into ONE call graph and must come back clean —
+    every finding either fixed (plane.py's control-socket retry) or
+    carrying an auditable happens-before pragma (the WAL writer's
+    single-owner handoff)."""
+    import os
+
+    from tools.check.domains import check_program_paths
+
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        violations = check_program_paths(
+            [str(REPO / "worldql_server_tpu")], cache=False,
+        )
+    finally:
+        os.chdir(cwd)
+    assert violations == [], "\n" + "\n".join(
+        v.render() for v in violations
+    )

@@ -1572,3 +1572,499 @@ def test_blocking_cross_shard_honors_pragma_and_scope():
 
 
 # endregion
+
+
+# region: interprocedural rules 21-24 (tools/check/domains)
+
+from tools.check.domains import check_program_sources  # noqa: E402
+
+
+def program_violations(sources, select=None, attr_hints=None):
+    """Multi-file fixture -> [(rule, relpath, line)] through the REAL
+    resolution + domain propagation (check_program_sources)."""
+    out = check_program_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()},
+        select={select} if select else None,
+        attr_hints=attr_hints,
+    )
+    return [(v.rule, v.path, v.line) for v in out]
+
+
+# region: 21 transitive-blocking-on-loop
+
+
+def test_transitive_blocking_one_hop():
+    """The case the per-file rule CANNOT see: the coroutine is clean,
+    the sync helper it calls blocks."""
+    src = """
+    import time
+
+    async def tick():
+        _flush()
+
+    def _flush():
+        time.sleep(0.1)
+    """
+    path = "worldql_server_tpu/engine/mod.py"
+    got = program_violations({path: src},
+                             select="transitive-blocking-on-loop")
+    assert got == [("transitive-blocking-on-loop", path, 8)]
+
+
+def test_transitive_blocking_two_hops_across_files():
+    """Seeded acceptance repro: blocking buried TWO sync calls down,
+    with the second hop in another module (import-resolved)."""
+    a = """
+    from worldql_server_tpu.engine.helpers import flush_segment
+
+    async def on_tick():
+        _drain()
+
+    def _drain():
+        flush_segment()
+    """
+    b = """
+    import os
+
+    def flush_segment():
+        _sync_disk()
+
+    def _sync_disk():
+        os.fsync(3)
+    """
+    got = program_violations(
+        {
+            "worldql_server_tpu/engine/ticker2.py": a,
+            "worldql_server_tpu/engine/helpers.py": b,
+        },
+        select="transitive-blocking-on-loop",
+    )
+    assert got == [(
+        "transitive-blocking-on-loop",
+        "worldql_server_tpu/engine/helpers.py", 8,
+    )]
+
+
+def test_transitive_blocking_resolved_method():
+    """self.attr.method() resolution: the blocking call hides behind a
+    constructor-typed attribute's method."""
+    src = """
+    import subprocess
+
+    class Probe:
+        def run_checks(self):
+            subprocess.run(["true"])
+
+    class Server:
+        def __init__(self):
+            self.probe = Probe()
+
+        async def boot(self):
+            self.probe.run_checks()
+    """
+    path = "worldql_server_tpu/engine/boot.py"
+    got = program_violations({path: src},
+                             select="transitive-blocking-on-loop")
+    assert got == [("transitive-blocking-on-loop", path, 6)]
+
+
+def test_transitive_blocking_quiet_behind_to_thread_hop():
+    """The hop is the fix: the same helper handed to to_thread runs in
+    the thread domain, where blocking is its job."""
+    src = """
+    import asyncio
+    import time
+
+    async def tick():
+        await asyncio.to_thread(_flush)
+
+    def _flush():
+        time.sleep(0.1)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="transitive-blocking-on-loop",
+    )
+    assert got == []
+
+
+def test_transitive_blocking_quiet_without_loop_reachability():
+    """A blocking helper nobody reaches from a coroutine is fine —
+    domain reachability, not a grep for time.sleep."""
+    src = """
+    import time
+
+    def cli_main():
+        _flush()
+
+    def _flush():
+        time.sleep(0.1)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="transitive-blocking-on-loop",
+    )
+    assert got == []
+
+
+def test_transitive_blocking_honors_pragma():
+    src = """
+    import time
+
+    async def tick():
+        _flush()
+
+    def _flush():
+        time.sleep(0.1)  # wql: allow(transitive-blocking-on-loop)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="transitive-blocking-on-loop",
+    )
+    assert got == []
+
+
+# endregion
+
+# region: 22 cross-domain-state
+
+
+def test_cross_domain_state_thread_target_mutates_peer_map():
+    """Seeded acceptance repro: a Thread(target=) worker mutating the
+    loop-owned peer registry."""
+    src = """
+    import threading
+
+    class Plane:
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.peer_map["x"] = 1
+    """
+    path = "worldql_server_tpu/delivery/mod.py"
+    got = program_violations({path: src}, select="cross-domain-state")
+    assert got == [("cross-domain-state", path, 9)]
+
+
+def test_cross_domain_state_two_hop_into_staging():
+    """The mutation happens a call below the thread entry point —
+    propagation, not a lexical check of the target function."""
+    src = """
+    import asyncio
+
+    class Collector:
+        async def kick(self):
+            await asyncio.to_thread(self._collect)
+
+        def _collect(self):
+            self._stage_row()
+
+        def _stage_row(self):
+            self._staged.append(1)
+    """
+    path = "worldql_server_tpu/entities/mod.py"
+    got = program_violations({path: src}, select="cross-domain-state")
+    assert got == [("cross-domain-state", path, 12)]
+
+
+def test_cross_domain_state_peer_map_method_reached_from_thread():
+    """PeerMap's OWN methods count when a thread-domain helper calls
+    them (resolved through the peer_map attr hint) — both the mutating
+    call site and the method body are reported."""
+    peers = """
+    class PeerMap:
+        def rebind(self, key, peer):
+            self._m[key] = peer
+    """
+    user = """
+    import threading
+
+    class Bridge:
+        async def start(self):
+            threading.Thread(target=self._pump).start()
+
+        def _pump(self):
+            self.peer_map.rebind("k", object())
+    """
+    got = program_violations(
+        {
+            "worldql_server_tpu/engine/peers2.py": peers,
+            "worldql_server_tpu/cluster/bridge.py": user,
+        },
+        select="cross-domain-state",
+        attr_hints={"peer_map": "worldql_server_tpu.engine.peers2.PeerMap"},
+    )
+    assert got == [
+        ("cross-domain-state", "worldql_server_tpu/cluster/bridge.py", 9),
+        ("cross-domain-state", "worldql_server_tpu/engine/peers2.py", 4),
+    ]
+
+
+def test_cross_domain_state_quiet_on_loop_and_own_attrs():
+    """Loop-domain mutation of loop-owned state is the CONTRACT, and a
+    worker thread owns its private attrs."""
+    src = """
+    import threading
+
+    class Plane:
+        async def on_peer(self):
+            self.peer_map["x"] = 1
+
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self._scratch = 2
+    """
+    got = program_violations(
+        {"worldql_server_tpu/delivery/mod.py": src},
+        select="cross-domain-state",
+    )
+    assert got == []
+
+
+def test_cross_domain_state_honors_pragma():
+    src = """
+    import threading
+
+    class Plane:
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.peer_map["x"] = 1  # wql: allow(cross-domain-state)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/delivery/mod.py": src},
+        select="cross-domain-state",
+    )
+    assert got == []
+
+
+# endregion
+
+# region: 23 lock-across-await
+
+
+def test_lock_across_await_typed_attr():
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        async def put(self, k, v):
+            with self._lock:
+                await self._persist(k, v)
+    """
+    path = "worldql_server_tpu/storage/mod.py"
+    got = program_violations({path: src}, select="lock-across-await")
+    assert got == [("lock-across-await", path, 9)]
+
+
+def test_lock_across_await_lockish_name():
+    """No constructor in sight: a bare name whose tail says 'lock' is
+    still presumed a thread lock."""
+    src = """
+    async def drain(state_lock, queue):
+        with state_lock:
+            await queue.put(1)
+    """
+    path = "worldql_server_tpu/delivery/mod.py"
+    got = program_violations({path: src}, select="lock-across-await")
+    assert got == [("lock-across-await", path, 3)]
+
+
+def test_lock_across_await_quiet_for_asyncio_lock():
+    """asyncio.Lock is loop-native: holding it across an await is the
+    intended use, not the hazard."""
+    src = """
+    import asyncio
+
+    class Store:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+
+        async def put(self, k, v):
+            with self._lock:
+                await self._persist(k, v)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/storage/mod.py": src},
+        select="lock-across-await",
+    )
+    assert got == []
+
+
+def test_lock_across_await_quiet_when_released_before_await():
+    """Copy under the lock, await outside — the fix shape the message
+    recommends must itself lint clean."""
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        async def put(self, k, v):
+            with self._lock:
+                staged = (k, v)
+            await self._persist(*staged)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/storage/mod.py": src},
+        select="lock-across-await",
+    )
+    assert got == []
+
+
+def test_lock_across_await_honors_pragma():
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        async def put(self, k, v):
+            with self._lock:  # wql: allow(lock-across-await)
+                await self._persist(k, v)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/storage/mod.py": src},
+        select="lock-across-await",
+    )
+    assert got == []
+
+
+# endregion
+
+# region: 24 unlocked-shared-write
+
+
+def test_unlocked_shared_write_two_domains_no_lock():
+    """The Metrics-registry class of bug: the same attr stored from
+    loop and thread code in a class with no lock anywhere."""
+    src = """
+    import threading
+
+    class Stats:
+        async def on_tick(self):
+            self.count = self.count + 1
+
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.count = self.count + 1
+    """
+    path = "worldql_server_tpu/engine/mod.py"
+    got = program_violations({path: src},
+                             select="unlocked-shared-write")
+    assert got == [
+        ("unlocked-shared-write", path, 6),
+        ("unlocked-shared-write", path, 12),
+    ]
+
+
+def test_unlocked_shared_write_augassign_counts():
+    src = """
+    import asyncio
+
+    class Stats:
+        async def on_tick(self):
+            self.total += 1
+
+        async def kick(self):
+            await asyncio.to_thread(self._worker)
+
+        def _worker(self):
+            self.total += 1
+    """
+    path = "worldql_server_tpu/engine/mod.py"
+    got = program_violations({path: src},
+                             select="unlocked-shared-write")
+    assert got == [
+        ("unlocked-shared-write", path, 6),
+        ("unlocked-shared-write", path, 12),
+    ]
+
+
+def test_unlocked_shared_write_quiet_with_lock_discipline():
+    """A class that declares a threading.Lock has a discipline —
+    auditing each site belongs to review, not this rule."""
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        async def on_tick(self):
+            self.count = 1
+
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.count = 2
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="unlocked-shared-write",
+    )
+    assert got == []
+
+
+def test_unlocked_shared_write_quiet_single_domain_and_init():
+    """One domain writing is confinement (fine); __init__ stores are
+    pre-publication (fine)."""
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.count = 0
+
+        async def on_tick(self):
+            self.count = self.count + 1
+
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self._thread_only = 1
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="unlocked-shared-write",
+    )
+    assert got == []
+
+
+def test_unlocked_shared_write_honors_pragma():
+    src = """
+    import threading
+
+    class Stats:
+        async def on_tick(self):
+            self.count = 1  # wql: allow(unlocked-shared-write)
+
+        async def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.count = 2  # wql: allow(unlocked-shared-write)
+    """
+    got = program_violations(
+        {"worldql_server_tpu/engine/mod.py": src},
+        select="unlocked-shared-write",
+    )
+    assert got == []
+
+
+# endregion
+
+# endregion

@@ -1,0 +1,154 @@
+"""Clustered columnar fast path (closes PR 15's KNOWN GAP).
+
+The router stamps every router→shard forward with a 20-byte WQTX
+trace prefix (cluster/tracectx.py). The native entity classifier
+sees bare wire bytes only — a prefixed buffer fails classification,
+which used to push every clustered entity update onto the object
+path. The fix strips the prefix in the shard's recv loop BEFORE the
+batch reaches ``ColumnarIngest.process_batch``, carries the trace
+context alongside for slow-routed messages, and counts each stripped
+frame (``zmq.ctx_unwrapped``) so the fast-path-through-router claim
+is measurable, not assumed.
+"""
+
+import asyncio
+import uuid
+
+import pytest
+
+from tests.client_util import ZmqClient, free_port
+from worldql_server_tpu.cluster import tracectx
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.protocol import (
+    Instruction,
+    Message,
+    entity_wire,
+    serialize_message,
+)
+from worldql_server_tpu.protocol.types import Entity, Vector3
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def ent_msg(sender, entities, world="w"):
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE, sender_uuid=sender,
+        world_name=world, entities=entities,
+    )
+
+
+@pytest.fixture(scope="module")
+def wire() -> entity_wire.EntityWire:
+    ew = entity_wire.load()
+    assert ew is not None, "native entity codec failed to load"
+    return ew
+
+
+def test_wqtx_prefix_defeats_classifier_and_strip_restores_it(wire):
+    """The gap's mechanics, pinned: the SAME entity update classifies
+    fast bare, slow with the router prefix, and fast again after
+    ``tracectx.unwrap`` — byte-identical columns both fast times."""
+    sender, ent = uuid.uuid4(), uuid.uuid4()
+    data = serialize_message(ent_msg(sender, [Entity(
+        uuid=ent, position=Vector3(1, 2, 3), world_name="w",
+    )]))
+    wrapped = tracectx.wrap(data, trace_id=tracectx.new_trace_id(),
+                            t_ingress_ns=123456)
+
+    bare = wire.decode([data])
+    assert bare.status.tolist() == [1]
+
+    through_router = wire.decode([wrapped])
+    assert through_router.status.tolist() == [0], \
+        "prefixed bytes must NOT classify (conservative, correct)"
+
+    trace_id, t_ctx, stripped = tracectx.unwrap(wrapped)
+    assert trace_id != 0 and t_ctx == 123456 and stripped == data
+    restored = wire.decode([stripped])
+    assert restored.status.tolist() == [1]
+    assert bytes(restored.sender_keys[0]) == bytes(bare.sender_keys[0])
+    assert bytes(restored.uuid_keys[0]) == bytes(bare.uuid_keys[0])
+
+
+class _ShardStub:
+    """The minimal cluster surface the transport + teardown touch.
+
+    Installed AFTER server.start(), so the ticker (which captured
+    cluster=None at construction) never drains through it — only the
+    recv loop's unwrap hook and the peer-teardown hook are live,
+    which is exactly the surface under test."""
+
+    unwrap = staticmethod(tracectx.unwrap)
+
+    def on_peer_torn_down(self, peer_uuid) -> None:
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+
+def test_router_framed_updates_keep_columnar_fast_path():
+    """e2e over real ZMQ: WQTX-wrapped entity updates (as the router
+    would forward them) ride the columnar fast path — fast_messages
+    advances, rows stage, zmq.ctx_unwrapped counts every stripped
+    frame — and neighbor frames keep serving."""
+
+    async def scenario():
+        config = Config()
+        config.store_url = "memory://"
+        config.http_enabled = False
+        config.ws_enabled = False
+        config.zmq_server_port = free_port()
+        config.zmq_server_host = "127.0.0.1"
+        config.spatial_backend = "tpu"
+        config.tick_interval = 0.03
+        config.entity_sim = True
+        config.entity_k = 4
+        server = WorldQLServer(config)
+        await server.start()
+        server.cluster = _ShardStub()
+        try:
+            ingest = server.entity_ingest
+            assert ingest is not None and ingest.active
+            a = await ZmqClient.connect(config.zmq_server_port)
+            b = await ZmqClient.connect(config.zmq_server_port)
+            ea, eb = uuid.uuid4(), uuid.uuid4()
+
+            def routered(msg) -> bytes:
+                return tracectx.wrap(
+                    serialize_message(msg),
+                    trace_id=tracectx.new_trace_id(),
+                    t_ingress_ns=1,
+                )
+
+            fast0 = ingest.fast_messages
+            await a.send_raw(routered(ent_msg(a.uuid, [Entity(
+                uuid=ea, position=Vector3(1, 2, 3), world_name="w",
+            )])))
+            await b.send_raw(routered(ent_msg(b.uuid, [Entity(
+                uuid=eb, position=Vector3(2, 2, 3), world_name="w",
+            )])))
+            frame = await b.recv_until(Instruction.LOCAL_MESSAGE,
+                                       timeout=20)
+            assert frame.parameter == "entity.frame"
+            for _ in range(3):
+                await b.send_raw(routered(ent_msg(b.uuid, [Entity(
+                    uuid=eb, position=Vector3(2, 2, 3), world_name="w",
+                )])))
+                await b.recv_until(Instruction.LOCAL_MESSAGE, timeout=20)
+
+            assert ingest.fast_messages > fast0, ingest.stats()
+            assert ingest.rows > 0
+            counters = server.metrics.snapshot()["counters"]
+            stripped = counters.get("zmq.ctx_unwrapped", 0)
+            assert stripped >= ingest.fast_messages - fast0 > 0, counters
+            await a.close()
+            await b.close()
+        finally:
+            server.cluster = None
+            await server.stop()
+
+    run(scenario())

@@ -1,0 +1,183 @@
+"""Parity pins and red tests for the SPSC ring model checker.
+
+The model checker (``tools/ring_model.py``) is only as good as its
+fidelity to ``delivery/ring.py``: these tests drive the model's
+sequential step functions and a REAL ``Ring`` (over actual shared
+memory, with a model-sized cap) in lockstep through every scenario
+and compare cursor trajectories, accept/reject decisions, and
+delivery order after every single operation. The red tests prove the
+checker can fail: the two seeded protocol bugs (publish-before-write,
+missing WRAP marker) must each be caught as a torn read.
+"""
+
+from __future__ import annotations
+
+import struct
+import uuid
+
+import pytest
+
+from multiprocessing import shared_memory
+
+from tools.ring_model import MAX_STATES, SCENARIOS, Model, Violation
+from worldql_server_tpu.cluster.bus import _CTX, CTX_LEN, HEADER_LEN
+from worldql_server_tpu.delivery.ring import _CUR, _HDR, Ring
+
+
+def _tiny_ring(cap: int) -> Ring:
+    """A real Ring with a model-sized cap (create() clamps to the
+    64 KiB production floor, so build the block by hand)."""
+    shm = shared_memory.SharedMemory(create=True, size=_HDR + cap)
+    shm.buf[:_HDR] = b"\x00" * _HDR
+    _CUR.pack_into(shm.buf, 16, cap)
+    return Ring(shm, cap)
+
+
+def _frame(op: int, frame_len: int) -> bytes:
+    return bytes([op & 0xFF]) * frame_len
+
+
+def _slots(op: int, n_slots: int) -> bytes:
+    return struct.pack(f"<{n_slots}I", *range(op * 100, op * 100 + n_slots))
+
+
+# region: parity — model vs real Ring, lockstep
+
+@pytest.mark.parametrize("name,cap,ops", SCENARIOS,
+                         ids=[s[0] for s in SCENARIOS])
+def test_parity_lockstep(name, cap, ops):
+    """Same op script, write-until-full/drain-one schedule: cursors,
+    accept decisions, and delivered records must match exactly."""
+    model = Model(cap, ops)
+    mstate = model.seq_init()
+    ring = _tiny_ring(cap)
+    try:
+        delivered = []
+        for op, (frame_len, n_slots) in enumerate(ops):
+            while True:
+                mstate, m_ok = model.seq_try_write(mstate, op)
+                r_ok = ring.try_write(_frame(op, frame_len),
+                                      _slots(op, n_slots))
+                assert m_ok == r_ok, (name, op, "accept mismatch")
+                assert mstate[1] == ring._head(), (name, op, "head")
+                assert mstate[2] == ring._tail(), (name, op, "tail")
+                if m_ok:
+                    break
+                # full on both sides: drain one record and retry
+                mstate, m_op = model.seq_read(mstate)
+                rec = ring.read()
+                assert m_op is not None and rec is not None
+                delivered.append((m_op, rec))
+                assert mstate[2] == ring._tail(), (name, op, "tail/drain")
+        while True:
+            mstate, m_op = model.seq_read(mstate)
+            rec = ring.read()
+            assert (m_op is None) == (rec is None), (name, "drain parity")
+            assert mstate[2] == ring._tail(), (name, "tail/final")
+            if m_op is None:
+                break
+            delivered.append((m_op, rec))
+        # exactly-once, in-order, content-intact on the real side
+        assert [d[0] for d in delivered] == list(range(len(ops)))
+        for m_op, (frame, slots) in delivered:
+            frame_len, n_slots = ops[m_op]
+            assert frame == _frame(m_op, frame_len)
+            assert slots == list(range(m_op * 100, m_op * 100 + n_slots))
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_parity_record_size():
+    """The model delegates to the real arithmetic — pin a spread of
+    (frame_len, n_slots) footprints anyway so a future transcription
+    can't drift silently."""
+    for frame_len in (0, 1, 4, 7, 8, 23, 24, 36, 92):
+        for n_slots in (0, 1, 2, 5):
+            assert Model(128, [(4, 1)]).sizes[0] == Ring.record_size(4, 1)
+            got = Ring.record_size(frame_len, n_slots)
+            assert got % 8 == 0
+            assert got >= 28 + frame_len + 4 * n_slots
+
+
+# endregion
+
+# region: exhaustive exploration is green (and non-trivial)
+
+@pytest.mark.parametrize("name,cap,ops", SCENARIOS,
+                         ids=[s[0] for s in SCENARIOS])
+def test_explore_exhausts_clean(name, cap, ops):
+    stats = Model(cap, ops).explore()
+    assert stats["quiescent"] >= 1, "never reached producer-done+drained"
+    assert stats["states"] < MAX_STATES
+    # non-trivial interleaving space, not a sequential walk
+    assert stats["states"] > 2 * stats["ops"] * 10
+
+
+# endregion
+
+# region: red tests — the checker must catch the seeded bugs
+
+def test_seeded_publish_first_is_torn_read():
+    """Cursor published before the record bytes: the consumer can
+    observe junk/stale words — every scenario must catch it."""
+    for name, cap, ops in SCENARIOS:
+        with pytest.raises(Violation) as exc:
+            Model(cap, ops, publish_first=True).explore()
+        assert exc.value.kind == "torn-read", name
+        assert exc.value.trace, "violation must carry a step witness"
+
+
+def test_seeded_missing_wrap_marker_is_caught():
+    """No WRAP marker where one is required (rem >= header size): the
+    consumer misreads the stale burn region."""
+    name, cap, ops = next(s for s in SCENARIOS
+                          if s[0] == "mixed-wrap-marker")
+    with pytest.raises(Violation) as exc:
+        Model(cap, ops, skip_wrap_marker=True).explore()
+    assert exc.value.kind == "torn-read"
+
+
+def test_oversized_record_is_a_scenario_error():
+    """A record > cap/2 can be permanently unplaceable — the model
+    rejects the scenario instead of deadlocking silently."""
+    with pytest.raises(RuntimeError, match="never fit"):
+        Model(128, [(92, 0), (4, 1), (92, 0)]).explore()
+
+
+# endregion
+
+# region: cluster bus ctx framing inside a real ring
+
+def test_bus_ctx_header_rides_ring_intact():
+    """The 32-byte trace header (_CTX + peer uuid) the inter-shard bus
+    prepends inside each ring frame round-trips bit-exactly through a
+    real Ring, including across a wrap."""
+    peer = uuid.uuid4()
+    ring = _tiny_ring(128)
+    try:
+        for i in range(6):  # > one lap of a 128-byte ring
+            body = bytes([i]) * 10
+            framed = _CTX.pack(1000 + i, 2000 + i) + peer.bytes + body
+            assert ring.try_write(framed, b"")
+            frame, slots = ring.read()
+            assert len(frame) > HEADER_LEN
+            trace_id, t_ctx = _CTX.unpack_from(frame)
+            assert (trace_id, t_ctx) == (1000 + i, 2000 + i)
+            assert uuid.UUID(bytes=frame[CTX_LEN:HEADER_LEN]) == peer
+            assert frame[HEADER_LEN:] == body
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_bus_runt_boundary_matches_drain():
+    """drain() drops frames with len <= HEADER_LEN — pin the boundary
+    the model's CTX_WORDS abstraction assumes."""
+    assert HEADER_LEN == 32
+    assert CTX_LEN == 16
+    # a header-only frame is a runt; one body byte makes it valid
+    assert len(_CTX.pack(0, 0) + uuid.uuid4().bytes) == HEADER_LEN
+
+
+# endregion

@@ -1,9 +1,21 @@
 """CLI for the project lint pass.
 
     python -m tools.check                    # lint the package
-    python -m tools.check worldql_server_tpu tests
+    python -m tools.check worldql_server_tpu tests tools
     python -m tools.check --list-rules
-    python -m tools.check --select jax-host-sync,async-dangling-task
+    python -m tools.check --select jax-host-sync,lock-across-await
+    python -m tools.check --time --soft-budget-s 60
+
+Two passes run: the per-file rule families (catalog 1–20) over every
+linted file, and the interprocedural execution-domain pass (catalog
+21–24, tools/check/domains.py) over the package files among them —
+one whole-program call graph, so a blocking call or a cross-domain
+mutation hiding a call level down still fails lint. ``--no-program``
+skips the graph pass; ``--no-cache`` bypasses the parsed-AST cache.
+
+``--time`` reports wall time per pass; ``--soft-budget-s N`` prints a
+loud warning (never a failure) when the total exceeds the budget —
+the CI lint step's canary against the lint itself becoming slow.
 
 Exit status: 0 clean, 1 violations, 2 usage error.
 """
@@ -12,8 +24,10 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 from .core import all_rules, check_paths
+from .domains import PROGRAM_RULES, check_program_paths
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -32,23 +46,72 @@ def main(argv: list[str] | None = None) -> int:
         "--select", default="",
         help="comma-separated rule names to run (default: all)",
     )
+    parser.add_argument(
+        "--no-program", action="store_true",
+        help="skip the interprocedural execution-domain pass (21-24)",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the parsed-AST cache (callgraph extraction)",
+    )
+    parser.add_argument(
+        "--time", action="store_true",
+        help="report lint wall time per pass on stderr",
+    )
+    parser.add_argument(
+        "--soft-budget-s", type=float, default=0.0,
+        help="warn (never fail) when total wall time exceeds this",
+    )
     args = parser.parse_args(argv)
 
     rules = {r.name: r for r in all_rules()}
+    program_rules = {r.name: r for r in PROGRAM_RULES}
     if args.list_rules:
         for name in sorted(rules):
-            print(f"{name:24s} {rules[name].summary}")
+            print(f"{name:28s} {rules[name].summary}")
+        for name in sorted(program_rules):
+            print(f"{name:28s} {program_rules[name].summary}")
         return 0
 
     select = {s.strip() for s in args.select.split(",") if s.strip()}
-    unknown = select - rules.keys()
+    unknown = select - rules.keys() - program_rules.keys()
     if unknown:
         print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
         return 2
 
-    violations = check_paths(args.paths, select=select or None)
+    t0 = time.perf_counter()
+    file_select = select & rules.keys()
+    violations = []
+    if not select or file_select:
+        violations.extend(
+            check_paths(args.paths, select=file_select or None)
+        )
+    t_file = time.perf_counter()
+    program_select = select & program_rules.keys()
+    if not args.no_program and (not select or program_select):
+        violations.extend(check_program_paths(
+            args.paths, select=program_select or None,
+            cache=not args.no_cache,
+        ))
+    t_prog = time.perf_counter()
+
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     for v in violations:
         print(v.render())
+    if args.time:
+        print(
+            f"lint wall: {t_prog - t0:.2f}s "
+            f"(per-file {t_file - t0:.2f}s, "
+            f"domain graph {t_prog - t_file:.2f}s)",
+            file=sys.stderr,
+        )
+    if args.soft_budget_s and (t_prog - t0) > args.soft_budget_s:
+        print(
+            f"WARNING: lint wall {t_prog - t0:.2f}s exceeds the "
+            f"soft budget of {args.soft_budget_s:.0f}s — profile "
+            f"tools/check before it becomes the slowest CI step",
+            file=sys.stderr,
+        )
     if violations:
         print(
             f"\n{len(violations)} violation(s). Intentional cases need an "

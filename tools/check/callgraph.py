@@ -1,0 +1,727 @@
+"""Repo-wide AST call graph: the substrate for interprocedural rules.
+
+Every rule family before catalog 21 judges one parsed file at a time,
+so a blocking call or a loop-owned-state mutation hiding ONE call
+level down is invisible. This module builds a whole-program call graph
+over the package:
+
+* **Module-qualified name resolution** — ``from ..engine.peers import
+  PeerMap`` / ``import time`` / relative imports all resolve call
+  sites to either an internal function's qualified name
+  (``worldql_server_tpu.engine.peers.PeerMap.insert``) or an external
+  dotted name (``time.sleep``) the rule tables can match.
+* **Method resolution through class attributes** — ``self.plane =
+  EntityPlane(...)`` in ``__init__`` types ``self.plane``, so
+  ``self.plane.collect_tick()`` resolves to the real method; base
+  classes defined in the repo resolve inherited calls.
+* **Domain-crossing edges** — ``asyncio.to_thread`` /
+  ``run_in_executor`` / ``loop.call_soon_threadsafe`` /
+  ``threading.Thread(target=)`` / ``multiprocessing...Process(
+  target=)`` / ``create_task`` / supervisor ``spawn``/
+  ``spawn_transient`` record WHERE execution changes domain, and the
+  target function of the hand-off (unwrapping ``functools.partial``).
+
+The extraction half (one :class:`FileSummary` per file) is cached in a
+pickle keyed by ``(mtime_ns, size)`` with a content-sha fallback: a
+local edit misses on mtime and re-parses, while a CI-restored cache
+(fresh checkout → every mtime new) still hits on content, so
+actions/cache actually pays off. The link half (cross-file resolution)
+is cheap and always runs fresh. The cache lives at
+``.wql_check_cache.pkl`` under the working directory (override with
+``WQL_CHECK_CACHE``; delete it freely — it is a pure accelerator).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import PRAGMA_RE, dotted_name
+
+CACHE_VERSION = 4  # bump when summary shapes change: stale pickles reparse
+
+#: crossing kinds: which domain the hand-off target executes in
+CROSS_THREAD = "thread"
+CROSS_PROCESS = "process"
+CROSS_LOOP = "loop"
+
+
+@dataclass
+class CallSite:
+    """One call (or hand-off) inside a function body. ``raw`` is the
+    dotted callee text as written (``self.plane.flush``,
+    ``time.sleep``); for crossing sites it is the TARGET of the
+    hand-off, not the scheduling primitive."""
+
+    raw: str
+    lineno: int
+    col: int
+    cross: str | None = None
+
+
+@dataclass
+class WriteSite:
+    """One mutation inside a function body: an attribute/subscript
+    store (``kind='store'``) or a call to a known mutator method
+    (``kind='call'``, e.g. ``...peers.pop(...)``). ``chain`` is the
+    dotted text of the mutated object (``self._peers``), ``attr`` the
+    attribute name when the base is ``self``. ``locked`` means the
+    site sits lexically inside a ``with <threading lock>`` block."""
+
+    chain: str
+    attr: str
+    lineno: int
+    col: int
+    locked: bool
+    kind: str = "store"
+    method: str = ""
+
+
+@dataclass
+class LockAwait:
+    """A held ``threading.Lock``/``RLock`` spanning an ``await`` in an
+    async function (rule 23's per-function evidence)."""
+
+    lineno: int
+    col: int
+    lock: str
+    await_line: int
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    module: str
+    relpath: str
+    lineno: int
+    is_async: bool
+    cls: str | None
+    calls: list[CallSite] = field(default_factory=list)
+    writes: list[WriteSite] = field(default_factory=list)
+    lock_awaits: list[LockAwait] = field(default_factory=list)
+    #: names of functions defined lexically inside this one
+    local_defs: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ClassInfo:
+    qname: str
+    module: str
+    relpath: str
+    bases: list[str] = field(default_factory=list)
+    methods: dict[str, str] = field(default_factory=dict)
+    #: ``self.X = SomeClass(...)`` constructor-typed attributes
+    attr_types: dict[str, str] = field(default_factory=dict)
+    #: attrs assigned a threading.Lock()/RLock() (lock discipline)
+    lock_attrs: set[str] = field(default_factory=set)
+
+
+@dataclass
+class FileSummary:
+    relpath: str
+    module: str
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    allow: dict[int, set[str]] = field(default_factory=dict)
+
+
+# region: extraction
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_ASYNC_LOCK_CTORS = {"asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore"}
+
+#: method names treated as mutations of their receiver (rule 22)
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "rebind",
+    "__setitem__",
+}
+
+
+def module_name(relpath: str) -> str:
+    parts = Path(relpath).with_suffix("").parts
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(module: str, level: int, target: str | None) -> str:
+    """``from ..a import b`` inside ``pkg.x.y`` → ``pkg.a``."""
+    base = module.split(".")
+    # level 1 = current package (the module's parent)
+    base = base[: len(base) - level]
+    if target:
+        base.append(target)
+    return ".".join(p for p in ".".join(base).split(".") if p)
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """``functools.partial(f, ...)`` → ``f`` (one level is enough for
+    every hand-off in the repo)."""
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("functools.partial", "partial") and node.args:
+            return node.args[0]
+    return node
+
+
+def _target_expr(node: ast.AST) -> str | None:
+    """Dotted text of a hand-off target expression; a ``Call`` target
+    (``create_task(coro())``) resolves to its callee."""
+    node = _unwrap_partial(node)
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    return dotted_name(node)
+
+
+class _Extractor(ast.NodeVisitor):
+    """One pass over one file: functions, classes, call/write sites."""
+
+    def __init__(self, relpath: str, source: str):
+        self.summary = FileSummary(relpath=relpath, module=module_name(relpath))
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                self.summary.allow[lineno] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+        self.imports: dict[str, str] = {}
+        self._cls_stack: list[ClassInfo] = []
+        self._fn_stack: list[FunctionInfo] = []
+        self._lock_depth = 0
+
+    # region: imports
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.imports[alias.asname or alias.name.split(".")[0]] = (
+                alias.name if alias.asname else alias.name.split(".")[0]
+            )
+            if alias.asname:
+                self.imports[alias.asname] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = (
+            _resolve_relative(self.summary.module, node.level, node.module)
+            if node.level
+            else (node.module or "")
+        )
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imports[alias.asname or alias.name] = (
+                f"{base}.{alias.name}" if base else alias.name
+            )
+
+    # endregion
+
+    # region: scopes
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qname = f"{self.summary.module}.{node.name}"
+        info = ClassInfo(
+            qname=qname, module=self.summary.module,
+            relpath=self.summary.relpath,
+            bases=[b for b in (self._expand(dotted_name(x)) for x in node.bases) if b],
+        )
+        self.summary.classes[node.name] = info
+        self._cls_stack.append(info)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._cls_stack.pop()
+
+    def _visit_function(self, node, is_async: bool) -> None:
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        if self._fn_stack:
+            parent = self._fn_stack[-1]
+            qname = f"{parent.qname}.<locals>.{node.name}"
+            parent.local_defs[node.name] = qname
+        elif cls is not None:
+            qname = f"{cls.qname}.{node.name}"
+            cls.methods[node.name] = qname
+        else:
+            qname = f"{self.summary.module}.{node.name}"
+        info = FunctionInfo(
+            qname=qname, module=self.summary.module,
+            relpath=self.summary.relpath, lineno=node.lineno,
+            is_async=is_async,
+            cls=cls.qname if cls is not None and not self._fn_stack else None,
+        )
+        self.summary.functions[qname] = info
+        self._fn_stack.append(info)
+        saved_lock = self._lock_depth
+        self._lock_depth = 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self._lock_depth = saved_lock
+        self._fn_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function(node, is_async=True)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a lambda body executes at call time, possibly in another
+        # domain; sites inside are not attributed to the enclosing
+        # function (matches walk_shallow's per-file discipline)
+        return
+
+    # endregion
+
+    # region: sites
+
+    def _expand(self, raw: str | None) -> str | None:
+        """Qualify a dotted name's first segment through the import
+        map (``np.concatenate`` → ``numpy.concatenate``)."""
+        if raw is None:
+            return None
+        head, _, rest = raw.partition(".")
+        full = self.imports.get(head)
+        if full is None:
+            return raw
+        return f"{full}.{rest}" if rest else full
+
+    def _is_lockish(self, expr: ast.AST) -> str | None:
+        """Dotted text when ``expr`` names a (probable) threading
+        lock: a ``self.X`` typed by a Lock() assignment, or any name
+        whose last segment mentions 'lock' (minus asyncio locks)."""
+        raw = dotted_name(expr)
+        if raw is None:
+            return None
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        if raw.startswith("self.") and cls is not None:
+            attr = raw.split(".")[1]
+            if attr in cls.lock_attrs:
+                return raw
+            typed = cls.attr_types.get(attr)
+            if typed in _ASYNC_LOCK_CTORS:
+                return None
+        expanded = self._expand(raw) or raw
+        if expanded.startswith("asyncio."):
+            return None
+        return raw if "lock" in raw.split(".")[-1].lower() else None
+
+    def visit_With(self, node: ast.With) -> None:
+        lock = None
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # with self._lock() styles
+            lock = lock or self._is_lockish(item.context_expr) or (
+                self._is_lockish(expr) if expr is not item.context_expr else None
+            )
+        for item in node.items:
+            self.visit(item.context_expr)
+        if lock is None:
+            for stmt in node.body:
+                self.visit(stmt)
+            return
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None and fn.is_async:
+            awaited = self._first_await(node.body)
+            if awaited is not None:
+                fn.lock_awaits.append(LockAwait(
+                    node.lineno, node.col_offset, lock, awaited,
+                ))
+        self._lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self._lock_depth -= 1
+
+    @staticmethod
+    def _first_await(body) -> int | None:
+        """Line of the first ``await`` in this block, not descending
+        into nested function bodies (their awaits run elsewhere)."""
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Await):
+                return node.lineno
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return None
+
+    def _add_call(self, raw: str | None, node: ast.AST,
+                  cross: str | None = None) -> None:
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is None or raw is None:
+            return
+        fn.calls.append(CallSite(raw, node.lineno, node.col_offset, cross))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        raw = dotted_name(node.func)
+        expanded = self._expand(raw) if raw else None
+        cross, target = self._crossing(node, raw, expanded)
+        if cross is not None:
+            self._add_call(target, node, cross)
+        elif raw is not None:
+            self._add_call(raw, node)
+            last = raw.rsplit(".", 1)[-1]
+            if "." in raw and last in MUTATOR_METHODS:
+                self._add_write(node.func.value, node, kind="call",
+                                method=last)
+        self.generic_visit(node)
+
+    def _crossing(self, node: ast.Call, raw, expanded):
+        """(cross_kind, target_raw) when this call hands its target to
+        another execution domain, else (None, None)."""
+        if raw is None:
+            return None, None
+        last = raw.rsplit(".", 1)[-1]
+        if expanded == "asyncio.to_thread" or last == "to_thread":
+            return CROSS_THREAD, _target_expr(node.args[0]) if node.args else None
+        if last == "run_in_executor" and len(node.args) >= 2:
+            return CROSS_THREAD, _target_expr(node.args[1])
+        if expanded in ("threading.Thread", "Thread") or last == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    return CROSS_THREAD, _target_expr(kw.value)
+            return None, None
+        if last == "Process":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    return CROSS_PROCESS, _target_expr(kw.value)
+            return None, None
+        if last in ("call_soon_threadsafe", "call_soon") and node.args:
+            return CROSS_LOOP, _target_expr(node.args[0])
+        if last == "call_later" and len(node.args) >= 2:
+            return CROSS_LOOP, _target_expr(node.args[1])
+        if last in ("create_task", "ensure_future") and node.args:
+            return CROSS_LOOP, _target_expr(node.args[0])
+        if last == "spawn" and len(node.args) >= 2:
+            # robustness supervisor: spawn(name, factory) — the factory
+            # is called to make the coroutine, then runs on the loop
+            return CROSS_LOOP, _target_expr(node.args[1])
+        if last == "spawn_transient" and len(node.args) >= 2:
+            return CROSS_LOOP, _target_expr(node.args[1])
+        return None, None
+
+    def _add_write(self, base: ast.AST, node: ast.AST,
+                   kind: str = "store", method: str = "") -> None:
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        chain = dotted_name(base)
+        if fn is None or chain is None:
+            return
+        attr = ""
+        parts = chain.split(".")
+        if parts[0] == "self" and len(parts) >= 2:
+            attr = parts[1]
+        fn.writes.append(WriteSite(
+            chain, attr, node.lineno, node.col_offset,
+            locked=self._lock_depth > 0, kind=kind, method=method,
+        ))
+
+    def _record_target(self, target: ast.AST, node: ast.AST) -> None:
+        if isinstance(target, ast.Attribute):
+            self._add_write(target, node)
+        elif isinstance(target, ast.Subscript):
+            self._add_write(target.value, node)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_target(el, node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # constructor-typed attrs + lock attrs (class knowledge)
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        if cls is not None and len(node.targets) == 1:
+            t = node.targets[0]
+            chain = dotted_name(t)
+            if (
+                chain is not None and chain.startswith("self.")
+                and chain.count(".") == 1
+                and isinstance(node.value, ast.Call)
+            ):
+                ctor = dotted_name(node.value.func)
+                expanded = self._expand(ctor) if ctor else None
+                attr = chain.split(".")[1]
+                if ctor in _LOCK_CTORS or expanded in (
+                    "threading.Lock", "threading.RLock",
+                ):
+                    cls.lock_attrs.add(attr)
+                elif expanded is not None:
+                    cls.attr_types.setdefault(attr, expanded)
+        for t in node.targets:
+            self._record_target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, node)
+        self.generic_visit(node)
+
+
+def extract_summary(source: str, relpath: str) -> FileSummary:
+    tree = ast.parse(source, filename=relpath)
+    ex = _Extractor(relpath, source)
+    ex.visit(tree)
+    return ex.summary
+
+
+# endregion
+
+# region: cache
+
+
+def default_cache_path() -> Path:
+    env = os.environ.get("WQL_CHECK_CACHE")
+    return Path(env) if env else Path(".wql_check_cache.pkl")
+
+
+def load_summaries(
+    files: list[Path], root: Path | None = None, cache: bool = True,
+) -> dict[str, FileSummary]:
+    """Parse (or cache-load) every file → ``{relpath: FileSummary}``.
+    Unparseable files are skipped — the per-file pass already reports
+    syntax errors."""
+    root = root or Path.cwd()
+    cache_path = default_cache_path() if cache else None
+    store: dict = {}
+    if cache_path is not None and cache_path.exists():
+        try:
+            with open(cache_path, "rb") as fh:
+                payload = pickle.load(fh)
+            if payload.get("version") == CACHE_VERSION:
+                store = payload.get("files", {})
+        except Exception:
+            store = {}  # cache is a pure accelerator: corrupt → reparse
+    out: dict[str, FileSummary] = {}
+    dirty = False
+    for file in files:
+        try:
+            rel = file.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = file.as_posix()
+        try:
+            st = file.stat()
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            continue
+        hit = store.get(rel)
+        if hit is not None and hit[0] == key:
+            out[rel] = hit[2]
+            continue
+        try:
+            raw = file.read_bytes()
+        except OSError:
+            continue
+        sha = hashlib.sha256(raw).hexdigest()
+        if hit is not None and hit[1] == sha:
+            # CI shape: restored cache, fresh-checkout mtimes — adopt
+            # the new stat key so the next run hits on the fast path
+            out[rel] = hit[2]
+            store[rel] = (key, sha, hit[2])
+            dirty = True
+            continue
+        try:
+            summary = extract_summary(raw.decode("utf-8"), rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        out[rel] = summary
+        store[rel] = (key, sha, summary)
+        dirty = True
+    if cache_path is not None and dirty:
+        try:
+            tmp = cache_path.with_suffix(".tmp")
+            with open(tmp, "wb") as fh:
+                pickle.dump(
+                    {"version": CACHE_VERSION, "files": store}, fh,
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    return out
+
+
+# endregion
+
+# region: linking
+
+
+@dataclass
+class Edge:
+    caller: str
+    callee: str          # internal qname OR external dotted name
+    internal: bool
+    site: CallSite
+
+
+class CallGraph:
+    """Linked whole-program view: functions, classes, resolved edges.
+
+    ``attr_hints`` maps well-known attribute names to class qnames for
+    attributes typed only by constructor parameters (``self.metrics =
+    metrics``) — the domain layer seeds these with project knowledge.
+    """
+
+    def __init__(self, summaries: dict[str, FileSummary],
+                 attr_hints: dict[str, str] | None = None):
+        self.summaries = summaries
+        self.attr_hints = attr_hints or {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self._class_by_module: dict[tuple[str, str], ClassInfo] = {}
+        for s in summaries.values():
+            self.functions.update(s.functions)
+            for name, cls in s.classes.items():
+                self.classes[cls.qname] = cls
+                self._class_by_module[(s.module, name)] = cls
+        self.edges: dict[str, list[Edge]] = {q: [] for q in self.functions}
+        self._link()
+
+    # region: resolution
+
+    def _resolve_method(self, cls: ClassInfo, name: str) -> str | None:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.qname in seen:
+                continue
+            seen.add(c.qname)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                base = self.classes.get(b)
+                if base is None:
+                    # bases recorded as module-local names
+                    base = self._class_by_module.get(
+                        (c.module, b.rsplit(".", 1)[-1])
+                    )
+                if base is not None:
+                    stack.append(base)
+        return None
+
+    def _attr_class(self, cls: ClassInfo, attr: str) -> ClassInfo | None:
+        typed = cls.attr_types.get(attr) or self.attr_hints.get(attr)
+        if typed is None:
+            return None
+        target = self.classes.get(typed)
+        if target is None:
+            target = self._class_by_module.get(
+                (cls.module, typed.rsplit(".", 1)[-1])
+            )
+        if target is None:
+            # constructor imported from another module: match by the
+            # trailing class name anywhere in the repo (unique names —
+            # true for every class this resolution matters for)
+            tail = typed.rsplit(".", 1)[-1]
+            hits = [
+                c for (m, n), c in self._class_by_module.items() if n == tail
+            ]
+            if len(hits) == 1:
+                target = hits[0]
+        return target
+
+    def resolve(self, fn: FunctionInfo, raw: str) -> tuple[str, bool] | None:
+        """One call site → ``(name, internal)``: an internal function
+        qname, or an external dotted name (``time.sleep``)."""
+        summary = self.summaries.get(fn.relpath)
+        if summary is None:
+            return None
+        parts = raw.split(".")
+        # self.* chains through the enclosing class
+        owner = fn.cls or (
+            fn.qname.rsplit(".<locals>.", 1)[0].rsplit(".", 1)[0]
+            if ".<locals>." in fn.qname else None
+        )
+        if parts[0] in ("self", "cls") and owner is not None:
+            cls = self.classes.get(owner)
+            if cls is None:
+                return None
+            if len(parts) == 2:
+                m = self._resolve_method(cls, parts[1])
+                return (m, True) if m else None
+            if len(parts) == 3:
+                target = self._attr_class(cls, parts[1])
+                if target is not None:
+                    m = self._resolve_method(target, parts[2])
+                    if m:
+                        return (m, True)
+                return None
+            return None
+        # locally defined nested functions
+        if len(parts) == 1:
+            q = fn.local_defs.get(parts[0])
+            if q is None and ".<locals>." in fn.qname:
+                outer = self.functions.get(
+                    fn.qname.rsplit(".<locals>.", 1)[0]
+                )
+                if outer is not None:
+                    q = outer.local_defs.get(parts[0])
+            if q is not None:
+                return (q, True)
+        # module-level function / class in the same module
+        mod = fn.module
+        q = f"{mod}.{raw}"
+        if q in self.functions:
+            return (q, True)
+        cls = self._class_by_module.get((mod, parts[0]))
+        if cls is not None:
+            if len(parts) == 1:
+                init = cls.methods.get("__init__")
+                return (init, True) if init else (cls.qname, True)
+            m = self._resolve_method(cls, parts[-1])
+            if m:
+                return (m, True)
+        # imported names: search the repo for a unique match by tail
+        tailq = self._repo_lookup(raw)
+        if tailq is not None:
+            return (tailq, True)
+        return (raw, False)
+
+    def _repo_lookup(self, raw: str) -> str | None:
+        """Match ``pkg.mod.fn`` / ``mod.fn`` / bare imported ``fn``
+        against repo functions+classes by dotted suffix (unique-match
+        only, so externals never mis-bind)."""
+        hits = [
+            q for q in self.functions
+            if q == raw or q.endswith("." + raw)
+        ]
+        if len(hits) == 1:
+            return hits[0]
+        # Klass(...) constructor via import
+        chits = [
+            c for c in self.classes.values()
+            if c.qname == raw or c.qname.endswith("." + raw)
+        ]
+        if len(chits) == 1:
+            init = chits[0].methods.get("__init__")
+            return init or chits[0].qname
+        return None
+
+    # endregion
+
+    def _link(self) -> None:
+        for fn in self.functions.values():
+            for site in fn.calls:
+                resolved = self.resolve(fn, site.raw)
+                if resolved is None:
+                    continue
+                name, internal = resolved
+                if internal and name not in self.functions:
+                    continue  # bare class marker with no __init__
+                self.edges[fn.qname].append(
+                    Edge(fn.qname, name, internal, site)
+                )
+
+    def allowed(self, relpath: str, rule: str, lineno: int) -> bool:
+        summary = self.summaries.get(relpath)
+        if summary is None:
+            return False
+        rules = summary.allow.get(lineno)
+        return bool(rules and (rule in rules or "*" in rules))

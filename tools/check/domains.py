@@ -1,0 +1,449 @@
+"""Execution-domain analysis: interprocedural rule families 21–24.
+
+The server spans four execution domains —
+
+=============  =====================================================
+domain         roots
+=============  =====================================================
+``loop``       every coroutine (server/router/ticker/transport/
+               cluster-router code — each process runs its own
+               asyncio loop, and blocking any of them is the same
+               bug), plus ``call_soon*``/``create_task`` targets
+``thread``     ``asyncio.to_thread``/``run_in_executor`` targets
+               (the ticker's collect workers), ``threading.Thread``
+               targets (the WAL writer, device watchdogs)
+``process``    ``multiprocessing`` ``Process(target=)`` spawns —
+               the plain-sync sender workers
+               (``delivery/worker.py``)
+=============  =====================================================
+
+— and cluster router/shard/supervisor processes each run the loop +
+thread + process domains again. Domains propagate over the
+:mod:`callgraph` edges: a sync helper called from a coroutine is
+loop-domain, a helper handed to ``to_thread`` is thread-domain, and a
+function reachable both ways carries both (that ambiguity is exactly
+what rules 22/24 exist to judge).
+
+Rule catalog (continues the per-file catalog; pragma syntax is the
+same ``# wql: allow(<rule>)``):
+
+21. ``transitive-blocking-on-loop`` — a blocking primitive reachable
+    from a loop-domain function through sync calls without a
+    to-thread hop. The per-file ``async-blocking-call`` rule catches
+    the direct case; this one catches the call hiding N levels down.
+22. ``cross-domain-state`` — mutation of event-loop-owned structures
+    (interning maps, staging columns, PeerMap, SessionStore) from
+    thread/process-domain code. The documented ``interning_maps()``
+    thread-ownership contract, machine-checked.
+23. ``lock-across-await`` — a held ``threading.Lock``/``RLock``
+    spanning an ``await``: the loop parks the coroutine WITH the lock
+    held, and the thread the lock excludes can now block the whole
+    process (or deadlock against the loop).
+24. ``unlocked-shared-write`` — an attribute written from ≥2 domains
+    whose owning class has no lock discipline at all (the
+    Metrics-registry class of bug, found statically).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from .callgraph import (
+    CROSS_LOOP, CROSS_PROCESS, CROSS_THREAD, CallGraph, FunctionInfo,
+    load_summaries, extract_summary,
+)
+from .core import Violation, iter_py_files
+
+LOOP = "loop"
+THREAD = "thread"
+PROCESS = "process"
+
+#: blocking primitives by resolved dotted name (exact or prefix-dot
+#: match) — the transitive closure of rules_async._BLOCKING_CALLS plus
+#: the sync-side primitives that only ever appear in helpers
+BLOCKING = {
+    "time.sleep": "use `await asyncio.sleep(...)` or hop via to_thread",
+    "os.fsync": "fsync belongs on the WAL writer thread / a to_thread hop",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.popen": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.waitpid": "use asyncio child-watcher APIs or a to_thread hop",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.getoutput": "use `await asyncio.create_subprocess_exec(...)`",
+    "sqlite3.connect": "open in a worker via `asyncio.to_thread(...)`",
+    "socket.create_connection": "use `asyncio.open_connection(...)`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo(...)`",
+    "urllib.request.urlopen": "use an async client or `asyncio.to_thread`",
+    "requests.get": "use an async client or `asyncio.to_thread`",
+    "requests.post": "use an async client or `asyncio.to_thread`",
+    "requests.request": "use an async client or `asyncio.to_thread`",
+    "select.select": "the loop IS the selector — await readiness instead",
+    "time.monotonic_ns.sleep": "",  # never matches; keeps table shape honest
+}
+
+#: event-loop-owned structures (rule 22): attribute / variable name
+#: tokens anywhere in a mutated chain. These are the documented
+#: single-owner structures: the backend interning maps
+#: (``interning_maps()`` contract), the staging columns, the peer
+#: registry and the session store.
+LOOP_OWNED_TOKENS = {
+    "_world_ids": "backend interning map (enqueue-time contract)",
+    "_peer_ids": "backend interning map (enqueue-time contract)",
+    "peer_map": "PeerMap — loop-owned peer registry",
+    "sessions": "SessionStore — loop-owned session registry",
+    "_staged": "staging columns — loop-owned double buffer",
+    "staging": "staging columns — loop-owned double buffer",
+}
+
+#: classes whose instances are loop-owned: a thread/process-domain
+#: function running one of THESE mutating methods is rule 22's other
+#: half (reached interprocedurally, e.g. a helper calling
+#: ``peer_map.rebind``)
+LOOP_OWNED_CLASSES = {"PeerMap", "SessionStore", "StagingColumns"}
+
+#: well-known constructor-parameter attribute types the per-file
+#: extractor cannot see (``self.metrics = metrics``): project
+#: knowledge injected into method resolution
+ATTR_CLASS_HINTS = {
+    "metrics": "worldql_server_tpu.engine.metrics.Metrics",
+    "_metrics": "worldql_server_tpu.engine.metrics.Metrics",
+    "peer_map": "worldql_server_tpu.engine.peers.PeerMap",
+    "sessions": "worldql_server_tpu.robustness.sessions.SessionStore",
+    "ring": "worldql_server_tpu.delivery.ring.Ring",
+}
+
+#: entry points seeded PROCESS directly (multiprocessing spawn targets
+#: are found from the graph; these are the argv-style ones)
+PROCESS_ROOTS = ("worldql_server_tpu.delivery.worker.worker_main",)
+
+
+@dataclass(frozen=True)
+class ProgramRule:
+    name: str
+    summary: str
+
+
+RULE_TRANSITIVE_BLOCKING = ProgramRule(
+    "transitive-blocking-on-loop",
+    "21: blocking primitive reachable from loop-domain code without a "
+    "to-thread hop (interprocedural)",
+)
+RULE_CROSS_DOMAIN_STATE = ProgramRule(
+    "cross-domain-state",
+    "22: loop-owned structure (interning maps, staging columns, "
+    "PeerMap, SessionStore) mutated from thread/process domains",
+)
+RULE_LOCK_ACROSS_AWAIT = ProgramRule(
+    "lock-across-await",
+    "23: held threading.Lock/RLock spanning an await",
+)
+RULE_UNLOCKED_SHARED_WRITE = ProgramRule(
+    "unlocked-shared-write",
+    "24: attribute written from >=2 domains with no lock discipline "
+    "in the owning class",
+)
+
+PROGRAM_RULES = [
+    RULE_TRANSITIVE_BLOCKING, RULE_CROSS_DOMAIN_STATE,
+    RULE_LOCK_ACROSS_AWAIT, RULE_UNLOCKED_SHARED_WRITE,
+]
+
+
+# region: domain propagation
+
+
+class DomainMap:
+    """``qname -> {domain}`` plus the parent chain that justified each
+    (function, domain) pair — the rule messages print the chain."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.domains: dict[str, set[str]] = {}
+        self.parent: dict[tuple[str, str], tuple[str, int] | None] = {}
+        self._propagate()
+
+    def _seed(self, qname: str, domain: str,
+              parent: tuple[str, int] | None, work: list) -> None:
+        got = self.domains.setdefault(qname, set())
+        if domain in got:
+            return
+        got.add(domain)
+        self.parent[(qname, domain)] = parent
+        work.append((qname, domain))
+
+    def _propagate(self) -> None:
+        work: list[tuple[str, str]] = []
+        for q, fn in self.graph.functions.items():
+            if fn.is_async:
+                self._seed(q, LOOP, None, work)
+        for root in PROCESS_ROOTS:
+            if root in self.graph.functions:
+                self._seed(root, PROCESS, None, work)
+        while work:
+            qname, domain = work.pop()
+            for edge in self.graph.edges.get(qname, ()):
+                site = edge.site
+                if site.cross == CROSS_THREAD:
+                    if edge.internal:
+                        self._seed(edge.callee, THREAD,
+                                   (qname, site.lineno), work)
+                    continue
+                if site.cross == CROSS_PROCESS:
+                    if edge.internal:
+                        self._seed(edge.callee, PROCESS,
+                                   (qname, site.lineno), work)
+                    continue
+                if site.cross == CROSS_LOOP:
+                    if edge.internal:
+                        self._seed(edge.callee, LOOP,
+                                   (qname, site.lineno), work)
+                    continue
+                if not edge.internal:
+                    continue
+                callee = self.graph.functions.get(edge.callee)
+                if callee is None:
+                    continue
+                if callee.is_async:
+                    continue  # runs on its own loop seed, not inline
+                self._seed(edge.callee, domain, (qname, site.lineno), work)
+
+    def chain(self, qname: str, domain: str, limit: int = 6) -> str:
+        """Human-readable propagation path `root -> ... -> qname`."""
+        names = [qname]
+        key = (qname, domain)
+        while len(names) < limit:
+            parent = self.parent.get(key)
+            if parent is None:
+                break
+            names.append(parent[0])
+            key = (parent[0], domain)
+        short = [n.replace("worldql_server_tpu.", "") for n in names]
+        return " <- ".join(short)
+
+
+# endregion
+
+# region: rules
+
+
+def _check_transitive_blocking(graph: CallGraph, dm: DomainMap) -> list:
+    out = []
+    for qname, fn in graph.functions.items():
+        if LOOP not in dm.domains.get(qname, ()):
+            continue
+        if fn.is_async:
+            # direct calls in coroutines are the per-file
+            # async-blocking-call rule's catch; re-flagging them here
+            # would double-report every site
+            continue
+        for edge in graph.edges.get(qname, ()):
+            if edge.internal or edge.site.cross is not None:
+                continue
+            hint = _blocking_hint(edge.callee)
+            if hint is None:
+                continue
+            if graph.allowed(
+                fn.relpath, RULE_TRANSITIVE_BLOCKING.name, edge.site.lineno
+            ):
+                continue
+            out.append(Violation(
+                RULE_TRANSITIVE_BLOCKING.name, fn.relpath,
+                edge.site.lineno, edge.site.col,
+                f"blocking call `{edge.callee}` in `{_short(qname)}`, "
+                f"which event-loop code reaches without a to-thread "
+                f"hop (path: {dm.chain(qname, LOOP)}); {hint}",
+            ))
+    return out
+
+
+def _blocking_hint(name: str) -> str | None:
+    hint = BLOCKING.get(name)
+    if hint is not None:
+        return hint
+    for prefix, h in BLOCKING.items():
+        if name.startswith(prefix + "."):
+            return h
+    return None
+
+
+def _check_cross_domain_state(graph: CallGraph, dm: DomainMap) -> list:
+    out = []
+    for qname, fn in graph.functions.items():
+        doms = dm.domains.get(qname, set())
+        off_loop = doms & {THREAD, PROCESS}
+        if not off_loop:
+            continue
+        owner = fn.cls.rsplit(".", 1)[-1] if fn.cls else ""
+        for w in fn.writes:
+            token = _owned_token(w.chain, w.attr, owner)
+            if token is None:
+                continue
+            if graph.allowed(
+                fn.relpath, RULE_CROSS_DOMAIN_STATE.name, w.lineno
+            ):
+                continue
+            dom = sorted(off_loop)[0]
+            out.append(Violation(
+                RULE_CROSS_DOMAIN_STATE.name, fn.relpath, w.lineno, w.col,
+                f"`{w.chain}` ({LOOP_OWNED_TOKENS.get(token, token)}) "
+                f"mutated in `{_short(qname)}`, which runs in the "
+                f"{'/'.join(sorted(off_loop))} domain (path: "
+                f"{dm.chain(qname, dom)}); loop-owned state must only "
+                f"mutate on the event loop — marshal via "
+                f"call_soon_threadsafe or return results for the loop "
+                f"to apply",
+            ))
+    return out
+
+
+def _owned_token(chain: str, attr: str, owner_class: str) -> str | None:
+    parts = chain.split(".")
+    if owner_class in LOOP_OWNED_CLASSES and parts[0] == "self":
+        return owner_class
+    for part in parts:
+        if part in LOOP_OWNED_TOKENS:
+            return part
+    return None
+
+
+def _check_lock_across_await(graph: CallGraph, dm: DomainMap) -> list:
+    out = []
+    for qname, fn in graph.functions.items():
+        for la in fn.lock_awaits:
+            if graph.allowed(
+                fn.relpath, RULE_LOCK_ACROSS_AWAIT.name, la.lineno
+            ):
+                continue
+            out.append(Violation(
+                RULE_LOCK_ACROSS_AWAIT.name, fn.relpath, la.lineno, la.col,
+                f"`with {la.lock}:` in `{_short(qname)}` spans the "
+                f"await at line {la.await_line} — the coroutine parks "
+                f"holding a thread lock, so the worker thread it "
+                f"excludes can stall the whole process; release before "
+                f"awaiting, or copy under the lock and await outside",
+            ))
+    return out
+
+
+def _check_unlocked_shared_write(graph: CallGraph, dm: DomainMap) -> list:
+    out = []
+    # class qname -> attr -> [(fn, write, domains)]
+    per_class: dict[str, dict[str, list]] = {}
+    for qname, fn in graph.functions.items():
+        if fn.cls is None or qname.endswith(".__init__"):
+            continue  # construction happens-before publication
+        doms = dm.domains.get(qname, set())
+        if not doms:
+            continue
+        for w in fn.writes:
+            if w.kind != "store" or not w.attr:
+                continue
+            if not w.chain.startswith("self."):
+                continue
+            per_class.setdefault(fn.cls, {}).setdefault(
+                w.attr, []
+            ).append((fn, w, doms))
+    for cls_q, attrs in per_class.items():
+        cls = graph.classes.get(cls_q)
+        if cls is None or cls.lock_attrs:
+            # a class with a lock attr has a discipline; auditing that
+            # every write honors it is rule 23/22's job and manual
+            # review's — this rule hunts the NO-lock multi-domain class
+            continue
+        for attr, writes in attrs.items():
+            all_domains = set()
+            for _fn, _w, doms in writes:
+                all_domains |= doms
+            if len(all_domains) < 2:
+                continue
+            for fn, w, doms in writes:
+                if w.locked:
+                    continue
+                if graph.allowed(
+                    fn.relpath, RULE_UNLOCKED_SHARED_WRITE.name, w.lineno
+                ):
+                    continue
+                out.append(Violation(
+                    RULE_UNLOCKED_SHARED_WRITE.name, fn.relpath,
+                    w.lineno, w.col,
+                    f"`self.{attr}` is written from "
+                    f"{'/'.join(sorted(all_domains))} domains but "
+                    f"`{_short(cls_q)}` has no lock attribute — a "
+                    f"read-modify-write can lose updates across "
+                    f"threads; add a threading.Lock (the Metrics "
+                    f"registry precedent) or confine writes to one "
+                    f"domain",
+                ))
+    return out
+
+
+def _short(qname: str) -> str:
+    return qname.replace("worldql_server_tpu.", "")
+
+
+# endregion
+
+# region: entry points
+
+
+def check_graph(graph: CallGraph, select: set[str] | None = None) -> list:
+    dm = DomainMap(graph)
+    checks = {
+        RULE_TRANSITIVE_BLOCKING.name: _check_transitive_blocking,
+        RULE_CROSS_DOMAIN_STATE.name: _check_cross_domain_state,
+        RULE_LOCK_ACROSS_AWAIT.name: _check_lock_across_await,
+        RULE_UNLOCKED_SHARED_WRITE.name: _check_unlocked_shared_write,
+    }
+    out: list[Violation] = []
+    for name, check in checks.items():
+        if select and name not in select:
+            continue
+        out.extend(check(graph, dm))
+    return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def check_program_paths(
+    paths: list[str], select: set[str] | None = None, cache: bool = True,
+    scope_prefix: str = "worldql_server_tpu",
+) -> list[Violation]:
+    """The repo-wide interprocedural pass: every package file under
+    the lint paths goes into ONE graph. Files outside ``scope_prefix``
+    (tests, tools) are excluded — the domain model describes the
+    server, not its harnesses."""
+    root = Path.cwd()
+    files = []
+    for f in iter_py_files(paths):
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        if rel.startswith(scope_prefix):
+            files.append(f)
+    if not files:
+        return []
+    summaries = load_summaries(files, root=root, cache=cache)
+    graph = CallGraph(summaries, attr_hints=ATTR_CLASS_HINTS)
+    return check_graph(graph, select=select)
+
+
+def check_program_sources(
+    sources: dict[str, str], select: set[str] | None = None,
+    attr_hints: dict[str, str] | None = None,
+) -> list[Violation]:
+    """Fixture-sized entry: ``{relpath: source}`` → violations. The
+    unit repros in tests/test_check_rules.py run multi-file fixtures
+    through exactly the production resolution + propagation."""
+    summaries = {
+        rel: extract_summary(src, rel) for rel, src in sources.items()
+    }
+    hints = dict(ATTR_CLASS_HINTS)
+    if attr_hints:
+        hints.update(attr_hints)
+    graph = CallGraph(summaries, attr_hints=hints)
+    return check_graph(graph, select=select)
+
+
+# endregion

@@ -185,7 +185,7 @@ class DeliveryPlane:
         self._stopping = True
         for shard in self._shards:
             if shard.alive and shard.ctl is not None:
-                self._ctl_send(shard, {"op": "stop"})
+                await self._actl_send(shard, {"op": "stop"})
         for shard in self._shards:
             proc = shard.proc
             if proc is not None:
@@ -226,26 +226,44 @@ class DeliveryPlane:
 
     # region: control channel
 
+    def _ctl_try(self, shard: _Shard, data: bytes, fds=None) -> str:
+        """One non-blocking send attempt: ``ok`` / ``again`` (buffer
+        full — worker wedged or slow) / ``err`` (socket dead)."""
+        try:
+            if fds:
+                socket.send_fds(shard.ctl, [data], fds)
+            else:
+                shard.ctl.send(data)
+            return "ok"
+        except (BlockingIOError, InterruptedError):
+            return "again"
+        except OSError:
+            return "err"
+
     def _ctl_send(self, shard: _Shard, msg: dict, fds=None) -> bool:
-        """Bounded-retry control send (non-blocking socket; control
-        volume is handshake-rate, so a short spin is fine)."""
+        """Single-shot control send. Every caller runs on the event
+        loop, so this must never wait for the worker: EAGAIN (the
+        worker's control buffer is full — it is wedged or far behind)
+        counts as failure and the caller's degraded path takes over
+        (adopt: the peer stays on the in-process write path; release:
+        the worker's end closes when the slot is reused or the worker
+        dies). ``_actl_send`` is the retrying variant for coroutines."""
+        if shard.ctl is None:
+            return False
+        return self._ctl_try(shard, json.dumps(msg).encode(), fds) == "ok"
+
+    async def _actl_send(self, shard: _Shard, msg: dict, fds=None) -> bool:
+        """Bounded-retry control send for coroutine callers (stop):
+        yields to the loop between attempts instead of blocking it."""
         if shard.ctl is None:
             return False
         data = json.dumps(msg).encode()
         deadline = time.monotonic() + 1.0
         while True:
-            try:
-                if fds:
-                    socket.send_fds(shard.ctl, [data], fds)
-                else:
-                    shard.ctl.send(data)
-                return True
-            except (BlockingIOError, InterruptedError):
-                if time.monotonic() >= deadline:
-                    return False
-                time.sleep(0.005)
-            except OSError:
-                return False
+            status = self._ctl_try(shard, data, fds)
+            if status != "again" or time.monotonic() >= deadline:
+                return status == "ok"
+            await asyncio.sleep(0.005)
 
     async def _reader(self, shard: _Shard) -> None:
         """Drain worker→parent packets; exit means the worker is gone

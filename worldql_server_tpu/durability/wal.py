@@ -160,7 +160,11 @@ class WriteAheadLog:
         self._loop = asyncio.get_running_loop()
         os.makedirs(self.dir, exist_ok=True)
         existing = list_segments(self.dir)
-        self._seq = existing[-1][0] + 1 if existing else 0
+        # single-writer handoff: this loop-side write (and the
+        # _open_segment below) happens strictly BEFORE the writer
+        # thread spawns; Thread.start() publishes it, and from then on
+        # only the worker touches _seq/_file/_size
+        self._seq = existing[-1][0] + 1 if existing else 0  # wql: allow(unlocked-shared-write)
         self._open_segment()
         self._thread = threading.Thread(
             target=self._worker, name="wal-writer", daemon=True
@@ -236,25 +240,30 @@ class WriteAheadLog:
     # region: writer thread
 
     def _open_segment(self) -> None:
+        # reached from both domains but never concurrently: once from
+        # start() before the thread exists (happens-before via
+        # Thread.start()), afterwards only from the worker's _rotate
         path = os.path.join(self.dir, segment_name(self._seq))
-        self._file = open(path, "ab")
+        self._file = open(path, "ab")  # wql: allow(unlocked-shared-write)
         if self._file.tell() == 0:
             self._file.write(MAGIC)
             self._file.flush()
-        self._size = self._file.tell()
+        self._size = self._file.tell()  # wql: allow(unlocked-shared-write)
 
     def _rotate(self) -> None:
         self._file.flush()
         os.fsync(self._file.fileno())
         self._file.close()
-        self._seq += 1
+        # worker-thread only (see _open_segment ownership note)
+        self._seq += 1  # wql: allow(unlocked-shared-write)
         self._open_segment()
 
     def _write_frame(self, frame: bytes) -> None:
         if self._size + len(frame) > self._segment_bytes and self._size > len(MAGIC):
             self._rotate()
         self._file.write(frame)
-        self._size += len(frame)
+        # worker-thread only (see _open_segment ownership note)
+        self._size += len(frame)  # wql: allow(unlocked-shared-write)
 
     def _worker(self) -> None:
         while True:

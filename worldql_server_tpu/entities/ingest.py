@@ -81,14 +81,24 @@ class ColumnarIngest:
             "decode_fallbacks": self.decode_fallbacks,
         }
 
-    async def process_batch(self, datas: list[bytes], slow_route) -> None:
-        """Consume one recv batch. ``slow_route(data)`` is the
+    async def process_batch(self, datas: list[bytes], slow_route,
+                            ctxs: list[tuple[int, int]] | None = None) -> None:
+        """Consume one recv batch. ``slow_route(data, ctx)`` is the
         transport's ordinary single-message path (decode → router);
         per-message errors are contained here exactly like the
-        transport's own loop contains them. Never raises."""
+        transport's own loop contains them. Never raises.
+
+        ``ctxs`` (clustered shards) carries the per-message router
+        trace context the transport stripped off before the native
+        classifier — slow-routed messages get theirs back so the
+        object path still threads ``Message.trace_ctx``; columnar-
+        consumed updates never materialize a Message (same as the
+        single-process fast path) and close the e2e clock in the
+        delivery plane instead."""
         if not self.active:
-            for data in datas:
-                await self._slow(data, slow_route)
+            for i, data in enumerate(datas):
+                await self._slow(data, slow_route,
+                                 ctxs[i] if ctxs else None)
             return
         self.batches += 1
         try:
@@ -106,8 +116,9 @@ class ColumnarIngest:
                 "native entity decode failed — batch of %d messages "
                 "degraded to the object path", len(datas),
             )
-            for data in datas:
-                await self._slow(data, slow_route)
+            for i, data in enumerate(datas):
+                await self._slow(data, slow_route,
+                                 ctxs[i] if ctxs else None)
             return
         run_idx: list[int] = []
         run_senders: list[uuid_mod.UUID] = []
@@ -129,13 +140,18 @@ class ColumnarIngest:
             # per-entity arrival order survives (a removal after an
             # update must see the update already staged)
             self._flush_run(run_idx, run_senders, datas, res)
-            await self._slow(datas[i], slow_route)
+            await self._slow(datas[i], slow_route,
+                             ctxs[i] if ctxs else None)
         self._flush_run(run_idx, run_senders, datas, res)
 
-    async def _slow(self, data: bytes, slow_route) -> None:
+    async def _slow(self, data: bytes, slow_route,
+                    ctx: tuple[int, int] | None = None) -> None:
         self.slow_messages += 1
         try:
-            await slow_route(data)
+            if ctx is not None:
+                await slow_route(data, ctx)
+            else:
+                await slow_route(data)
         except Exception:
             self._contain("error processing inbound message — dropped")
 

@@ -79,17 +79,29 @@ class ScenarioContext:
         self.clients: list[ZmqPeer] = []
 
     async def connect(self, attempts: int = 100, **kwargs) -> ZmqPeer:
-        last: Exception | None = None
+        last: Exception | str | None = None
         for _ in range(attempts):
             try:
                 peer = await ZmqPeer.connect(
                     self.config.zmq_server_port, **kwargs
                 )
-                self.clients.append(peer)
-                return peer
             except Exception as exc:
                 last = exc
                 await asyncio.sleep(0.02)
+                continue
+            if peer.refused:
+                # A shed handshake is NOT a connection: the server
+                # never registered the peer, so every message it sends
+                # from here on is dropped as unknown-sender. Honor the
+                # retry-after hint and try again. (Scenarios probing
+                # refusal semantics use ZmqPeer.connect directly.)
+                last = f"handshake shed, retry-after {peer.retry_after_ms} ms"
+                hint_s = (peer.retry_after_ms or 20) / 1000.0
+                peer.close()
+                await asyncio.sleep(min(hint_s, 0.5))
+                continue
+            self.clients.append(peer)
+            return peer
         raise AssertionError(f"scenario client could not connect: {last!r}")
 
     def counters(self) -> dict:

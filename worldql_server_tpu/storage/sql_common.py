@@ -39,7 +39,12 @@ class LruCache:
             return None
 
     def put(self, key, value) -> None:
-        self._map[key] = value
+        # instances are per-store, never shared across domains: the
+        # postgres store's caches live entirely on the event loop, the
+        # sqlite store's are only touched inside to_thread hops that
+        # its store-wide asyncio.Lock serializes (one hop at a time,
+        # ordering published by the loop's executor handoff)
+        self._map[key] = value  # wql: allow(unlocked-shared-write)
         self._map.move_to_end(key)
         if self.maxsize and len(self._map) > self.maxsize:
             self._map.popitem(last=False)

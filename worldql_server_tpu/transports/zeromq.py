@@ -163,9 +163,24 @@ class ZmqTransport:
                         "error processing inbound zmq message — dropped"
                     )
                 continue
+            # Clustered shards receive router-framed bytes (the WQTX
+            # trace prefix, cluster/tracectx.py). Strip it BEFORE the
+            # native entity classifier — a prefixed buffer fails
+            # classification and the whole batch degrades to the
+            # object path (PR 15's KNOWN GAP, closed here) — and
+            # carry the ctx alongside so slow-routed messages still
+            # thread trace_ctx onto their Message.
+            cluster = getattr(self.server, "cluster", None)
             datas = []
+            ctxs: list[tuple[int, int]] | None = \
+                [] if cluster is not None else None
+            unwrapped = 0
             data = self._flatten(parts, limit)
             if data is not None:
+                if cluster is not None:
+                    trace_id, t_ctx, data = cluster.unwrap(data)
+                    ctxs.append((trace_id, t_ctx))  # wql: allow(unbounded-ingest) — lockstep with datas, same RECV_DRAIN_MAX bound
+                    unwrapped += 1 if trace_id else 0
                 datas.append(data)  # wql: allow(unbounded-ingest) — one message; the drain below is bounded by RECV_DRAIN_MAX
             while len(datas) < RECV_DRAIN_MAX:
                 try:
@@ -174,10 +189,19 @@ class ZmqTransport:
                     break
                 data = self._flatten(parts, limit)
                 if data is not None:
+                    if cluster is not None:
+                        trace_id, t_ctx, data = cluster.unwrap(data)
+                        ctxs.append((trace_id, t_ctx))  # wql: allow(unbounded-ingest) — lockstep with datas, same RECV_DRAIN_MAX bound
+                        unwrapped += 1 if trace_id else 0
                     datas.append(data)  # wql: allow(unbounded-ingest) — bounded by RECV_DRAIN_MAX; admission happens in ColumnarIngest/router
+            if unwrapped:
+                # the fast-path-through-router proof: router-framed
+                # messages reaching the columnar batch pre-unwrapped
+                self.server.metrics.inc("zmq.ctx_unwrapped", unwrapped)
             if datas:
                 # contains per message internally; never raises
-                await fast.process_batch(datas, self._route_data)
+                await fast.process_batch(datas, self._route_data,
+                                         ctxs=ctxs)
 
     def _flatten(self, parts: list[bytes], limit: int) -> bytes | None:
         """Bound + join one multipart message (None = dropped).
@@ -200,28 +224,36 @@ class ZmqTransport:
         if data is not None:
             await self._route_data(data)
 
-    async def _route_data(self, data: bytes) -> None:
+    async def _route_data(self, data: bytes,
+                          ctx: tuple[int, int] | None = None) -> None:
         tracer = getattr(self.server, "tracer", None)
         if tracer is not None and tracer.enabled:
             # recv→decode→route under one span tree: the decode and the
             # router's handle span nest inside "zmq.recv", so a slow
             # inbound message shows WHERE it spent its wall time
             with tracer.span("zmq.recv", bytes=len(data)) as rspan:
-                await self._decode_route(data, tracer, rspan)
+                await self._decode_route(data, tracer, rspan, ctx=ctx)
         else:
-            await self._decode_route(data, None)
+            await self._decode_route(data, None, ctx=ctx)
 
-    async def _decode_route(self, data: bytes, tracer, rspan=None) -> None:
+    async def _decode_route(self, data: bytes, tracer, rspan=None,
+                            ctx: tuple[int, int] | None = None) -> None:
         # Cluster shards receive every message through the router,
         # which frames a trace context on (cluster/tracectx.py):
         # strip it BEFORE the codec (fan-out re-broadcasts the
         # unwrapped bytes) and thread it onto the Message so delivery
         # closes the router-ingress clock at socket-write-complete.
-        # Non-cluster servers pay one attribute test.
-        cluster = getattr(self.server, "cluster", None)
-        trace_id = t_ctx = 0
-        if cluster is not None:
-            trace_id, t_ctx, data = cluster.unwrap(data)
+        # The columnar recv loop unwraps pre-batch (the native
+        # classifier needs bare wire bytes) and passes the ctx in;
+        # the per-message path unwraps here. Non-cluster servers pay
+        # one attribute test.
+        if ctx is not None:
+            trace_id, t_ctx = ctx
+        else:
+            cluster = getattr(self.server, "cluster", None)
+            trace_id = t_ctx = 0
+            if cluster is not None:
+                trace_id, t_ctx, data = cluster.unwrap(data)
         try:
             failpoints.fire("codec.decode")
             if tracer is not None:
