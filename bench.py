@@ -3654,13 +3654,243 @@ def bench_config11(args) -> dict:
     }
 
 
+def _kind_cols(rng, m: int, kind_id: int):
+    """→ (kinds i8 [m], params f64 [m, 6]) staged columns for one kind,
+    parameters drawn exactly as the wire parsers clamp them (cube 16,
+    stencil 3, ray steps 64)."""
+    from worldql_server_tpu.queries.kinds import (
+        KIND_CONE, KIND_DENSITY, KIND_KNN, KIND_RAYCAST, PARAM_LANES,
+        RAY_ALL_HITS, RAY_FIRST_HIT,
+    )
+
+    kinds = np.full(m, kind_id, np.int8)
+    params = np.zeros((m, PARAM_LANES), np.float64)
+    if kind_id in (KIND_CONE, KIND_RAYCAST):
+        d = rng.normal(size=(m, 3))
+        d /= np.linalg.norm(d, axis=1, keepdims=True)
+        params[:, 0:3] = d
+    if kind_id == KIND_CONE:
+        params[:, 3] = np.cos(np.radians(rng.uniform(20.0, 80.0, m)))
+        params[:, 4] = rng.uniform(12.0, 48.0, m)
+    elif kind_id == KIND_RAYCAST:
+        params[:, 3] = rng.uniform(16.0, 64.0, m)          # max_t
+        params[:, 4] = np.where(
+            rng.random(m) < 0.5, RAY_FIRST_HIT, RAY_ALL_HITS
+        )
+    elif kind_id == KIND_KNN:
+        params[:, 0] = rng.integers(1, 12, m).astype(np.float64)
+        params[:, 1] = rng.uniform(12.0, 48.0, m)          # max_range
+    elif kind_id == KIND_DENSITY:
+        params[:, 0] = rng.integers(1, 3, m).astype(np.float64)
+        params[:, 1] = 8.0                                 # top_n
+    return kinds, params
+
+
+def _query_results_match(got, want) -> bool:
+    """Lane-for-lane result equality across the two collect shapes:
+    KindResult triples for library kinds, peer sets for radius rows
+    (radius peer ORDER is an index-layout artifact on both paths)."""
+    from worldql_server_tpu.queries.results import KindResult
+
+    if isinstance(got, KindResult) or isinstance(want, KindResult):
+        return (
+            isinstance(got, KindResult)
+            and isinstance(want, KindResult)
+            and got.kind == want.kind
+            and list(got.peers) == list(want.peers)
+            and got.extra == want.extra
+        )
+    return set(got) == set(want)
+
+
+def bench_config12(args) -> dict:
+    """Spatial query library (ISSUE 17): per-kind device throughput of
+    the staged kind pipeline (cone / raycast / filtered-kNN / density
+    expanded into probe rows riding the radius hash-probe), the
+    mixed-kind batch's p50/p99 next to a pure-radius batch of the SAME
+    size (the cost of carrying the library), and CPU-oracle parity
+    sampled across every kind in the mixed batch. ``--smoke`` asserts
+    the kind-expansion path actually fired, parity held on every
+    sampled lane, and the timed window re-traced nothing after the
+    boot tier walk (precompile.py's kind leg). The gate leaves are the
+    parity/retrace COUNTS — the rates are 1-core-bound and pruned from
+    the checked-in baseline."""
+    from worldql_server_tpu.queries.kinds import (
+        KIND_CONE, KIND_DENSITY, KIND_KNN, KIND_RADIUS, KIND_RAYCAST,
+        PARAM_LANES,
+    )
+    from worldql_server_tpu.spatial.backend import LocalQuery
+    from worldql_server_tpu.spatial.cpu_backend import CpuSpatialBackend
+    from worldql_server_tpu.spatial.precompile import precompile_tiers
+    from worldql_server_tpu.spatial.tpu_backend import TpuSpatialBackend
+    from worldql_server_tpu.utils.retrace import GUARD
+    from worldql_server_tpu.protocol.types import Replication, Vector3
+
+    n_worlds = 4
+    m = min(args.queries, 512 if args.quick else 4096)
+    reps = 5 if args.quick else 11
+    rng = np.random.default_rng(17)
+    tpu = TpuSpatialBackend(cube_size=16)
+    peers, sub_positions, sub_world_ids = build_index(
+        tpu, rng, args.subs, n_worlds
+    )
+    tpu.flush()
+    tpu.wait_compaction()
+
+    # staged columns, interned exactly as engine/staging.py encodes
+    senders = rng.integers(0, len(peers), m)
+    wid_col = np.fromiter(
+        (tpu._world_ids.get(f"world_{w}", -1)
+         for w in sub_world_ids[senders]),
+        np.int32, count=m,
+    )
+    sid_col = np.fromiter(
+        (tpu._peer_ids.get(peers[s], -1) for s in senders),
+        np.int32, count=m,
+    )
+    pos_col = np.ascontiguousarray(sub_positions[senders], np.float64)
+    repl_col = np.full(m, int(Replication.EXCEPT_SELF), np.int8)
+
+    kind_ids = {
+        "cone": KIND_CONE, "raycast": KIND_RAYCAST,
+        "knn": KIND_KNN, "density": KIND_DENSITY,
+    }
+    pure = {
+        name: _kind_cols(rng, m, kid) for name, kid in kind_ids.items()
+    }
+    # mixed batch: every kind plus a radius share, interleaved
+    mixed_kinds = np.zeros(m, np.int8)
+    mixed_params = np.zeros((m, PARAM_LANES), np.float64)
+    lanes = [KIND_RADIUS, *kind_ids.values()]
+    for j, kid in enumerate(lanes):
+        sel = np.flatnonzero(np.arange(m) % len(lanes) == j)
+        mixed_kinds[sel] = kid
+        if kid != KIND_RADIUS:
+            _, p = _kind_cols(rng, sel.size, kid)
+            mixed_params[sel] = p
+
+    def run_once(kinds, params):
+        t0 = time.perf_counter()
+        out = tpu.collect_local_batch(
+            tpu.dispatch_staged_batch(
+                wid_col, pos_col, sid_col, repl_col, kinds, params
+            )
+        )
+        return out, (time.perf_counter() - t0) * 1e3
+
+    # discovery pass: kind expansion turns m queries into (many more)
+    # probe rows, and THOSE are the tiers the radius pipeline runs at —
+    # size the boot walk to the largest probe batch, not to m
+    probe_rows = m
+    for kinds, params in (*pure.values(), (mixed_kinds, mixed_params)):
+        handle = tpu.dispatch_staged_batch(
+            wid_col, pos_col, sid_col, repl_col, kinds, params
+        )
+        probe_rows = max(
+            probe_rows, int(handle[1][1].probe_owner.shape[0])
+        )
+        tpu.collect_local_batch(handle)
+    pc_stats = precompile_tiers(
+        tpu, max_batch=probe_rows, t_tiers=2, max_compiles=128
+    )
+    log(f"tier precompile (probe tier {probe_rows}): {pc_stats}")
+    for kinds, params in (*pure.values(), (mixed_kinds, mixed_params),
+                          (None, None)):
+        run_once(kinds, params)        # warm every shape once
+        run_once(kinds, params)
+    guard_before = GUARD.snapshot()
+
+    per_kind = {}
+    for name, (kinds, params) in pure.items():
+        walls = [run_once(kinds, params)[1] for _ in range(reps)]
+        wall = float(np.median(walls))
+        per_kind[name] = {
+            "device_queries_per_s": round(m / (wall / 1e3)),
+            "wall_ms": round(wall, 3),
+        }
+        log(f"{name}: {wall:.2f} ms/batch "
+            f"({per_kind[name]['device_queries_per_s']:,}/s)")
+    mixed_out, _ = run_once(mixed_kinds, mixed_params)
+    mixed_walls = np.array(
+        [run_once(mixed_kinds, mixed_params)[1] for _ in range(reps)]
+    )
+    radius_walls = np.array(
+        [run_once(None, None)[1] for _ in range(reps)]
+    )
+    retrace_delta = GUARD.delta(guard_before)
+    retraces = sum(retrace_delta.values())
+    log(f"mixed: p50 {pctl(mixed_walls, 50):.2f} p99 "
+        f"{pctl(mixed_walls, 99):.2f} ms  radius: p50 "
+        f"{pctl(radius_walls, 50):.2f} p99 {pctl(radius_walls, 99):.2f} "
+        f"ms  retraces {retraces} {retrace_delta or ''}")
+
+    # CPU-oracle parity, stratified across every kind in the mixed
+    # batch (the randomized property suite in tests/test_queries.py is
+    # the exhaustive version; this pins the BENCH shapes)
+    cpu = CpuSpatialBackend(cube_size=16)
+    build_index(cpu, np.random.default_rng(17), args.subs, n_worlds)
+    parity = {name: True for name in ("radius", *kind_ids)}
+    by_id = {0: "radius", **{v: k for k, v in kind_ids.items()}}
+    sample = []
+    for kid in (KIND_RADIUS, *kind_ids.values()):
+        sample.extend(np.flatnonzero(mixed_kinds == kid)[:12])
+    for i in sample:
+        want = cpu.match_local_batch([
+            LocalQuery(
+                f"world_{sub_world_ids[senders[i]]}",
+                Vector3(*pos_col[i]),
+                peers[senders[i]],
+                Replication.EXCEPT_SELF,
+                kind=int(mixed_kinds[i]),
+                params=tuple(mixed_params[i]),
+            )
+        ])[0]
+        if not _query_results_match(mixed_out[i], want):
+            parity[by_id[int(mixed_kinds[i])]] = False
+            log(f"parity diverged: query {i} kind {mixed_kinds[i]}: "
+                f"{mixed_out[i]!r} vs {want!r}")
+    parity_failures = sum(1 for ok in parity.values() if not ok)
+    log(f"parity: {parity_failures} failure(s) across "
+        f"{len(sample)} sampled lanes {parity}")
+
+    if args.smoke:
+        assert tpu.kind_expansions > 0, \
+            "smoke: the kind-expansion path never fired"
+        assert parity_failures == 0, \
+            f"smoke: kind results diverged from the CPU oracle: {parity}"
+        assert retraces == 0, (
+            "smoke: the timed window re-traced despite the kind tier "
+            f"walk: {retrace_delta}"
+        )
+        log(f"smoke: {tpu.kind_expansions} kind expansions, parity "
+            f"green on every kind, retraces {retraces}")
+    return {
+        "metric": "query_parity_failures",
+        "value": parity_failures,
+        "unit": "count",
+        "parity_failures": parity_failures,
+        "parity": {k: int(v) for k, v in parity.items()},
+        "retraces": retraces,
+        "kind_expansions": int(tpu.kind_expansions),
+        "kinds": per_kind,
+        "mixed_p50_ms": round(pctl(mixed_walls, 50), 3),
+        "mixed_p99_ms": round(pctl(mixed_walls, 99), 3),
+        "radius_p50_ms": round(pctl(radius_walls, 50), 3),
+        "radius_p99_ms": round(pctl(radius_walls, 99), 3),
+        "mixed_over_radius": round(
+            float(np.median(mixed_walls) / np.median(radius_walls)), 2
+        ),
+        "config": 12,
+    }
+
+
 # --------------------------------------------------------------------
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -3676,7 +3906,10 @@ def main() -> None:
                          "(1→N shard server processes behind the "
                          "router tier: admitted msgs/s + cross-shard "
                          "p99 per point, exact router/shard shed "
-                         "audit)")
+                         "audit); 12 = query_library (per-kind "
+                         "cone/raycast/kNN/density device throughput, "
+                         "mixed-kind batch p50/p99 vs a pure-radius "
+                         "batch of the same size, CPU-oracle parity)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -3715,14 +3948,14 @@ def main() -> None:
         1: bench_config1, 2: bench_config2, 3: bench_config3,
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
-        10: bench_config10, 11: bench_config11,
+        10: bench_config10, 11: bench_config11, 12: bench_config12,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12]
     else:
         selected = [args.config or 5]
     for n in selected:

@@ -121,13 +121,13 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
     assert main([str(baseline), str(baseline), *gate]) == 0
 
-    # JSON-lines baseline: one record per smoke config (5+8+9+10+11)
+    # JSON-lines baseline: one record per smoke config (5+8+9+10+11+12)
     records = [
         json.loads(line)
         for line in baseline.read_text().splitlines() if line.strip()
     ]
     by_config = {rec["config"]: rec for rec in records}
-    assert set(by_config) == {5, 8, 9, 10, 11}
+    assert set(by_config) == {5, 8, 9, 10, 11, 12}
     # config 9's gate leaves are the admission RATES; the volatile
     # fsync-bound record p99s are pruned from the baseline on purpose
     # (the bench still reports them) — pin that they stay pruned
@@ -240,6 +240,31 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(broken_audit), *gate]) == 1
+
+    # the ISSUE 17 query-library gate: the config-12 baseline keeps
+    # ONLY the parity/retrace counts (per-kind device_queries_per_s and
+    # the mixed/radius percentiles are 1-core-bound and pruned — the
+    # bench still reports them), and a single diverged kind — or a
+    # quiet retrace in the timed window — flags on its own
+    # ("failures"/"retraces" are lower-is-better; 0 -> 1 crosses the
+    # --min-abs floor)
+    assert by_config[12]["parity_failures"] == 0
+    assert by_config[12]["retraces"] == 0
+    assert all(by_config[12]["parity"].values())
+    no_timing_leaves(by_config[12])
+    for key in ("kinds", "mixed_over_radius", "kind_expansions"):
+        assert key not in by_config[12], key
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 12:
+            rec["parity_failures"] = 1
+            rec["value"] = 1
+            rec["parity"]["knn"] = 0
+    diverged = tmp_path / "diverged_kind.json"
+    diverged.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(diverged), *gate]) == 1
 
 
 def test_cluster_observability_leaves_gate_structurally(tmp_path):

@@ -841,6 +841,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "full-fetch-on-tick",
         "full-rebuild-on-tick",
         "per-query-python-loop",
+        "unregistered-query-kind",
         "host-sync-in-sim-tick",
         "store-on-loop",
         "unspanned-stage",
@@ -955,6 +956,83 @@ def test_per_query_loop_pragma_allows_designated_paths():
     assert violations(
         src, relpath=_SPATIAL, select="per-query-python-loop"
     ) == []
+
+
+_QUERIES = "worldql_server_tpu/queries/expand.py"
+
+
+def test_per_query_loop_fires_in_queries_expand_over_kind_columns():
+    # the ISSUE 17 extension: queries/*.py dispatch functions are in
+    # scope, and the staged `kinds`/`params` columns count as the
+    # query batch — a per-row loop over either is the same O(m)
+    # host-encode wall the rule exists to kill
+    src = """
+    def expand_staged(world_ids, positions, sender_ids, repls,
+                      kinds, params, *, cube_size):
+        rows = []
+        for k in kinds:
+            rows.append(int(k))
+        lanes = [p[0] for p in params]
+        return rows, lanes
+    """
+    got = violations(src, relpath=_QUERIES, select="per-query-python-loop")
+    assert len(got) == 2  # the kinds loop AND the params comprehension
+
+
+def test_per_query_loop_quiet_on_vectorized_expand_and_fold():
+    vectorized = """
+    import numpy as np
+
+    def expand_staged(world_ids, positions, sender_ids, repls,
+                      kinds, params, *, cube_size):
+        idx = np.flatnonzero(kinds == 1)
+        return idx, params[idx]
+    """
+    assert violations(
+        vectorized, relpath=_QUERIES, select="per-query-python-loop"
+    ) == []
+    # the fold is collect-side per-RESULT assembly (like the radius
+    # path's list building) — deliberately out of scope
+    fold = """
+    def fold_collected(plan, probe_targets):
+        return [sorted(t) for t in probe_targets]
+    """
+    assert violations(
+        fold, relpath=_QUERIES, select="per-query-python-loop"
+    ) == []
+
+
+# endregion
+
+
+# region: unregistered-query-kind (ISSUE 17)
+
+
+def test_unregistered_kind_fires_on_typoed_wire_literal():
+    src = """
+    CONE_WIRE = "query.cnoe"
+    """
+    assert violations(src, select="unregistered-query-kind") == [
+        ("unregistered-query-kind", 2)
+    ]
+
+
+def test_unregistered_kind_quiet_on_registered_wires_and_replies():
+    src = """
+    REQUESTS = ["query.cone", "query.raycast", "query.knn",
+                "query.density"]
+    REPLY = "query.knn.result"
+    OTHER = "queries.malformed"   # metric name, not the wire shape
+    PROSE = "send a query.cone request"  # not a bare literal
+    """
+    assert violations(src, select="unregistered-query-kind") == []
+
+
+def test_unregistered_kind_pragma_allows_negative_test_literals():
+    src = """
+    BAD = "query.bogus"  # wql: allow(unregistered-query-kind)
+    """
+    assert violations(src, select="unregistered-query-kind") == []
 
 
 # endregion

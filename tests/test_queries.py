@@ -1,0 +1,680 @@
+"""Spatial query library (ISSUE 17): lane-for-lane parity of the
+device kind pipeline (cone / raycast / filtered-kNN / density probe
+expansion riding the staged radius dispatch) against the CPU oracles
+in queries/oracle.py — randomized worlds, replication modes, empty
+results and overflow shapes; a mixed-kind batch in ONE tick; delta-
+tick reuse parity per kind (reuse happens at probe granularity);
+ResilientBackend degradation answering kind queries through the
+mirror oracles on both the dispatch and the collect leg; the retrace
+GUARD pin on precompile.py's kind tier walk; and one e2e real-ZMQ
+test per wire instruction (query.cone / query.raycast / query.knn /
+query.density → .result reply frames), on the CPU backend so tier-1
+pays no jit wall — the tpu-backend wire legs live in the sniper_scope
+and projectile_storm scenarios."""
+
+import asyncio
+import json
+import uuid as uuid_mod
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tests.client_util import ZmqClient, free_port            # noqa: E402
+from worldql_server_tpu.engine.config import Config           # noqa: E402
+from worldql_server_tpu.engine.server import WorldQLServer    # noqa: E402
+from worldql_server_tpu.protocol import (                     # noqa: E402
+    Instruction, Message, Vector3,
+)
+from worldql_server_tpu.protocol.types import Replication     # noqa: E402
+from worldql_server_tpu.queries.kinds import (                # noqa: E402
+    KIND_CONE, KIND_DENSITY, KIND_KNN, KIND_RADIUS, KIND_RAYCAST,
+    PARAM_LANES, RAY_ALL_HITS, RAY_FIRST_HIT,
+)
+from worldql_server_tpu.queries.results import KindResult     # noqa: E402
+from worldql_server_tpu.robustness import failpoints          # noqa: E402
+from worldql_server_tpu.robustness.resilient import (         # noqa: E402
+    ResilientBackend,
+)
+from worldql_server_tpu.spatial.backend import LocalQuery     # noqa: E402
+from worldql_server_tpu.spatial.cpu_backend import (          # noqa: E402
+    CpuSpatialBackend,
+)
+from worldql_server_tpu.spatial.quantize import (             # noqa: E402
+    cube_coords_batch,
+)
+from worldql_server_tpu.spatial.tpu_backend import (          # noqa: E402
+    TpuSpatialBackend,
+)
+from worldql_server_tpu.utils.retrace import GUARD            # noqa: E402
+
+CUBE = 16
+#: distinct sub-count from every other suite so this module's segment
+#: shapes compile fresh inside a shared pytest process
+N_SUBS = 93
+N_WORLDS = 3
+KIND_IDS = {
+    "cone": KIND_CONE, "raycast": KIND_RAYCAST,
+    "knn": KIND_KNN, "density": KIND_DENSITY,
+}
+
+
+# ------------------------------------------------------------------
+# index + staged-column helpers (the bench_config12 idiom, scaled to
+# tier-1 budgets)
+
+
+def _build_index(backend, rng, n_subs, n_worlds):
+    positions = rng.uniform(-56.0, 56.0, (n_subs, 3))
+    cubes = cube_coords_batch(positions, backend.cube_size)
+    peers = [uuid_mod.UUID(int=i + 1) for i in range(n_subs)]
+    world_ids = np.arange(n_subs) * n_worlds // n_subs
+    for w in range(n_worlds):
+        sel = world_ids == w
+        backend.bulk_add_subscriptions(
+            f"world_{w}", [peers[i] for i in np.flatnonzero(sel)],
+            cubes[sel],
+        )
+    return peers, positions, world_ids
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """One (device, oracle) backend pair over identical indexes,
+    shared across the parity tests — the kind kernels compile once."""
+    rng = np.random.default_rng(170)
+    tpu = TpuSpatialBackend(cube_size=CUBE)
+    peers, positions, world_ids = _build_index(
+        tpu, rng, N_SUBS, N_WORLDS
+    )
+    tpu.flush()
+    tpu.wait_compaction()
+    cpu = CpuSpatialBackend(cube_size=CUBE)
+    _build_index(cpu, np.random.default_rng(170), N_SUBS, N_WORLDS)
+    return tpu, cpu, peers, positions, world_ids
+
+
+def _staged_cols(tpu, peers, positions, world_ids, senders, rng,
+                 *, n_empty=4):
+    """Staged columns exactly as engine/staging.py interns them, with
+    replication lanes randomized across all three modes and the LAST
+    ``n_empty`` rows teleported far outside the index (empty-result
+    coverage on every kind)."""
+    m = len(senders)
+    wid = np.fromiter(
+        (tpu._world_ids.get(f"world_{w}", -1)
+         for w in world_ids[senders]),
+        np.int32, count=m,
+    )
+    sid = np.fromiter(
+        (tpu._peer_ids.get(peers[s], -1) for s in senders),
+        np.int32, count=m,
+    )
+    pos = np.ascontiguousarray(positions[senders], np.float64)
+    repl = rng.integers(0, 3, m).astype(np.int8)
+    if n_empty:
+        pos[-n_empty:] += 4000.0
+    return wid, pos, sid, repl
+
+
+def _kind_cols(rng, m, kind_id):
+    """Parameter lanes drawn exactly as the wire parsers clamp them
+    (cube 16, stencil 3, ray steps 64), plus deliberate overflow
+    shapes: a kNN k far above the index population and cone/density
+    reaches at the stencil clamp."""
+    kinds = np.full(m, kind_id, np.int8)
+    params = np.zeros((m, PARAM_LANES), np.float64)
+    if kind_id in (KIND_CONE, KIND_RAYCAST):
+        d = rng.normal(size=(m, 3))
+        d /= np.linalg.norm(d, axis=1, keepdims=True)
+        params[:, 0:3] = d
+    if kind_id == KIND_CONE:
+        params[:, 3] = np.cos(np.radians(rng.uniform(15.0, 175.0, m)))
+        params[:, 4] = rng.uniform(8.0, 3 * CUBE, m)
+        params[0, 4] = 3 * CUBE          # full stencil reach
+    elif kind_id == KIND_RAYCAST:
+        params[:, 3] = rng.uniform(16.0, 64.0 * CUBE / 2, m)
+        params[:, 4] = np.where(
+            rng.random(m) < 0.5, RAY_FIRST_HIT, RAY_ALL_HITS
+        )
+        params[0, 4] = RAY_ALL_HITS
+        params[1, 4] = RAY_FIRST_HIT
+    elif kind_id == KIND_KNN:
+        params[:, 0] = rng.integers(1, 12, m).astype(np.float64)
+        params[:, 1] = rng.uniform(12.0, 48.0, m)
+        params[0, 0] = 256.0             # overflow: k >> population
+        params[0, 1] = 4000.0
+        params[1, 0] = 1.0
+    elif kind_id == KIND_DENSITY:
+        params[:, 0] = rng.integers(0, 4, m).astype(np.float64)
+        params[:, 1] = rng.integers(1, 9, m).astype(np.float64)
+        params[0, 0] = 3.0               # stencil-clamp extent
+    return kinds, params
+
+
+def _mixed_cols(rng, m):
+    """The mixed one-tick batch: every kind plus a radius share,
+    interleaved ``% 5`` exactly like the serving shape bench pins."""
+    kinds = np.zeros(m, np.int8)
+    params = np.zeros((m, PARAM_LANES), np.float64)
+    lanes = [KIND_RADIUS, *KIND_IDS.values()]
+    for j, kid in enumerate(lanes):
+        sel = np.flatnonzero(np.arange(m) % len(lanes) == j)
+        kinds[sel] = kid
+        if kid != KIND_RADIUS:
+            _, p = _kind_cols(rng, sel.size, kid)
+            params[sel] = p
+    return kinds, params
+
+
+def _oracle_row(cpu, peers, positions, world_ids, senders,
+                pos, repl, kinds, params, i):
+    return cpu.match_local_batch([
+        LocalQuery(
+            f"world_{world_ids[senders[i]]}",
+            Vector3(*pos[i]),
+            peers[senders[i]],
+            Replication(int(repl[i])),
+            kind=int(kinds[i]) if kinds is not None else 0,
+            params=tuple(params[i]) if params is not None else (),
+        )
+    ])[0]
+
+
+def _rows_match(got, want):
+    """KindResult field equality for library kinds; radius rows as
+    peer SETS (radius order is an index-layout artifact)."""
+    if isinstance(got, KindResult) or isinstance(want, KindResult):
+        return (
+            isinstance(got, KindResult)
+            and isinstance(want, KindResult)
+            and got.kind == want.kind
+            and list(got.peers) == list(want.peers)
+            and got.extra == want.extra
+        )
+    return set(got) == set(want)
+
+
+def _assert_parity(pair_t, senders, pos, repl, kinds, params, out):
+    tpu, cpu, peers, positions, world_ids = pair_t
+    for i in range(len(senders)):
+        want = _oracle_row(
+            cpu, peers, positions, world_ids, senders,
+            pos, repl, kinds, params, i,
+        )
+        assert _rows_match(out[i], want), (
+            f"row {i} (kind "
+            f"{int(kinds[i]) if kinds is not None else 0}, repl "
+            f"{int(repl[i])}) diverged:\n  device {out[i]!r}\n  "
+            f"oracle {want!r}"
+        )
+
+
+# ------------------------------------------------------------------
+# property suite: per-kind parity, randomized worlds / replication /
+# empty results / overflow
+
+
+@pytest.mark.parametrize("name", sorted(KIND_IDS))
+def test_kind_parity_vs_oracle(pair, name):
+    tpu, cpu, peers, positions, world_ids = pair
+    seed = {"cone": 11, "raycast": 12, "knn": 13, "density": 14}[name]
+    rng = np.random.default_rng(seed)
+    m = 24
+    senders = rng.integers(0, N_SUBS, m)
+    wid, pos, sid, repl = _staged_cols(
+        tpu, peers, positions, world_ids, senders, rng
+    )
+    kinds, params = _kind_cols(rng, m, KIND_IDS[name])
+    out = tpu.collect_local_batch(
+        tpu.dispatch_staged_batch(wid, pos, sid, repl, kinds, params)
+    )
+    assert len(out) == m
+    assert all(isinstance(r, KindResult) for r in out)
+    _assert_parity(pair, senders, pos, repl, kinds, params, out)
+    # the teleported tail really exercised the empty shape
+    assert all(list(r.peers) == [] for r in out[-4:])
+
+
+def test_mixed_kind_batch_one_tick(pair):
+    """All five kinds interleaved in ONE staged dispatch — a single
+    kind expansion, every row lane-for-lane with its oracle."""
+    tpu, cpu, peers, positions, world_ids = pair
+    rng = np.random.default_rng(15)
+    m = 40
+    senders = rng.integers(0, N_SUBS, m)
+    wid, pos, sid, repl = _staged_cols(
+        tpu, peers, positions, world_ids, senders, rng, n_empty=5
+    )
+    kinds, params = _mixed_cols(rng, m)
+    expansions_before = tpu.kind_expansions
+    out = tpu.collect_local_batch(
+        tpu.dispatch_staged_batch(wid, pos, sid, repl, kinds, params)
+    )
+    assert tpu.kind_expansions == expansions_before + 1
+    _assert_parity(pair, senders, pos, repl, kinds, params, out)
+    # radius rows stayed plain peer lists, kind rows KindResults
+    for i in range(m):
+        assert isinstance(out[i], KindResult) == (kinds[i] != 0)
+
+
+def test_all_zero_kind_column_is_pure_radius(pair):
+    """``kinds`` of all zeros must take the radius pipeline byte for
+    byte — no expansion, identical fan-out to ``kinds=None``."""
+    tpu, cpu, peers, positions, world_ids = pair
+    rng = np.random.default_rng(16)
+    m = 24
+    senders = rng.integers(0, N_SUBS, m)
+    wid, pos, sid, repl = _staged_cols(
+        tpu, peers, positions, world_ids, senders, rng, n_empty=0
+    )
+    expansions_before = tpu.kind_expansions
+    plain = tpu.collect_local_batch(
+        tpu.dispatch_staged_batch(wid, pos, sid, repl)
+    )
+    zeroed = tpu.collect_local_batch(
+        tpu.dispatch_staged_batch(
+            wid, pos, sid, repl,
+            np.zeros(m, np.int8), np.zeros((m, PARAM_LANES), np.float64),
+        )
+    )
+    assert tpu.kind_expansions == expansions_before
+    assert [set(r) for r in zeroed] == [set(r) for r in plain]
+
+
+def test_list_path_kind_dispatch_parity(pair):
+    """The object-list dispatch path (ticker fallback windows) routes
+    kind queries through the same expansion."""
+    tpu, cpu, peers, positions, world_ids = pair
+    rng = np.random.default_rng(17)
+    m = 10
+    senders = rng.integers(0, N_SUBS, m)
+    kinds, params = _kind_cols(rng, m, KIND_CONE)
+    queries = [
+        LocalQuery(
+            f"world_{world_ids[senders[i]]}",
+            Vector3(*positions[senders[i]]),
+            peers[senders[i]],
+            Replication.EXCEPT_SELF,
+            kind=int(kinds[i]),
+            params=tuple(params[i]),
+        )
+        for i in range(m)
+    ]
+    out = tpu.collect_local_batch(tpu.dispatch_local_batch(queries))
+    want = cpu.match_local_batch(queries)
+    for i in range(m):
+        assert _rows_match(out[i], want[i]), (
+            f"list-path row {i}: {out[i]!r} vs {want[i]!r}"
+        )
+
+
+# ------------------------------------------------------------------
+# delta-tick reuse: kind batches are content-addressed at PROBE
+# granularity, so a repeated cone replays its cached cubes
+
+
+def test_delta_tick_reuse_parity_per_kind(pair):
+    tpu, cpu, peers, positions, world_ids = pair
+    if not tpu.supports_delta_ticks():
+        pytest.skip("backend cannot serve delta ticks")
+    assert tpu.configure_delta_ticks("on")
+    try:
+        rng = np.random.default_rng(18)
+        m = 12
+        for name, kid in sorted(KIND_IDS.items()):
+            senders = rng.integers(0, N_SUBS, m)
+            wid, pos, sid, repl = _staged_cols(
+                tpu, peers, positions, world_ids, senders, rng,
+                n_empty=2,
+            )
+            kinds, params = _kind_cols(rng, m, kid)
+
+            def run():
+                return tpu.collect_local_batch(
+                    tpu.dispatch_staged_batch(
+                        wid, pos, sid, repl, kinds, params
+                    )
+                )
+
+            first = run()
+            reused_before = tpu.delta_reused
+            second = run()
+            stats = tpu.last_delta_stats
+            assert tpu.delta_reused > reused_before, (
+                f"{name}: repeated kind batch replayed nothing "
+                f"({stats})"
+            )
+            assert stats["reused"] > 0 and stats["recomputed"] == 0, (
+                f"{name}: probe rows were not content-addressed: "
+                f"{stats}"
+            )
+            for i in range(m):
+                assert _rows_match(second[i], first[i]), (
+                    f"{name}: reuse changed row {i}: {second[i]!r} "
+                    f"vs {first[i]!r}"
+                )
+            _assert_parity(
+                pair, senders, pos, repl, kinds, params, second
+            )
+    finally:
+        tpu.configure_delta_ticks("off")
+
+
+# ------------------------------------------------------------------
+# ResilientBackend degradation: kind queries answered through the CPU
+# mirror's oracles on both failure legs
+
+
+def _resilient_fixture(n_subs=24):
+    inner = TpuSpatialBackend(cube_size=CUBE)
+    backend = ResilientBackend(inner, failover_after=5)
+    rng = np.random.default_rng(19)
+    positions = rng.uniform(-40.0, 40.0, (n_subs, 3))
+    cubes = cube_coords_batch(positions, CUBE)
+    peers = [uuid_mod.UUID(int=0x1000 + i) for i in range(n_subs)]
+    backend.bulk_add_subscriptions("world_0", peers, cubes)
+    inner.flush()
+    inner.wait_compaction()
+    oracle = CpuSpatialBackend(cube_size=CUBE)
+    oracle.bulk_add_subscriptions("world_0", peers, cubes)
+    return backend, oracle, peers, positions
+
+
+def test_resilient_degradation_answers_kinds_via_mirror():
+    """Failpoints on both legs of the two-phase batch: the staged kind
+    dispatch (and its collect) degrade to the ticker's retained
+    fallback pairs resolved through the mirror — identical oracle
+    semantics, session-invisible."""
+    backend, oracle, peers, positions = _resilient_fixture()
+    rng = np.random.default_rng(20)
+    m = 10
+    senders = rng.integers(0, len(peers), m)
+    wid = np.fromiter(
+        (backend.inner._world_ids.get("world_0", -1) for _ in senders),
+        np.int32, count=m,
+    )
+    sid = np.fromiter(
+        (backend.inner._peer_ids.get(peers[s], -1) for s in senders),
+        np.int32, count=m,
+    )
+    pos = np.ascontiguousarray(positions[senders], np.float64)
+    repl = np.zeros(m, np.int8)
+    kinds, params = _mixed_cols(rng, m)
+    fallback = [
+        (None, LocalQuery(
+            "world_0", Vector3(*pos[i]), peers[senders[i]],
+            Replication.EXCEPT_SELF,
+            kind=int(kinds[i]), params=tuple(params[i]),
+        ))
+        for i in range(m)
+    ]
+    want = oracle.match_local_batch([pair[1] for pair in fallback])
+    failpoints.registry.reset()
+    try:
+        # leg 1: dispatch itself fails → mirror resolves the fallback
+        failpoints.registry.set("backend.dispatch", "error:1:x1")
+        out = backend.collect_local_batch(
+            backend.dispatch_staged_batch(
+                wid, pos, sid, repl, kinds, params, fallback=fallback
+            )
+        )
+        assert backend.degraded_batches == 1
+        assert not backend.failed_over
+        for i in range(m):
+            assert _rows_match(out[i], want[i]), (
+                f"degraded dispatch row {i}: {out[i]!r} vs {want[i]!r}"
+            )
+
+        # leg 2: dispatch succeeds, collect fails → same containment
+        failpoints.registry.set("backend.collect", "error:1:x1")
+        out = backend.collect_local_batch(
+            backend.dispatch_staged_batch(
+                wid, pos, sid, repl, kinds, params, fallback=fallback
+            )
+        )
+        assert backend.degraded_batches == 2
+        for i in range(m):
+            assert _rows_match(out[i], want[i]), (
+                f"degraded collect row {i}: {out[i]!r} vs {want[i]!r}"
+            )
+
+        # healthy again: the device path agrees with what degradation
+        # served (the acceptance criterion's "identical under
+        # degradation" in both directions)
+        out = backend.collect_local_batch(
+            backend.dispatch_staged_batch(
+                wid, pos, sid, repl, kinds, params, fallback=fallback
+            )
+        )
+        assert backend.degraded_batches == 2
+        for i in range(m):
+            assert _rows_match(out[i], want[i]), (
+                f"recovered row {i}: {out[i]!r} vs {want[i]!r}"
+            )
+    finally:
+        failpoints.registry.reset()
+
+
+# ------------------------------------------------------------------
+# retrace GUARD: the boot tier walk (including precompile.py's kind
+# leg) must leave steady-state serving with zero quiet retraces
+
+
+def test_precompile_kind_walk_pins_zero_retraces():
+    from worldql_server_tpu.spatial.precompile import precompile_tiers
+
+    tpu = TpuSpatialBackend(cube_size=CUBE)
+    rng = np.random.default_rng(21)
+    peers, positions, world_ids = _build_index(tpu, rng, 41, 2)
+    tpu.flush()
+    tpu.wait_compaction()
+    m = 15
+    senders = rng.integers(0, 41, m)
+    wid, pos, sid, repl = _staged_cols(
+        tpu, peers, positions, world_ids, senders, rng, n_empty=2
+    )
+    batches = [_kind_cols(rng, m, kid) for kid in KIND_IDS.values()]
+    batches.append(_mixed_cols(rng, m))
+    # discovery: kind expansion turns m queries into (many more) probe
+    # rows — size the boot walk to the largest probe batch, not to m.
+    # The pure-radius control batch rides along so its (tiny, dense)
+    # shape is also on record before the snapshot, exactly like the
+    # boot warm pass.
+    probe_rows = m
+    for kinds, params in (*batches, (None, None)):
+        handle = tpu.dispatch_staged_batch(
+            wid, pos, sid, repl, kinds, params
+        )
+        if kinds is not None:
+            probe_rows = max(
+                probe_rows, int(handle[1][1].probe_owner.shape[0])
+            )
+        tpu.collect_local_batch(handle)
+    stats = precompile_tiers(
+        tpu, max_batch=probe_rows, t_tiers=2, max_compiles=96
+    )
+    assert stats["kind_dispatches"] > 0   # the kind leg really walked
+    before = GUARD.snapshot()
+    for kinds, params in (*batches, (None, None)):
+        out = tpu.collect_local_batch(
+            tpu.dispatch_staged_batch(wid, pos, sid, repl, kinds, params)
+        )
+        assert len(out) == m
+    delta = GUARD.delta(before)
+    assert delta == {}, (
+        f"mixed-kind serving re-traced after the boot walk: {delta}"
+    )
+
+
+# ------------------------------------------------------------------
+# e2e over real ZMQ: one test per wire instruction, CPU backend (the
+# oracle answers directly — no jit wall inside tier-1)
+
+
+def _make_server(**overrides) -> WorldQLServer:
+    config = Config()
+    config.store_url = "memory://"
+    config.http_enabled = False
+    config.ws_enabled = False
+    config.zmq_server_port = free_port()
+    config.zmq_server_host = "127.0.0.1"
+    config.sub_region_size = CUBE
+    for k, v in overrides.items():
+        setattr(config, k, v)
+    return WorldQLServer(config)
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+async def _subscribe(client, world, x, y, z):
+    await client.send(Message(
+        instruction=Instruction.AREA_SUBSCRIBE,
+        world_name=world,
+        position=Vector3(float(x), float(y), float(z)),
+    ))
+
+
+async def _ask(client, world, pos, wire, payload, timeout=5.0):
+    """Send one query.* LocalMessage, return the decoded .result
+    reply body."""
+    await client.send(Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        world_name=world,
+        position=Vector3(*[float(c) for c in pos]),
+        parameter=wire,
+        flex=json.dumps(payload).encode(),
+    ))
+    while True:
+        reply = await client.recv(timeout)
+        if (reply.instruction == Instruction.LOCAL_MESSAGE
+                and reply.parameter == f"{wire}.result"):
+            return json.loads(bytes(reply.flex).decode())
+
+
+async def _wire_stage(server):
+    """Shared stage: asker at (8,8,8) with a lane target at (24,8,8)
+    and a flank target at (8,40,8) — cube convention (max corner,
+    size 16) puts them in cubes (16,16,16), (32,16,16), (16,48,16)."""
+    asker = await ZmqClient.connect(server.config.zmq_server_port)
+    lane = await ZmqClient.connect(server.config.zmq_server_port)
+    flank = await ZmqClient.connect(server.config.zmq_server_port)
+    await _subscribe(asker, "w", 8, 8, 8)
+    await _subscribe(lane, "w", 24, 8, 8)
+    await _subscribe(flank, "w", 8, 40, 8)
+    for _ in range(400):
+        if server.backend.subscription_count() >= 3:
+            break
+        await asyncio.sleep(0.01)
+    assert server.backend.subscription_count() >= 3
+    return asker, lane, flank
+
+
+def test_wire_query_cone_e2e():
+    async def scenario():
+        server = _make_server()
+        await server.start()
+        try:
+            asker, lane, flank = await _wire_stage(server)
+            # narrow +x cone: the lane target only, sender excluded
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.cone",
+                {"dir": [1, 0, 0], "half_angle_deg": 30, "range": 48},
+            )
+            assert body == {"kind": "cone", "peers": [lane.uuid.hex]}
+            # wide cone picks up the flank too (dot 0 ≥ 32·cos95°)
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.cone",
+                {"dir": [1, 0, 0], "half_angle_deg": 95, "range": 48},
+            )
+            assert sorted(body["peers"]) == sorted(
+                [lane.uuid.hex, flank.uuid.hex]
+            )
+            assert server.metrics.counters["queries.kind_replies"] >= 2
+            for c in (asker, lane, flank):
+                await c.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())
+
+
+def test_wire_query_raycast_e2e():
+    async def scenario():
+        server = _make_server()
+        await server.start()
+        try:
+            asker, lane, flank = await _wire_stage(server)
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.raycast",
+                {"dir": [1, 0, 0], "max_t": 48, "mode": "first_hit"},
+            )
+            assert body["kind"] == "raycast"
+            assert body["mode"] == "first_hit"
+            assert body["peers"] == [lane.uuid.hex]
+            assert body["t"] == 16.0
+            # a ray into empty space still answers (miss, not silence)
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.raycast",
+                {"dir": [0, 0, 1], "max_t": 48, "mode": "first_hit"},
+            )
+            assert body["peers"] == [] and body["t"] is None
+            for c in (asker, lane, flank):
+                await c.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())
+
+
+def test_wire_query_knn_e2e():
+    async def scenario():
+        server = _make_server()
+        await server.start()
+        try:
+            asker, lane, flank = await _wire_stage(server)
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.knn",
+                {"k": 2, "max_range": 48},
+            )
+            assert body["kind"] == "knn"
+            assert body["k"] == 2
+            # nearest first: lane at 16, flank at 32; never the sender
+            assert body["peers"] == [lane.uuid.hex, flank.uuid.hex]
+            for c in (asker, lane, flank):
+                await c.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())
+
+
+def test_wire_query_density_e2e():
+    async def scenario():
+        server = _make_server()
+        await server.start()
+        try:
+            asker, lane, flank = await _wire_stage(server)
+            body = await _ask(
+                asker, "w", (8, 8, 8), "query.density",
+                {"extent": 2, "top_n": 8},
+            )
+            # density counts EVERYONE (the sender too): three occupied
+            # cubes of one peer each, tie-broken by coordinates
+            assert body == {"kind": "density", "cubes": [
+                [16, 16, 16, 1], [16, 48, 16, 1], [32, 16, 16, 1],
+            ]}
+            # the heatmap fed from the reply path
+            assert server.heatmap is not None
+            assert server.heatmap.updates >= 1
+            top = server.heatmap.top(1)
+            assert top and top[0][0] == "w"
+            for c in (asker, lane, flank):
+                await c.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())

@@ -22,6 +22,7 @@ def test_catalog_names():
     assert set(CATALOG) == {
         "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
         "reconnect_storm_replay", "cluster_flash_crowd",
+        "sniper_scope", "projectile_storm",
     }
     # the replay-storm variant is catalogued but NOT CI-smoke-blocking;
     # the cluster variant spawns shard subprocesses and runs in its
@@ -60,6 +61,24 @@ def test_battle_royale_smoke():
     # slow-marked: the tpu-backend sim compile makes this the heaviest
     # leg; CI runs it in the dedicated Scenario smoke step
     assert_green(run_scenario("battle_royale", shape="smoke"))
+
+
+@pytest.mark.slow
+def test_sniper_scope_smoke():
+    """ISSUE 17 wire e2e for cone + raycast: every reply frame checked
+    against the exact geometric answer, a malformed payload dropped
+    with a counter while the session survives. Slow-marked like
+    battle_royale (tpu-backend kind-kernel compile); CI runs it in the
+    Scenario smoke step."""
+    assert_green(run_scenario("sniper_scope", shape="smoke"))
+
+
+@pytest.mark.slow
+def test_projectile_storm_smoke():
+    """ISSUE 17 wire e2e for knn + density (+ raycast storm): exact
+    neighbor ladder and density survey, with the heatmap provably fed
+    by the storm's density replies."""
+    assert_green(run_scenario("projectile_storm", shape="smoke"))
 
 
 @pytest.mark.slow

@@ -51,8 +51,10 @@ def test_append_interns_at_enqueue_and_swap_returns_trimmed_views():
     staging.append(make_query(sender=peer))
     staging.append(make_query(world="unknown"))  # never interned → -1
     assert staging.count == 2
-    wid, pos, sid, repl = staging.swap()
+    wid, pos, sid, repl, kind, par = staging.swap()
     assert len(wid) == len(pos) == len(sid) == len(repl) == 2
+    assert len(kind) == len(par) == 2
+    assert list(kind) == [0, 0]  # plain radius rows stage kind 0
     assert wid[0] == backend._world_ids["w"]
     assert sid[0] == backend._peer_ids[peer]
     assert (wid[1], sid[1]) == (-1, -1)
@@ -67,7 +69,7 @@ def test_buffer_grows_pow2_and_preserves_rows():
     for i in range(n):
         staging.append(make_query(pos=(float(i), 0.0, 0.0)))
     assert staging.capacity == 2 * MIN_CAP
-    wid, pos, sid, repl = staging.swap()
+    wid, pos, sid, repl, _kind, _par = staging.swap()
     assert len(pos) == n
     assert [p[0] for p in pos[:3]] == [0.0, 1.0, 2.0]
     assert pos[n - 1][0] == float(n - 1)

@@ -15,6 +15,7 @@ rules enforce that mechanically for this repo's hot modules:
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from .core import FileContext, Rule, Violation, dotted_name, walk_shallow
@@ -194,9 +195,18 @@ _DISPATCH_FUNCS = {
     "match_local_batch",
     "_dispatch_encoded",
     "_prepare_queries",
+    # query-library dispatch leg (queries/expand.py + the backend's
+    # kind branch): the mixed-kind expansion must stay vectorized —
+    # a per-row loop here is the same host-encode wall. The FOLD side
+    # (fold_collected) is collect-path per-result assembly, like the
+    # radius path's list building, and deliberately not in this set.
+    "expand_staged",
+    "_dispatch_kind_batch",
 }
-#: parameter names that carry the per-tick query batch
-_QUERY_PARAMS = {"queries"}
+#: parameter names that carry the per-tick query batch (`kinds` and
+#: `params` are the staged kind/parameter COLUMNS — same cardinality,
+#: same wall if iterated per element)
+_QUERY_PARAMS = {"queries", "kinds", "params"}
 #: call wrappers whose argument is still iterated per element
 _ITER_WRAPPERS = {"enumerate", "zip", "reversed", "map", "iter"}
 
@@ -223,7 +233,7 @@ def _check_per_query_loop(ctx: FileContext) -> Iterator[Violation]:
     legacy object-list encode are the designated exceptions — they
     carry ``# wql: allow(per-query-python-loop)`` pragmas so every
     per-query loop on the dispatch path stays auditable."""
-    if "spatial/" not in ctx.relpath:
+    if "spatial/" not in ctx.relpath and "queries/" not in ctx.relpath:
         return
     scopes = [
         node for node in ast.walk(ctx.tree)
@@ -274,6 +284,63 @@ def _check_per_query_loop(ctx: FileContext) -> Iterator[Violation]:
                         "CPU/fallback site with "
                         "`# wql: allow(per-query-python-loop)`",
                     )
+
+
+#: wire-parameter shape of the query library: ``query.<name>`` requests
+#: and ``query.<name>.result`` replies. A literal of this shape that
+#: names no REGISTERED kind is a typo the router will silently route as
+#: a plain radius match (parse_query_message returns None on unknown
+#: parameters by design) — the query "works" and returns the wrong
+#: geometry, which no exception will ever surface.
+_QUERY_WIRE_RE = re.compile(r"query\.[a-z_.]+\Z")
+
+_KNOWN_WIRES: set[str] | None = None
+_KNOWN_WIRES_LOADED = False
+
+
+def _known_query_wires() -> set[str] | None:
+    """Registered wire names + their ``.result`` reply parameters,
+    straight from the registry so the lint can never drift from the
+    code. None (rule inert) when the package can't import — the lint
+    must stay runnable from a checkout with a broken tree."""
+    global _KNOWN_WIRES, _KNOWN_WIRES_LOADED
+    if not _KNOWN_WIRES_LOADED:
+        _KNOWN_WIRES_LOADED = True
+        try:
+            from worldql_server_tpu.queries.kinds import wire_names
+        except Exception:
+            _KNOWN_WIRES = None
+        else:
+            names = set(wire_names())
+            _KNOWN_WIRES = names | {f"{n}.result" for n in names}
+    return _KNOWN_WIRES
+
+
+def _check_unregistered_kind(ctx: FileContext) -> Iterator[Violation]:
+    hits = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and _QUERY_WIRE_RE.fullmatch(node.value)
+    ]
+    if not hits:
+        return
+    known = _known_query_wires()
+    if known is None:
+        return
+    for node in hits:
+        if node.value not in known:
+            yield from ctx.flag(
+                UNREGISTERED_KIND,
+                node,
+                f'"{node.value}" matches the query-library wire shape '
+                "but names no registered kind — the router would parse "
+                "it as a PLAIN RADIUS query and silently return the "
+                "wrong geometry; register the kind in "
+                "worldql_server_tpu/queries/kinds.py, fix the typo, or "
+                "mark a deliberate negative-test literal with "
+                "`# wql: allow(unregistered-query-kind)`",
+            )
 
 
 #: sim-tick hot functions of the entity plane (entities/plane.py): the
@@ -589,6 +656,13 @@ SIM_TICK_HAZARD = Rule(
     "must stay one fused kernel; pragma the designated collect points)",
     _check_sim_tick,
 )
+UNREGISTERED_KIND = Rule(
+    "unregistered-query-kind",
+    "query.<name> wire literal naming no registered kind — the router "
+    "parses unknown parameters as plain radius queries, so a typo "
+    "returns the wrong geometry without any error",
+    _check_unregistered_kind,
+)
 FULL_REBUILD = Rule(
     "full-rebuild-on-tick",
     "full-hash-rebuild entry point called from a tick-path function "
@@ -598,4 +672,5 @@ FULL_REBUILD = Rule(
 )
 
 RULES = [HOST_SYNC, JIT_IN_LOOP, TRACED_BRANCH, FULL_FETCH,
-         PER_QUERY_LOOP, SIM_TICK_HAZARD, FULL_REBUILD]
+         PER_QUERY_LOOP, UNREGISTERED_KIND, SIM_TICK_HAZARD,
+         FULL_REBUILD]

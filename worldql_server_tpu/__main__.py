@@ -75,6 +75,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "dispatches with zero per-query Python (auto = "
                         "on for staging-capable backends, the default; "
                         "off = object-list path everywhere)")
+    p.add_argument("--query-kinds", choices=["on", "off"],
+                   dest="query_kinds",
+                   help="batched spatial query library: cone / raycast "
+                        "/ filtered-kNN / region-density wire queries "
+                        "(query.cone, query.raycast, query.knn, "
+                        "query.density) expanded on the staged "
+                        "columns; 'off' routes every parameter as a "
+                        "plain radius match byte for byte (default on)")
+    p.add_argument("--query-stencil-max", type=int,
+                   dest="query_stencil_max",
+                   help="cube-stencil radius cap for kind expansion, "
+                        "applied at parse AND expansion (default 3)")
+    p.add_argument("--query-ray-steps", type=int, dest="query_ray_steps",
+                   help="max raycast march samples per query "
+                        "(default 64)")
+    p.add_argument("--query-density-top-n", type=int,
+                   dest="query_density_top_n",
+                   help="cubes kept per query.density reply and on "
+                        "the wql_region_density gauge (default 16)")
     p.add_argument("--precompile-tiers", action="store_true",
                    default=None, dest="precompile_tiers_flag",
                    help="trace every reachable device-kernel capacity "
@@ -283,7 +302,8 @@ _OVERRIDES = [
     "db_region_z_size", "db_table_size", "db_cache_size", "http_host",
     "http_port", "http_auth_token", "ws_host", "ws_port", "zmq_server_host",
     "zmq_server_port", "zmq_timeout_secs", "spatial_backend", "tick_interval",
-    "tick_pipeline", "query_staging", "mesh_batch", "mesh_space",
+    "tick_pipeline", "query_staging", "query_kinds", "query_stencil_max",
+    "query_ray_steps", "query_density_top_n", "mesh_batch", "mesh_space",
     "index_snapshot", "max_message_size",
     "durability", "wal_dir", "wal_fsync_ms", "wal_segment_bytes",
     "checkpoint_interval", "delivery_workers", "delivery_ring_bytes",

@@ -418,6 +418,32 @@ class Config:
     cluster_role: str = field(
         default_factory=lambda: _env("WQL_CLUSTER_ROLE", "")
     )
+    # Spatial query library (worldql_server_tpu/queries, ISSUE 17):
+    # 'on' (the default) routes LocalMessages whose parameter names a
+    # registered query kind (query.cone / query.raycast / query.knn /
+    # query.density) through kind-dispatched resolution — staged kind
+    # lanes, probe expansion on device backends, CPU oracles elsewhere
+    # — and answers each with a reply frame. 'off' pins the
+    # pre-library pipeline byte for byte: those parameters ride as
+    # plain radius messages.
+    query_kinds: str = field(
+        default_factory=lambda: _env("WQL_QUERY_KINDS", "on")
+    )
+    # Stencil clamp: max probe radius in cubes a kind expansion may
+    # walk (cone range / knn max-range reaches clamp to it). Part of
+    # the query SEMANTICS — oracles and kernels read the same value.
+    query_stencil_max: int = field(
+        default_factory=lambda: int(_env("WQL_QUERY_STENCIL_MAX", "3"))
+    )
+    # Raycast march clamp: max half-cube steps along the segment.
+    query_ray_steps: int = field(
+        default_factory=lambda: int(_env("WQL_QUERY_RAY_STEPS", "64"))
+    )
+    # Density result clamp: top-N cubes per query.density reply (also
+    # the region heatmap's gauge depth).
+    query_density_top_n: int = field(
+        default_factory=lambda: int(_env("WQL_QUERY_DENSITY_TOP_N", "16"))
+    )
     # Device telemetry (observability/device.py): jit compile/retrace
     # counters + flight-recorder loose spans, the per-tick
     # encode/h2d/compute/d2h timing split, and the live
@@ -554,6 +580,14 @@ class Config:
             errors.append("mesh_batch must be greater than 0")
         if self.mesh_space < 0:
             errors.append("mesh_space must be >= 0 (0 = all remaining devices)")
+        if self.query_kinds not in ("on", "off"):
+            errors.append("query_kinds must be 'on' or 'off'")
+        if self.query_stencil_max < 1:
+            errors.append("query_stencil_max must be >= 1")
+        if self.query_ray_steps < 1:
+            errors.append("query_ray_steps must be >= 1")
+        if self.query_density_top_n < 1:
+            errors.append("query_density_top_n must be >= 1")
         if self.entity_sim:
             if self.spatial_backend == "cpu":
                 errors.append(

@@ -23,6 +23,9 @@ import time
 import uuid as uuid_mod
 
 from ..durability.pipeline import DurabilityPipeline
+from ..queries.kinds import KIND_DENSITY, kind_by_id
+from ..queries.results import KindResult
+from ..queries.wire import build_reply, parse_query_message
 from ..robustness import failpoints
 from ..protocol import Instruction, Message, Replication
 from ..spatial.backend import LocalQuery, SpatialBackend
@@ -52,10 +55,23 @@ class Router:
         tracer=None,
         entity_plane=None,
         governor=None,
+        query_limits=None,
+        heatmap=None,
     ):
         self.peer_map = peer_map
         self.backend = backend
         self.store = store
+        # Optional queries.kinds.QueryLimits: with limits set, a
+        # LocalMessage whose parameter names a registered query kind
+        # (query.cone / query.raycast / query.knn / query.density)
+        # parses into kind + parameter lanes here, at ingest. None =
+        # query library off — those parameters route as plain radius
+        # messages, byte for byte the pre-library pipeline.
+        self.query_limits = query_limits
+        # Optional queries.heatmap.RegionHeatmap for the immediate
+        # (tickerless) path's density results; the ticker feeds it on
+        # the batched path.
+        self.heatmap = heatmap
         # Optional TickBatcher: LocalMessages queue for a per-tick device
         # batch instead of resolving immediately (engine/ticker.py).
         self.ticker = ticker
@@ -262,11 +278,34 @@ class Router:
         if world is None:
             return
 
+        kind_id, params = 0, ()
+        if self.query_limits is not None and message.parameter:
+            try:
+                parsed = parse_query_message(message, self.query_limits)
+            except ValueError as exc:
+                # hostile/malformed payload: drop THIS message with a
+                # log line — the sender keeps its session, the tick
+                # keeps its budget
+                logger.warning(
+                    "malformed %s from %s dropped: %s",
+                    message.parameter, message.sender_uuid, exc,
+                )
+                if self.metrics is not None:
+                    self.metrics.inc("queries.malformed")
+                return
+            if parsed is not None:
+                kind_id = parsed[0].kind
+                params = parsed[1]
+                if self.metrics is not None:
+                    self.metrics.inc("queries.kind_requests")
+
         query = LocalQuery(
             world=world,
             position=message.position,
             sender=message.sender_uuid,
             replication=message.replication,
+            kind=kind_id,
+            params=params,
         )
         if self.ticker is not None:
             # frame clock for batched mode opens at ticker flush start
@@ -280,6 +319,11 @@ class Router:
         # tick_interval settings.
         t_ingress_ns = time.monotonic_ns()
         [targets] = self.backend.match_local_batch([query])
+        if isinstance(targets, KindResult):
+            await self._deliver_kind_result(
+                message, query, targets, t_ingress_ns
+            )
+            return
         if targets:
             await self.peer_map.broadcast_to(message, targets)
             if self.metrics is not None:
@@ -287,6 +331,29 @@ class Router:
                     "frame.e2e_ms",
                     (time.monotonic_ns() - t_ingress_ns) / 1e6,
                 )
+
+    async def _deliver_kind_result(
+        self, message: Message, query: LocalQuery, result: KindResult,
+        t_ingress_ns: int,
+    ) -> None:
+        """Immediate-mode tail of a kind query: reply frame back to the
+        requesting peer (an empty result included — the sender is owed
+        an answer either way), density results into the heatmap."""
+        kind = kind_by_id(result.kind)
+        if kind is None:
+            return
+        if self.heatmap is not None and result.kind == KIND_DENSITY:
+            self.heatmap.record(query.world, result.extra.get("cubes", ()))
+        if self.metrics is not None:
+            self.metrics.inc("queries.kind_replies")
+        await self.peer_map.broadcast_to(
+            build_reply(message, kind, result), [query.sender]
+        )
+        if self.metrics is not None:
+            self.metrics.observe_ms(
+                "frame.e2e_ms",
+                (time.monotonic_ns() - t_ingress_ns) / 1e6,
+            )
 
     async def _global_message(self, message: Message) -> None:
         if self._entity_ingest(message):

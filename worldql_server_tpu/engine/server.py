@@ -223,6 +223,32 @@ class WorldQLServer:
                 loop_monitor=self.loop_monitor,
                 on_evict=self._on_rate_limit_evict,
             )
+        # Spatial query library (worldql_server_tpu/queries): wire-level
+        # cone/raycast/kNN/density queries riding the staged columns.
+        # 'off' (or an unregistered parameter) keeps every query a plain
+        # radius match byte for byte — router parse and backend dispatch
+        # both gate on these being None.
+        self.query_limits = None
+        self.heatmap = None
+        if config.query_kinds == "on":
+            from ..queries import QueryLimits
+            from ..queries.heatmap import RegionHeatmap
+
+            self.query_limits = QueryLimits(
+                cube_size=config.sub_region_size,
+                stencil_max=config.query_stencil_max,
+                ray_steps_max=config.query_ray_steps,
+                density_top_n=config.query_density_top_n,
+            )
+            self.heatmap = RegionHeatmap(top_n=config.query_density_top_n)
+            # expansion clamps live on the backend(s): the Resilient
+            # wrapper delegates dispatch to .inner and degradation to
+            # .mirror, so all three must agree with the parse clamps
+            for b in (self.backend, getattr(self.backend, "inner", None),
+                      getattr(self.backend, "mirror", None)):
+                if b is not None:
+                    b.query_stencil_max = config.query_stencil_max
+                    b.query_ray_steps = config.query_ray_steps
         # Entity simulation plane (worldql_server_tpu/entities): the
         # device-resident moving-object workload. Constructed only in
         # --entity-sim mode (validate() guarantees a device backend +
@@ -312,6 +338,7 @@ class WorldQLServer:
                 entity_plane=self.entity_plane,
                 governor=self.governor,
                 cluster=self.cluster,
+                heatmap=self.heatmap,
             )
         self.precompile_stats: dict | None = None
         # Durability engine: WAL + write-behind pipeline. With
@@ -345,6 +372,8 @@ class WorldQLServer:
             durability=self.durability, tracer=self.tracer,
             entity_plane=self.entity_plane,
             governor=self.governor,
+            query_limits=self.query_limits,
+            heatmap=self.heatmap,
         )
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
@@ -361,6 +390,12 @@ class WorldQLServer:
         )
         if hasattr(self.backend, "device_stats"):
             self.metrics.gauge("spatial_device", self.backend.device_stats)
+        if self.heatmap is not None:
+            # per-region density aggregates (queries/heatmap.py):
+            # numeric leaves only — tracked_cubes/worlds/updates plus
+            # rank-indexed top-N counts, flattened strict-parser clean
+            # as wql_region_density_top0..topN
+            self.metrics.gauge("region_density", self.heatmap.gauge)
         if self.config.delta_ticks != "off":
             # flattened into delta.* series by render_prometheus —
             # the e2e acceptance reads delta.reuse_fraction here

@@ -7,7 +7,9 @@ probes, row-by-row position fills) before the kernel ever launched —
 moves that per-query work to MESSAGE-ARRIVAL time, amortized across
 the tick window: the router's enqueue writes one row of preallocated
 columnar staging arrays (``world_id i32 | pos f64[·,3] | sender_id i32
-| repl i8``, already interned through the backend's dicts), and
+| repl i8 | kind i8 | par f64[·,PARAM_LANES]``, already interned — and
+kind-parsed — through the backend's dicts and the query-kind registry),
+and
 ``flush()`` just flips the double buffer and hands the filled column
 views to :meth:`SpatialBackend.dispatch_staged_batch` — zero per-query
 Python at flush time. The back buffer fills for tick N+1 while tick N
@@ -44,6 +46,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..queries.kinds import PARAM_LANES
 from ..spatial.delta_ticks import row_signatures  # noqa: F401  (re-export)
 
 #: initial (and minimum) rows per buffer
@@ -53,7 +56,8 @@ SHRINK_AFTER = 32
 
 
 class _Buffer:
-    __slots__ = ("wid", "pos", "sid", "repl", "n", "cap", "epoch")
+    __slots__ = ("wid", "pos", "sid", "repl", "kind", "par", "n", "cap",
+                 "epoch")
 
     def __init__(self, cap: int):
         self.alloc(cap)
@@ -66,19 +70,27 @@ class _Buffer:
         self.pos = np.empty((cap, 3), np.float64)
         self.sid = np.empty(cap, np.int32)
         self.repl = np.empty(cap, np.int8)
+        # query-library lanes (queries/): kind 0 = plain radius row; a
+        # non-zero kind reads its parsed f64 parameter lanes from par
+        self.kind = np.empty(cap, np.int8)
+        self.par = np.empty((cap, PARAM_LANES), np.float64)
 
     def grow(self) -> None:
         n, cap = self.n, self.cap * 2
         wid, pos, sid, repl = self.wid, self.pos, self.sid, self.repl
+        kind, par = self.kind, self.par
         self.alloc(cap)
         self.wid[:n] = wid[:n]
         self.pos[:n] = pos[:n]
         self.sid[:n] = sid[:n]
         self.repl[:n] = repl[:n]
+        self.kind[:n] = kind[:n]
+        self.par[:n] = par[:n]
 
     def views(self):
         n = self.n
-        return self.wid[:n], self.pos[:n], self.sid[:n], self.repl[:n]
+        return (self.wid[:n], self.pos[:n], self.sid[:n], self.repl[:n],
+                self.kind[:n], self.par[:n])
 
 
 class QueryStaging:
@@ -128,6 +140,12 @@ class QueryStaging:
         buf.pos[i, 2] = p.z
         buf.sid[i] = self._peer_ids.get(query.sender, -1)
         buf.repl[i] = int(query.replication)
+        kind = query.kind
+        buf.kind[i] = kind
+        if kind:
+            params = query.params
+            buf.par[i, : len(params)] = params
+            buf.par[i, len(params):] = 0.0
         buf.n = i + 1
 
     def epoch_ok(self) -> bool:
@@ -183,11 +201,14 @@ class QueryStaging:
                             n, wid, pos, sid, repl = (
                                 b.n, b.wid, b.pos, b.sid, b.repl
                             )
+                            kind, par = b.kind, b.par
                             b.alloc(b.cap // 2)
                             b.wid[:n] = wid[:n]
                             b.pos[:n] = pos[:n]
                             b.sid[:n] = sid[:n]
                             b.repl[:n] = repl[:n]
+                            b.kind[:n] = kind[:n]
+                            b.par[:n] = par[:n]
         else:
             self._under = 0
 

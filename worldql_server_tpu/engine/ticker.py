@@ -48,6 +48,9 @@ import time
 from collections import deque
 
 from ..observability.spans import NULL_TRACE, Tracer
+from ..queries.kinds import KIND_DENSITY, kind_by_id
+from ..queries.results import KindResult
+from ..queries.wire import build_reply
 from ..robustness import failpoints
 from ..spatial.backend import LocalQuery, SpatialBackend
 from ..protocol.types import Message
@@ -72,8 +75,13 @@ class TickBatcher:
         entity_plane=None,
         governor=None,
         cluster=None,
+        heatmap=None,
     ):
         self.backend = backend
+        # Optional queries.heatmap.RegionHeatmap: density-query results
+        # feed it as they fold out of each tick (the wql_region_density
+        # gauge and GET /debug/heatmap read it)
+        self._heatmap = heatmap
         self.peer_map = peer_map
         self.interval = interval
         self.max_batch = max_batch
@@ -452,11 +460,7 @@ class TickBatcher:
         if targets is None and not sim_pairs:
             return
         try:
-            pairs = [
-                (message, tgts)
-                for (message, _), tgts in zip(batch, targets or [])
-                if tgts
-            ]
+            pairs = self._build_pairs(batch, targets or [])
             pairs.extend(sim_pairs)
             # awaited in place below (shield loop) — not a dangling
             # loop, so it rides outside the supervisor
@@ -488,6 +492,35 @@ class TickBatcher:
             )
         except Exception:
             logger.exception("tick delivery failed — batch dropped")
+
+    def _build_pairs(self, batch, targets) -> list:
+        """One tick's delivery pairs. Radius rows pair the original
+        message with its fan-out list, exactly as before. Kind rows
+        (query library) come back as :class:`KindResult` — each pairs a
+        freshly built reply frame (queries/wire.py) with the REQUESTING
+        peer, an empty result included (the sender is owed an answer
+        either way) — and density rows additionally feed the region
+        heatmap. Collect-side per-query list assembly is the existing
+        contract; the dispatch path stays loop-free."""
+        heatmap = self._heatmap
+        pairs = []
+        for (message, query), tgts in zip(batch, targets):
+            if isinstance(tgts, KindResult):
+                kind = kind_by_id(tgts.kind)
+                if kind is None:  # unregistered kind staged: reply owed
+                    continue  # to nobody — drop, the lint rule guards this
+                pairs.append(
+                    (build_reply(message, kind, tgts), [query.sender])
+                )
+                if self.metrics is not None:
+                    self.metrics.inc("queries.kind_replies")
+                if heatmap is not None and tgts.kind == KIND_DENSITY:
+                    heatmap.record(
+                        query.world, tgts.extra.get("cubes", ())
+                    )
+            elif tgts:
+                pairs.append((message, tgts))
+        return pairs
 
     def _dispatch_batch(self, batch):
         """Launch one tick's batch: the staged columnar path when the
@@ -619,11 +652,7 @@ class TickBatcher:
                                 "tick.collect_ms", self.last_collect_ms
                             )
                     self._note_collect_stats(trace)
-                pairs = [
-                    (message, tgts)
-                    for (message, _), tgts in zip(batch, targets)
-                    if tgts
-                ]
+                pairs = self._build_pairs(batch, targets)
                 if sim_handle is not None:
                     pairs.extend(
                         await self._sim_collect_apply(
@@ -747,9 +776,11 @@ class TickBatcher:
                 self.metrics.inc(
                     "tick.fetch_bytes", int(stats.get("fetch_bytes", 0))
                 )
-                self.metrics.set_gauge(
-                    "tick.compaction_bucket", self.last_compaction_bucket
-                )
+                # NOT also pushed as a set_gauge here: the server's
+                # registered ``tick`` gauge dict already exports
+                # ``last_compaction_bucket`` under the SAME flattened
+                # name, and two exporters made /metrics emit a
+                # duplicate # TYPE the strict parser rejects
             trace.tag(
                 fetch_bytes=int(stats.get("fetch_bytes", 0)),
                 compaction_bucket=self.last_compaction_bucket,

@@ -1,0 +1,68 @@
+"""Batched spatial query library (ISSUE 17, ROADMAP item 5).
+
+ASH (arXiv:2110.00511) layers a generalized spatial-hash op set —
+queries, raycasts, aggregates — over ONE hash structure; TPU-KNN
+(arXiv:2206.14286) recasts neighbor selection as blocked distance
+tiles. This package is that template applied to the staged
+LocalMessage pipeline: the staging columns grow a ``kind i8`` plus
+per-kind parameter lanes, and every kind expands at dispatch time into
+*probe rows* — (world, sample-position, sender, replication) quadruples
+that ride the EXISTING encode → hash-probe → CSR-collect machinery
+against the SAME persistent device index. Candidate generation is the
+cube walk the radius path already does; the per-kind geometric filter
+(cone / segment / k-ball / region extent) runs as a pre-jitted,
+GUARD-registered device kernel over the kind's stencil lattice,
+replacing the sphere test. Compaction, delta-tick reuse (probes are
+content-addressed rows), precompile tier-walking and ResilientBackend
+CPU-mirror degradation all come along for free.
+
+Four ops ship on the mechanism:
+
+* ``query.cone`` — cone-of-sight / frustum visibility
+  (:mod:`geometry`): apex, direction, half-angle, range.
+* ``query.raycast`` — segment hit-scan: origin, direction, max-t,
+  first-hit or all-hits (host-side f64 ray march; the device leg is
+  the shared hash-probe dispatch).
+* ``query.knn`` — k-nearest subscribed peers with the replication
+  predicate (:mod:`knn`, reusing the packed-sort top-k idiom from
+  ``ops/tick.py``).
+* ``query.density`` — per-cube subscriber counts feeding the live
+  region heatmap (:mod:`heatmap`).
+
+Wire contract and parity semantics live in :mod:`wire` and
+:mod:`oracle`; the README "Spatial query library" section documents
+both.
+"""
+
+# The package surface stays jax-free: the device-kernel modules
+# (geometry/knn/expand) are imported explicitly by the TPU backend,
+# never as a side effect of touching the registry or the oracles.
+from .kinds import (  # noqa: F401
+    KIND_CONE,
+    KIND_DENSITY,
+    KIND_KNN,
+    KIND_RADIUS,
+    KIND_RAYCAST,
+    PARAM_LANES,
+    QueryKind,
+    QueryLimits,
+    kind_by_id,
+    kind_by_wire,
+    registered_kinds,
+)
+from .results import KindResult  # noqa: F401
+
+__all__ = [
+    "KIND_CONE",
+    "KIND_DENSITY",
+    "KIND_KNN",
+    "KIND_RADIUS",
+    "KIND_RAYCAST",
+    "PARAM_LANES",
+    "KindResult",
+    "QueryKind",
+    "QueryLimits",
+    "kind_by_id",
+    "kind_by_wire",
+    "registered_kinds",
+]

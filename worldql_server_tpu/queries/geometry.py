@@ -1,0 +1,132 @@
+"""Per-kind geometric filter kernels over the stencil lattice.
+
+The library's candidate-generation semantics (the *cube-sampled*
+contract every oracle in :mod:`oracle` replicates): a kind's candidate
+cubes are the cubes containing the sample points ``pos + u * size``
+for stencil offsets ``u ∈ [-r, r]³`` that pass the kind's geometric
+test on the displacement ``d = u * size``. Exactly one lattice point
+per cube (the lattice spacing equals the cube size), so the stencil
+mask IS the cube selection — no arithmetic in label space, ever
+(adjacent cube labels are not uniform integers; sample points are
+quantized by the same host-f64 ``cube_coords_batch`` as everything
+else).
+
+Each kernel is a batched device op: ``[M, PARAM_LANES]`` parameter rows
+against one ``[S, 3]`` stencil — jitted once, GUARD-registered, and
+precompiled by the boot tier walk (spatial/precompile.py) over the
+query-cap ladder × stencil radii, so a mixed-kind tick after boot
+retraces nothing. Geometry runs in f64 (jax_enable_x64 is on —
+spatial/jaxconf.py) with explicit component-sum arithmetic in a fixed
+order, so the numpy oracles produce bit-identical masks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+
+from ..spatial.hashing import next_pow2
+from ..utils import retrace
+from .kinds import PARAM_LANES
+from .stencil import stencil_offsets, stencil_radius  # noqa: F401  (re-export)
+
+#: kind-parameter rows pad to power-of-two tiers (this floor) before
+#: entering a kernel, so the row counts jit keys on form the same small
+#: enumerable ladder the dispatch capacities do — the boot tier walk
+#: (spatial/precompile.py) covers it, and a mid-serving change in the
+#: per-kind row count lands on a warm tier instead of retracing
+KIND_ROW_FLOOR = 64
+
+
+def _pad_rows(params: np.ndarray) -> tuple[np.ndarray, int]:
+    params = np.asarray(params, np.float64)
+    m = params.shape[0]
+    cap = next_pow2(m, floor=KIND_ROW_FLOOR)
+    if cap == m:
+        return params, m
+    out = np.zeros((cap, params.shape[1]), np.float64)
+    out[:m] = params
+    return out, m
+
+
+@jax.jit
+def _cone_mask_kernel(params, offsets, size):
+    """``[M, L]`` cone params × ``[S, 3]`` f64 offsets → bool ``[M, S]``:
+    displacement within range AND inside the half-angle (the apex cube
+    ``d == 0`` is always visible)."""
+    dx = offsets[:, 0] * size
+    dy = offsets[:, 1] * size
+    dz = offsets[:, 2] * size
+    d2 = dx * dx + dy * dy + dz * dz                      # [S]
+    dist = jnp.sqrt(d2)
+    ax, ay, az = params[:, 0:1], params[:, 1:2], params[:, 2:3]
+    dot = dx[None, :] * ax + dy[None, :] * ay + dz[None, :] * az
+    cos_half = params[:, 3:4]
+    within = dist[None, :] <= params[:, 4:5]
+    inside = dot >= dist[None, :] * cos_half
+    return within & (inside | (d2[None, :] == 0.0))
+
+
+@jax.jit
+def _density_mask_kernel(params, offsets):
+    """``[M, L]`` density params × ``[S, 3]`` f64 offsets → bool
+    ``[M, S]``: Chebyshev box of ``extent`` cubes (lane 0). Integer
+    geometry — exact in f64 by construction."""
+    cheb = jnp.max(jnp.abs(offsets), axis=1)              # [S]
+    return cheb[None, :] <= params[:, 0:1]
+
+
+retrace.GUARD.register("queries.cone_mask", _cone_mask_kernel)
+retrace.GUARD.register("queries.density_mask", _density_mask_kernel)
+
+
+def cone_mask(params: np.ndarray, offsets: np.ndarray,
+              cube_size: int) -> np.ndarray:
+    """Host wrapper: f64 in, bool ``[M, S]`` out (one fetch at the
+    dispatch boundary, like the staging encode). Rows pad to a pow2
+    tier (see ``KIND_ROW_FLOOR``); the pad rows are sliced away."""
+    padded, m = _pad_rows(params)
+    out = _cone_mask_kernel(
+        jnp.asarray(padded, jnp.float64),
+        jnp.asarray(offsets, jnp.float64),
+        jnp.float64(cube_size),
+    )
+    return np.asarray(out)[:m]
+
+
+def density_mask(params: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    padded, m = _pad_rows(params)
+    out = _density_mask_kernel(
+        jnp.asarray(padded, jnp.float64),
+        jnp.asarray(offsets, jnp.float64),
+    )
+    return np.asarray(out)[:m]
+
+
+def precompile_kind_kernels(qcap: int, radius: int, cube_size: int) -> int:
+    """Warm each REGISTERED kind's kernel at one (row-tier, stencil-
+    radius) shape — the per-kind leg of the boot tier walk. Iterating
+    the registry (not a hardcoded list) keeps a newly registered kind
+    from paying its first trace mid-serving. Returns the number of
+    kernel calls made (precompile budget accounting)."""
+    from .kinds import registered_kinds
+    from .knn import knn_order  # local: avoid import cycle at module load
+
+    offsets = stencil_offsets(radius)
+    params = np.zeros((qcap, PARAM_LANES), np.float64)
+    params[:, 0] = 1.0  # a unit direction keeps the cone kernel honest
+    calls = 0
+    for kind in registered_kinds():
+        if kind.name == "cone":
+            cone_mask(params, offsets, cube_size)
+        elif kind.name == "density":
+            density_mask(params, offsets)
+        elif kind.name == "knn":
+            knn_order(params, offsets, cube_size)
+        else:
+            continue  # raycast: host-side f64 march, no kernel to warm
+        calls += 1
+    return calls

@@ -1,0 +1,97 @@
+"""Live region heatmap fed by ``query.density`` results.
+
+Every folded density reply the ticker (or the router's immediate path)
+delivers also lands here: the heatmap keeps, per (world, cube), the
+most recent subscriber count with a freshness horizon, so the hottest
+regions of the fleet are one scrape away. Two export surfaces:
+
+* the ``wql_region_density`` gauge on ``/metrics`` — top-N cube counts
+  as rank-indexed leaves (``wql_region_density_top0`` …), plus the
+  tracked-cube/world totals; strict-parser clean (rank keys, no label
+  games);
+* ``GET /debug/heatmap`` — the full JSON snapshot, per world.
+
+Guarded by a lock: recording happens on the event loop, but /metrics
+and /debug scrapes may render from transport threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: cubes silent for longer than this drop out of gauge/top views
+DEFAULT_HORIZON_S = 60.0
+
+
+class RegionHeatmap:
+    def __init__(self, top_n: int = 16,
+                 horizon_s: float = DEFAULT_HORIZON_S):
+        self.top_n = int(top_n)
+        self.horizon_s = float(horizon_s)
+        self._lock = threading.Lock()
+        #: (world, (cx, cy, cz)) → [count, monotonic_ts]
+        self._cells: dict[tuple, list] = {}
+        self.updates = 0
+
+    def record(self, world: str, cubes) -> None:
+        """Fold one density result: ``cubes`` is the reply's
+        ``[[cx, cy, cz, count], ...]`` rows."""
+        now = time.monotonic()
+        with self._lock:
+            for cx, cy, cz, count in cubes:
+                self._cells[(world, (int(cx), int(cy), int(cz)))] = [
+                    int(count), now,
+                ]
+            self.updates += 1
+
+    def _live(self):
+        horizon = time.monotonic() - self.horizon_s
+        dead = [k for k, v in self._cells.items() if v[1] < horizon]
+        for k in dead:
+            del self._cells[k]
+        return self._cells
+
+    def top(self, n: int | None = None) -> list:
+        """→ ``[[world, cx, cy, cz, count], ...]`` hottest first
+        (count desc, then world/cube for determinism)."""
+        with self._lock:
+            cells = [
+                (world, cube, v[0]) for (world, cube), v in
+                self._live().items()
+            ]
+        cells.sort(key=lambda c: (-c[2], c[0], c[1]))
+        return [
+            [world, cube[0], cube[1], cube[2], count]
+            for world, cube, count in cells[: n or self.top_n]
+        ]
+
+    def snapshot(self, n: int | None = None) -> dict:
+        """Full per-world JSON view for ``GET /debug/heatmap``;
+        ``n`` caps the rows kept per world (hottest first)."""
+        with self._lock:
+            live = [
+                (world, cube, v[0])
+                for (world, cube), v in self._live().items()
+            ]
+        out: dict = {}
+        for world, cube, count in live:
+            out.setdefault(world, []).append([*cube, count])
+        for world, rows in out.items():
+            rows.sort(key=lambda r: (-r[3], r[0], r[1], r[2]))
+            if n is not None:
+                out[world] = rows[:n]
+        return out
+
+    def gauge(self) -> dict:
+        """The ``wql_region_density`` dict gauge: numeric leaves only
+        (render_prometheus flattens one level)."""
+        top = self.top()
+        out = {
+            "tracked_cubes": float(len(self._cells)),
+            "worlds": float(len({w for (w, _c) in self._cells})),
+            "updates": float(self.updates),
+        }
+        for rank, row in enumerate(top):
+            out[f"top{rank}"] = float(row[4])
+        return out

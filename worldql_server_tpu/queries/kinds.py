@@ -1,0 +1,207 @@
+"""Query-kind registry: the dispatch table the whole library pivots on.
+
+A *kind* is one spatial query shape. Kind 0 is the classic radius
+(single-cube) LocalMessage and never appears here as a handler — it IS
+the existing pipeline. Every other kind registers:
+
+* a stable ``kind`` id (the staging column's ``i8`` value),
+* its wire parameter (``query.<name>`` on a LocalMessage; the reply
+  frame uses ``query.<name>.result``),
+* a ``parse`` function mapping the request's JSON payload to the fixed
+  ``f64[PARAM_LANES]`` parameter row staged alongside the query
+  columns (clamped against :class:`QueryLimits` so a hostile payload
+  can never demand an unbounded stencil or ray march).
+
+The registry is consulted by the router (wire → kind), the backend's
+staged expansion (kind → stencil/kernel), precompile (tier walk over
+registered kinds) and the ``unregistered-query-kind`` lint rule
+(tools/check/rules_jax.py) — a wire parameter routed without an entry
+here is a build failure, not a runtime surprise.
+
+Parameter lane layouts (all f64, unused lanes zero):
+
+==========  =====================================================
+kind        lanes
+==========  =====================================================
+cone (1)    [ux, uy, uz (unit dir), cos_half_angle, range, 0]
+raycast (2) [ux, uy, uz (unit dir), max_t, mode (0=first, 1=all), 0]
+knn (3)     [k, max_range, 0, 0, 0, 0]
+density (4) [extent_cubes, top_n, 0, 0, 0, 0]
+==========  =====================================================
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+#: f64 parameter lanes staged per query row (engine/staging.py ``par``)
+PARAM_LANES = 6
+
+KIND_RADIUS = 0
+KIND_CONE = 1
+KIND_RAYCAST = 2
+KIND_KNN = 3
+KIND_DENSITY = 4
+
+#: raycast mode lane values
+RAY_FIRST_HIT = 0.0
+RAY_ALL_HITS = 1.0
+
+#: hard cap on k regardless of limits (reply frames stay bounded)
+KNN_K_CAP = 256
+
+
+@dataclass(frozen=True)
+class QueryLimits:
+    """Server-side clamps applied at parse time (engine/config.py:
+    ``query_stencil_max`` / ``query_ray_steps`` / ``query_density_top_n``
+    flags). The backend applies the SAME stencil clamp at expansion, so
+    a stale staged row can never out-run the configured stencil."""
+
+    cube_size: int = 16
+    stencil_max: int = 3
+    ray_steps_max: int = 64
+    density_top_n: int = 16
+
+
+@dataclass(frozen=True)
+class QueryKind:
+    kind: int
+    name: str
+    wire: str
+    parse: Callable[[dict, QueryLimits], np.ndarray]
+
+
+def _unit_dir(payload: dict) -> tuple[float, float, float]:
+    raw = payload.get("dir")
+    if (
+        not isinstance(raw, (list, tuple)) or len(raw) != 3
+        or not all(isinstance(v, (int, float)) for v in raw)
+    ):
+        raise ValueError("dir must be a [x, y, z] number triple")
+    dx, dy, dz = (float(v) for v in raw)
+    if not all(math.isfinite(v) for v in (dx, dy, dz)):
+        raise ValueError("dir components must be finite")
+    norm = math.sqrt(dx * dx + dy * dy + dz * dz)
+    if norm == 0.0:
+        raise ValueError("dir must be non-zero")
+    return dx / norm, dy / norm, dz / norm
+
+
+def _finite_pos(payload: dict, key: str) -> float:
+    raw = payload.get(key)
+    if not isinstance(raw, (int, float)) or not math.isfinite(float(raw)):
+        raise ValueError(f"{key} must be a finite number")
+    value = float(raw)
+    if value <= 0.0:
+        raise ValueError(f"{key} must be > 0")
+    return value
+
+
+def _row(*lanes: float) -> np.ndarray:
+    out = np.zeros(PARAM_LANES, np.float64)
+    out[: len(lanes)] = lanes
+    return out
+
+
+def _parse_cone(payload: dict, limits: QueryLimits) -> np.ndarray:
+    ux, uy, uz = _unit_dir(payload)
+    half_deg = _finite_pos(payload, "half_angle_deg")
+    if half_deg > 180.0:
+        raise ValueError("half_angle_deg must be <= 180")
+    rng = min(
+        _finite_pos(payload, "range"),
+        float(limits.stencil_max * limits.cube_size),
+    )
+    return _row(ux, uy, uz, math.cos(math.radians(half_deg)), rng)
+
+
+def _parse_raycast(payload: dict, limits: QueryLimits) -> np.ndarray:
+    ux, uy, uz = _unit_dir(payload)
+    max_t = min(
+        _finite_pos(payload, "max_t"),
+        limits.ray_steps_max * (limits.cube_size / 2.0),
+    )
+    mode = payload.get("mode", "first_hit")
+    if mode not in ("first_hit", "all_hits"):
+        raise ValueError("mode must be 'first_hit' or 'all_hits'")
+    lane = RAY_ALL_HITS if mode == "all_hits" else RAY_FIRST_HIT
+    return _row(ux, uy, uz, max_t, lane)
+
+
+def _parse_knn(payload: dict, limits: QueryLimits) -> np.ndarray:
+    k = payload.get("k")
+    if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+        raise ValueError("k must be a positive integer")
+    max_range = min(
+        _finite_pos(payload, "max_range"),
+        float(limits.stencil_max * limits.cube_size),
+    )
+    return _row(float(min(k, KNN_K_CAP)), max_range)
+
+
+def _parse_density(payload: dict, limits: QueryLimits) -> np.ndarray:
+    extent = payload.get("extent", 1)
+    if not isinstance(extent, int) or isinstance(extent, bool) or extent < 0:
+        raise ValueError("extent must be a non-negative integer")
+    top_n = payload.get("top_n", limits.density_top_n)
+    if not isinstance(top_n, int) or isinstance(top_n, bool) or top_n < 1:
+        raise ValueError("top_n must be a positive integer")
+    return _row(
+        float(min(extent, limits.stencil_max)),
+        float(min(top_n, limits.density_top_n)),
+    )
+
+
+_REGISTRY: dict[int, QueryKind] = {}
+_BY_WIRE: dict[str, QueryKind] = {}
+
+
+def register(kind: QueryKind) -> QueryKind:
+    if kind.kind in _REGISTRY or kind.wire in _BY_WIRE:
+        raise ValueError(f"query kind {kind.kind}/{kind.wire} already registered")
+    _REGISTRY[kind.kind] = kind
+    _BY_WIRE[kind.wire] = kind
+    return kind
+
+
+CONE = register(QueryKind(KIND_CONE, "cone", "query.cone", _parse_cone))
+RAYCAST = register(
+    QueryKind(KIND_RAYCAST, "raycast", "query.raycast", _parse_raycast)
+)
+KNN = register(QueryKind(KIND_KNN, "knn", "query.knn", _parse_knn))
+DENSITY = register(
+    QueryKind(KIND_DENSITY, "density", "query.density", _parse_density)
+)
+
+
+def registered_kinds() -> list[QueryKind]:
+    """Registered kinds ordered by id (stable for tier walks)."""
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def kind_by_id(kind: int) -> QueryKind | None:
+    return _REGISTRY.get(kind)
+
+
+def kind_by_wire(parameter: str) -> QueryKind | None:
+    """The kind whose wire parameter matches, else None. Reply
+    parameters (``query.<name>.result``) deliberately do NOT resolve —
+    a reply re-ingested as a request must fall through to the plain
+    radius path, not loop."""
+    return _BY_WIRE.get(parameter)
+
+
+def wire_names() -> set[str]:
+    """Every wire parameter the library answers, plus its reply twin —
+    the allow-list the ``unregistered-query-kind`` lint rule checks
+    string literals against."""
+    out: set[str] = set()
+    for kind in _REGISTRY.values():
+        out.add(kind.wire)
+        out.add(kind.wire + ".result")
+    return out

@@ -1,0 +1,75 @@
+"""Filtered k-nearest: distance-ordered stencil probes on device.
+
+The index holds CUBES, not exact peer positions, so "k nearest" is
+defined on the cube lattice: walk the stencil cubes in ascending
+squared displacement ``|u·size|²`` and collect subscribed peers (the
+replication predicate rides the probe rows' ``repl`` lanes through the
+existing device filter) until ``k`` distinct peers are found. Within
+one cube, peers tie-break by uuid; across cubes at equal distance, by
+stencil index — fully deterministic, pinned lane-for-lane by the
+oracle.
+
+The ordering kernel reuses the packed single-sort top-k idiom from
+``ops/tick.py``'s stencil-kNN (TPU-KNN's blocked-selection insight,
+arXiv:2206.14286): bitcast the f32 distance to its ordered uint32
+image, pack ``(d2_bits << 32) | stencil_idx`` into one uint64, and a
+single ``jnp.sort`` yields both the order and the tie-break — no
+argsort, no gather storm. f32 is exact enough here on purpose: equal
+f64 distances that f32 merges fall to the index tie-break, identically
+in kernel and oracle (both cast through f32).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..spatial import jaxconf  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+
+from ..utils import retrace
+
+
+@jax.jit
+def _knn_order_kernel(params, offsets, size):
+    """``[M, L]`` knn params × ``[S, 3]`` f64 offsets → (``order``
+    int32 ``[M, S]`` stencil indices ascending by (d2, idx), ``n_ok``
+    int32 ``[M]`` count of in-range probes per query)."""
+    dx = offsets[:, 0] * size
+    dy = offsets[:, 1] * size
+    dz = offsets[:, 2] * size
+    d2 = dx * dx + dy * dy + dz * dz                       # [S]
+    dist = jnp.sqrt(d2)
+    ok = dist[None, :] <= params[:, 1:2]                   # [M, S]
+    d2_bits = jax.lax.bitcast_convert_type(
+        d2.astype(jnp.float32), jnp.uint32
+    ).astype(jnp.uint64)                                   # [S]
+    idx = jnp.arange(d2.shape[0], dtype=jnp.uint64)
+    packed = jnp.where(
+        ok,
+        (d2_bits[None, :] << np.uint64(32)) | idx[None, :],
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+    packed = jnp.sort(packed, axis=1)
+    order = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    n_ok = jnp.sum(ok, axis=1, dtype=jnp.int32)
+    return order, n_ok
+
+
+retrace.GUARD.register("queries.knn_order", _knn_order_kernel)
+
+
+def knn_order(params: np.ndarray, offsets: np.ndarray,
+              cube_size: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host wrapper: → (order int32 ``[M, S]``, n_ok int32 ``[M]``).
+    Rows pad to a pow2 tier like the mask kernels (geometry._pad_rows)
+    so the jit shapes stay enumerable for the boot tier walk."""
+    from .geometry import _pad_rows
+
+    padded, m = _pad_rows(params)
+    order, n_ok = _knn_order_kernel(
+        jnp.asarray(padded, jnp.float64),
+        jnp.asarray(offsets, jnp.float64),
+        jnp.float64(cube_size),
+    )
+    return np.asarray(order)[:m], np.asarray(n_ok)[:m]

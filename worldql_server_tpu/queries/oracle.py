@@ -1,0 +1,203 @@
+"""CPU-parity oracles: the reference semantics for every query kind.
+
+Each oracle resolves ONE kind query through the host-authority
+``query_cube`` path — plain numpy + Python over the same cube-sampled
+contract :mod:`geometry` documents — and returns exactly the
+:class:`~worldql_server_tpu.queries.expand.KindResult` the device
+expansion + fold produces, lane for lane. The property suite
+(tests/test_queries.py) pins the two paths against each other across
+randomized worlds, replication modes, empty results and overflow;
+ResilientBackend's degraded CPU mirror and the plain
+:class:`CpuSpatialBackend` both answer kind queries through here
+(``SpatialBackend.match_local_batch``), so degradation keeps parity by
+construction.
+
+Geometry parity notes: displacements, dot products and distances are
+computed with the same f64 expressions, in the same order, as the
+device kernels (jax_enable_x64 is on); the kNN ordering casts squared
+distances through f32 exactly like the packed-sort kernel, so f32-tied
+probes fall to the identical index tie-break.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..spatial.quantize import cube_coords_batch
+from .results import KindResult, _uuid_key
+from .stencil import stencil_offsets, stencil_radius
+from .kinds import (
+    KIND_CONE,
+    KIND_DENSITY,
+    KIND_KNN,
+    KIND_RAYCAST,
+    RAY_ALL_HITS,
+)
+
+
+def _filtered(backend, world, cube, sender, replication) -> list:
+    from ..spatial.backend import _apply_replication
+
+    peers = backend.query_cube(world, cube)
+    return _apply_replication(peers, sender, replication)
+
+
+def _unique_cubes_keep_first(samples: np.ndarray, cube_size: int):
+    """Sample points → deduplicated cube labels, first occurrence
+    order — the oracle twin of ``expand._dedupe_keep_first``."""
+    cubes = cube_coords_batch(samples, cube_size)
+    _, first = np.unique(cubes, axis=0, return_index=True)
+    return cubes[np.sort(first)]
+
+
+def _pos_row(position) -> np.ndarray:
+    return np.array(
+        [position.x, position.y, position.z], np.float64
+    )
+
+
+def match_kind(backend, query, params: np.ndarray,
+               *, stencil_max: int = 3,
+               ray_steps_max: int = 64) -> KindResult:
+    """Resolve one kind query against ``backend``'s host index."""
+    p = np.asarray(params, np.float64)
+    kind = int(query.kind)
+    if kind == KIND_CONE:
+        return _match_cone(backend, query, p, stencil_max)
+    if kind == KIND_RAYCAST:
+        return _match_raycast(backend, query, p, ray_steps_max)
+    if kind == KIND_KNN:
+        return _match_knn(backend, query, p, stencil_max)
+    if kind == KIND_DENSITY:
+        return _match_density(backend, query, p, stencil_max)
+    return KindResult(kind, [])
+
+
+def _displacements(off: np.ndarray, cube_size: int):
+    size = np.float64(cube_size)
+    dx = off[:, 0] * size
+    dy = off[:, 1] * size
+    dz = off[:, 2] * size
+    d2 = dx * dx + dy * dy + dz * dz
+    return dx, dy, dz, d2
+
+
+def _match_cone(backend, query, p, stencil_max) -> KindResult:
+    size = backend.cube_size
+    off = stencil_offsets(
+        stencil_radius(p[4], size, stencil_max)
+    ).astype(np.float64)
+    dx, dy, dz, d2 = _displacements(off, size)
+    dist = np.sqrt(d2)
+    dot = dx * p[0] + dy * p[1] + dz * p[2]
+    mask = (dist <= p[4]) & ((dot >= dist * p[3]) | (d2 == 0.0))
+    samples = _pos_row(query.position) + np.stack(
+        [dx[mask], dy[mask], dz[mask]], axis=1
+    )
+    seen: set = set()
+    for cube in _unique_cubes_keep_first(samples, size):
+        seen.update(_filtered(
+            backend, query.world, tuple(int(c) for c in cube),
+            query.sender, query.replication,
+        ))
+    return KindResult(KIND_CONE, sorted(seen, key=_uuid_key))
+
+
+def _match_raycast(backend, query, p, ray_steps_max) -> KindResult:
+    size = backend.cube_size
+    half = float(size) / 2.0
+    max_t = p[3]
+    all_hits = p[4] == RAY_ALL_HITS
+    origin = _pos_row(query.position)
+    unit = p[0:3]
+    peers: list = []
+    ts: list = []
+    hit_seen: set = set()
+    cube_seen: set = set()
+    for j in range(int(ray_steps_max) + 1):
+        t = np.float64(j) * np.float64(half)
+        if t > max_t:
+            break
+        sample = origin + unit * t
+        cube = tuple(
+            int(c) for c in cube_coords_batch(sample[None, :], size)[0]
+        )
+        if cube in cube_seen:
+            continue
+        cube_seen.add(cube)
+        hit = sorted(set(_filtered(
+            backend, query.world, cube, query.sender, query.replication,
+        )), key=_uuid_key)
+        if not hit:
+            continue
+        if not all_hits:
+            return KindResult(
+                KIND_RAYCAST, hit, {"t": float(t), "mode": "first_hit"}
+            )
+        for u in hit:
+            if u not in hit_seen:
+                hit_seen.add(u)
+                peers.append(u)
+                ts.append(float(t))
+    if not all_hits:
+        return KindResult(KIND_RAYCAST, [], {"t": None, "mode": "first_hit"})
+    return KindResult(KIND_RAYCAST, peers, {"ts": ts, "mode": "all_hits"})
+
+
+def _match_knn(backend, query, p, stencil_max) -> KindResult:
+    size = backend.cube_size
+    off = stencil_offsets(
+        stencil_radius(p[1], size, stencil_max)
+    ).astype(np.float64)
+    dx, dy, dz, d2 = _displacements(off, size)
+    dist = np.sqrt(d2)
+    ok = dist <= p[1]
+    # the kernel's packed-sort order: f32 distance image, index ties
+    d2_32 = d2.astype(np.float32)
+    order = np.lexsort((np.arange(off.shape[0]), d2_32))
+    k = int(p[0])
+    origin = _pos_row(query.position)
+    peers: list = []
+    seen: set = set()
+    cube_seen: set = set()
+    for s in order:
+        if not ok[s] or len(peers) >= k:
+            continue
+        sample = origin + np.array([dx[s], dy[s], dz[s]], np.float64)
+        cube = tuple(
+            int(c) for c in cube_coords_batch(sample[None, :], size)[0]
+        )
+        if cube in cube_seen:
+            continue
+        cube_seen.add(cube)
+        for u in sorted(set(_filtered(
+            backend, query.world, cube, query.sender, query.replication,
+        )), key=_uuid_key):
+            if u not in seen:
+                seen.add(u)
+                peers.append(u)
+                if len(peers) >= k:
+                    break
+    return KindResult(KIND_KNN, peers, {"k": k})
+
+
+def _match_density(backend, query, p, stencil_max) -> KindResult:
+    size = backend.cube_size
+    off = stencil_offsets(
+        max(1, min(int(stencil_max), int(p[0])))
+    ).astype(np.float64)
+    cheb = np.max(np.abs(off), axis=1)
+    mask = cheb <= p[0]
+    samples = _pos_row(query.position) + off[mask] * np.float64(size)
+    entries = []
+    for cube in _unique_cubes_keep_first(samples, size):
+        cube_t = tuple(int(c) for c in cube)
+        count = len(backend.query_cube(query.world, cube_t))
+        if count:
+            entries.append((*cube_t, count))
+    entries.sort(key=lambda e: (-e[3], e[0], e[1], e[2]))
+    top_n = int(p[1])
+    return KindResult(
+        KIND_DENSITY, [],
+        {"cubes": [list(e) for e in entries[:top_n]]},
+    )

@@ -1,0 +1,30 @@
+"""The kind-query result row (jax-free — shared by device fold, CPU
+oracles, wire reply building and the ticker's pair builder)."""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+
+
+def _uuid_key(u: uuid_mod.UUID) -> int:
+    return u.int
+
+
+class KindResult:
+    """One kind query's folded result: the reply-frame payload plus the
+    (possibly empty) peer list. Always truthy — an empty cone still
+    owes its sender a reply frame, unlike a radius row with no
+    listeners."""
+
+    __slots__ = ("kind", "peers", "extra")
+
+    def __init__(self, kind: int, peers: list, extra: dict | None = None):
+        self.kind = kind
+        self.peers = peers
+        self.extra = extra or {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"KindResult(kind={self.kind}, peers={len(self.peers)}, "
+            f"extra={self.extra})"
+        )

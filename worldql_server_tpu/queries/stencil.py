@@ -1,0 +1,41 @@
+"""Stencil lattice helpers shared by kernels and oracles (jax-free).
+
+Split from :mod:`geometry` so the CPU-only paths (base
+``match_local_batch``, the resilient mirror, the oracles) never import
+jax: the *cube-sampled* candidate contract — one lattice point per
+cube, never arithmetic in label space — is documented there.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_STENCILS: dict[int, np.ndarray] = {}
+
+
+def stencil_offsets(radius: int) -> np.ndarray:
+    """``[(2r+1)³, 3]`` int64 lattice offsets in lexicographic order
+    (x-major, each axis ``-r..r`` ascending) — the canonical probe
+    order for every kind except kNN (which re-orders by distance)."""
+    radius = int(radius)
+    cached = _STENCILS.get(radius)
+    if cached is None:
+        axis = np.arange(-radius, radius + 1, dtype=np.int64)
+        ux, uy, uz = np.meshgrid(axis, axis, axis, indexing="ij")
+        cached = np.ascontiguousarray(
+            np.stack([ux.ravel(), uy.ravel(), uz.ravel()], axis=1)
+        )
+        cached.setflags(write=False)
+        _STENCILS[radius] = cached
+    return cached
+
+
+def stencil_radius(reach: np.ndarray | float, cube_size: int,
+                   stencil_max: int) -> int:
+    """Stencil radius in cubes covering a world-units ``reach``:
+    ``min(stencil_max, ceil(reach / cube_size))``, floor 1. Computed
+    identically by the device expansion and the oracles — the clamp is
+    part of the query semantics, not an implementation detail."""
+    reach = float(np.max(reach)) if np.ndim(reach) else float(reach)
+    cubes = int(np.ceil(reach / float(cube_size))) if reach > 0 else 1
+    return max(1, min(int(stencil_max), cubes))

@@ -1,0 +1,86 @@
+"""Wire contract for the query library.
+
+Requests are plain LocalMessages with a parameter-namespaced kind
+(``query.cone`` / ``query.raycast`` / ``query.knn`` / ``query.density``)
+and a JSON payload in ``flex`` (``data`` accepted as a fallback for
+text-only clients). They flow through the normal LocalMessage pipeline
+— admission, governor, staging — with the kind + parsed parameter
+lanes riding the staged columns. Results come back as *reply frames*:
+a LocalMessage with ``parameter="query.<kind>.result"`` and a JSON
+``flex`` body, delivered to the requesting peer only.
+
+Reply bodies (all peers as lowercase hex uuids):
+
+* cone —    ``{"kind": "cone", "peers": [...]}``
+* raycast — ``{"kind": "raycast", "mode": "first_hit", "peers": [...],
+  "t": <float|null>}`` or ``{"mode": "all_hits", "peers": [...],
+  "ts": [...]}``
+* knn —     ``{"kind": "knn", "k": <int>, "peers": [...]}``
+* density — ``{"kind": "density", "cubes": [[cx, cy, cz, count], ...]}``
+
+A malformed payload is dropped at the router with a log line (the
+sender keeps its session; a hostile payload must not cost a tick), and
+reply parameters never resolve back to a kind — re-ingesting a reply
+is just a radius message.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..protocol.types import Instruction, Message
+from .kinds import QueryKind, QueryLimits, kind_by_wire
+from .results import KindResult
+
+
+def parse_query_message(message: Message, limits: QueryLimits):
+    """→ ``(QueryKind, params tuple)`` for a query-parameter
+    LocalMessage, or ``None`` when the parameter is not a registered
+    kind. Raises ``ValueError`` on a malformed payload."""
+    kind = kind_by_wire(message.parameter or "")
+    if kind is None:
+        return None
+    if message.flex:
+        try:
+            payload = json.loads(message.flex.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ValueError(f"bad {kind.wire} payload: {exc}") from None
+    elif message.data:
+        try:
+            payload = json.loads(message.data)
+        except json.JSONDecodeError as exc:
+            raise ValueError(f"bad {kind.wire} payload: {exc}") from None
+    else:
+        payload = {}
+    if not isinstance(payload, dict):
+        raise ValueError(f"{kind.wire} payload must be a JSON object")
+    return kind, tuple(kind.parse(payload, limits))
+
+
+def build_reply(message: Message, kind: QueryKind,
+                result: KindResult) -> Message:
+    """The reply frame for one resolved kind query — addressed to the
+    requesting peer by the delivery pair, not by this frame."""
+    body: dict = {"kind": kind.name}
+    extra = result.extra
+    if kind.name == "raycast":
+        body["mode"] = extra.get("mode", "first_hit")
+        body["peers"] = [u.hex for u in result.peers]
+        if body["mode"] == "all_hits":
+            body["ts"] = extra.get("ts", [])
+        else:
+            body["t"] = extra.get("t")
+    elif kind.name == "density":
+        body["cubes"] = extra.get("cubes", [])
+    else:
+        if kind.name == "knn":
+            body["k"] = extra.get("k")
+        body["peers"] = [u.hex for u in result.peers]
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        parameter=f"{kind.wire}.result",
+        sender_uuid=message.sender_uuid,
+        world_name=message.world_name,
+        position=message.position,
+        flex=json.dumps(body, separators=(",", ":")).encode("utf-8"),
+    )

@@ -365,20 +365,27 @@ class ResilientBackend(SpatialBackend):
         )
 
     def dispatch_staged_batch(
-        self, world_ids, positions, sender_ids, repls, fallback=None,
+        self, world_ids, positions, sender_ids, repls,
+        kinds=None, params=None, fallback=None,
     ):
         """Staged dispatch with the same containment as the list path.
         The mirror fallback needs LocalQuery objects — the staged
         columns carry interned ids that die with a failed inner
         backend — so the ticker's retained ``(message, query)`` pairs
         (``fallback``) are the re-resolve source; extracting them is
-        O(m) Python paid ONLY on the failure path."""
+        O(m) Python paid ONLY on the failure path. The query-library
+        ``kinds``/``params`` lanes pass straight through: on the
+        degraded path the fallback LocalQuery rows still carry their
+        kind, so the mirror answers them through the CPU oracles
+        (``SpatialBackend.match_local_batch``) with identical
+        semantics."""
         if not self.failed_over:
             try:
                 failpoints.fire("backend.dispatch")
                 return _Inflight(
                     self.inner.dispatch_staged_batch(
-                        world_ids, positions, sender_ids, repls
+                        world_ids, positions, sender_ids, repls,
+                        kinds, params,
                     ),
                     fallback,
                 )

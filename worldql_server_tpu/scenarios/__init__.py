@@ -10,7 +10,7 @@ tests/test_scenarios.py.
 
 from .catalog import (
     BattleRoyale, ClusterFlashCrowd, FlashCrowd, GameTick,
-    ReconnectStorm, ReconnectStormReplay,
+    ProjectileStorm, ReconnectStorm, ReconnectStormReplay, SniperScope,
 )
 from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
 
@@ -19,6 +19,7 @@ CATALOG = {
     for scenario in (
         FlashCrowd, BattleRoyale, ReconnectStorm, GameTick,
         ReconnectStormReplay, ClusterFlashCrowd,
+        SniperScope, ProjectileStorm,
     )
 }
 
@@ -29,10 +30,12 @@ __all__ = [
     "ClusterFlashCrowd",
     "FlashCrowd",
     "GameTick",
+    "ProjectileStorm",
     "ReconnectStorm",
     "ReconnectStormReplay",
     "Scenario",
     "ScenarioContext",
+    "SniperScope",
     "format_report",
     "run_scenario",
 ]

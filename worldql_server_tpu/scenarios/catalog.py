@@ -21,6 +21,7 @@ the CLI, bench config 10 and the test suite.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 import uuid as uuid_mod
 
@@ -685,6 +686,362 @@ class GameTick(Scenario):
                   slo["heartbeat_p99_ms"] <= hb_limit,
                   slo["heartbeat_p99_ms"], f"<= {hb_limit} ms"),
             Check("queue_drained", slo["drained"], slo["drained"], True),
+        ]
+
+
+def _cube_of(x: float, y: float, z: float, size: int) -> tuple[int, int, int]:
+    """The subscription-cube label of a position — computed through the
+    REAL quantizer, so scenario expectations can never drift from the
+    max-corner grid convention."""
+    from ..spatial.quantize import cube_coords_batch
+
+    row = cube_coords_batch(np.array([[x, y, z]], np.float64), size)[0]
+    return tuple(int(c) for c in row)
+
+
+async def _query_roundtrip(peer: ZmqPeer, world: str, position: Vector3,
+                           wire: str, payload: dict,
+                           timeout: float = 10.0) -> dict:
+    """Send one kind query over the wire and await ITS reply frame
+    (``<wire>.result``), decoded from the JSON flex body."""
+    await peer.send(Message(
+        instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+        position=position, parameter=wire,
+        flex=json.dumps(payload).encode("utf-8"),
+    ))
+    deadline = time.perf_counter() + timeout
+    while True:
+        left = deadline - time.perf_counter()
+        if left <= 0:
+            raise asyncio.TimeoutError(f"no {wire}.result within {timeout}s")
+        reply = await peer.recv(left)
+        if (
+            reply.instruction == Instruction.LOCAL_MESSAGE
+            and reply.parameter == f"{wire}.result"
+            and reply.flex
+        ):
+            return json.loads(reply.flex.decode("utf-8"))
+
+
+class SniperScope(Scenario):
+    """Cone-of-sight + raycast over the real wire (ISSUE 17): a sniper
+    peer interrogates a laid-out world through ``query.cone`` and
+    ``query.raycast`` LocalMessages and every reply frame is checked
+    against the EXACT geometric expectation — narrow cone sees only the
+    on-axis targets, widening past 90° admits the flanker but never the
+    peer behind, first-hit returns the nearest occupied cube before the
+    farther one, an empty ray is still answered, the sender never
+    appears in its own results, and a hostile malformed payload is
+    dropped with a counter while the session survives."""
+
+    name = "sniper_scope"
+    description = "cone + raycast queries with exact geometric answers"
+
+    def build_config(self, shape: str) -> Config:
+        return Config(
+            store_url="memory://",
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+            spatial_backend="tpu", tick_interval=0.02,
+            precompile_tiers=False,
+            sub_region_size=16,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        world = "scope"
+        sniper = await ctx.connect()
+        # the range: one cube-spaced lane along +x from the sniper, a
+        # flanker 90° off-axis, a target square behind the scope
+        layout = {
+            "near": Vector3(24.0, 8.0, 8.0),
+            "far": Vector3(40.0, 8.0, 8.0),
+            "flank": Vector3(8.0, 40.0, 8.0),
+            "behind": Vector3(-24.0, 8.0, 8.0),
+        }
+        targets = {name: await ctx.connect() for name in layout}
+        apex = Vector3(8.0, 8.0, 8.0)
+        await sniper.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name=world, position=apex,
+        ))
+        for name, peer in targets.items():
+            await peer.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name=world, position=layout[name],
+            ))
+        deadline = time.perf_counter() + 10.0
+        while ctx.server.backend.subscription_count() < 5:
+            if time.perf_counter() > deadline:
+                raise AssertionError("subscriptions never landed")
+            await asyncio.sleep(0.02)
+
+        hexes = {name: peer.uuid.hex for name, peer in targets.items()}
+        replies: dict[str, dict] = {}
+        # first reply pays the kind-kernel jit compile on a cold server
+        replies["narrow"] = await _query_roundtrip(
+            sniper, world, apex, "query.cone",
+            {"dir": [1, 0, 0], "half_angle_deg": 30, "range": 48},
+            timeout=90.0,
+        )
+        replies["wide"] = await _query_roundtrip(
+            sniper, world, apex, "query.cone",
+            {"dir": [1, 0, 0], "half_angle_deg": 95, "range": 48},
+        )
+        replies["first_hit"] = await _query_roundtrip(
+            sniper, world, apex, "query.raycast",
+            {"dir": [1, 0, 0], "max_t": 48},
+        )
+        replies["all_hits"] = await _query_roundtrip(
+            sniper, world, apex, "query.raycast",
+            {"dir": [1, 0, 0], "max_t": 48, "mode": "all_hits"},
+        )
+        replies["empty_ray"] = await _query_roundtrip(
+            sniper, world, apex, "query.raycast",
+            {"dir": [0, 0, 1], "max_t": 48},
+        )
+
+        # hostile payload: not even JSON — dropped at the router with a
+        # counter, never a tick or the session
+        malformed0 = ctx.counters().get("queries.malformed", 0)
+        await sniper.send(Message(
+            instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+            position=apex, parameter="query.cone", flex=b"{broken",
+        ))
+        deadline = time.perf_counter() + 5.0
+        while ctx.counters().get("queries.malformed", 0) <= malformed0:
+            if time.perf_counter() > deadline:
+                break
+            await asyncio.sleep(0.02)
+
+        drained = await ctx.drain_ticker()
+        counters = ctx.counters()
+        all_hit_t = dict(zip(
+            replies["all_hits"]["peers"], replies["all_hits"]["ts"]
+        ))
+        sniper_leaked = any(
+            sniper.uuid.hex in r.get("peers", ()) for r in replies.values()
+        )
+        return {
+            "hexes": hexes,
+            "narrow_peers": sorted(replies["narrow"]["peers"]),
+            "wide_peers": sorted(replies["wide"]["peers"]),
+            "first_hit_peers": replies["first_hit"]["peers"],
+            "first_hit_t": replies["first_hit"]["t"],
+            "all_hits_t_by_peer": all_hit_t,
+            "empty_ray_peers": replies["empty_ray"]["peers"],
+            "empty_ray_t": replies["empty_ray"]["t"],
+            "sniper_in_own_results": sniper_leaked,
+            "malformed_dropped":
+                counters.get("queries.malformed", 0) - malformed0,
+            "kind_replies": counters.get("queries.kind_replies", 0),
+            "drained": drained,
+            "broker_answers": await ctx.heartbeat_ok(sniper),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        hexes = slo["hexes"]
+        lane = sorted([hexes["near"], hexes["far"]])
+        wide = sorted([hexes["near"], hexes["far"], hexes["flank"]])
+        t_near = slo["all_hits_t_by_peer"].get(hexes["near"])
+        t_far = slo["all_hits_t_by_peer"].get(hexes["far"])
+        ladder_ok = (
+            set(slo["all_hits_t_by_peer"]) == {hexes["near"], hexes["far"]}
+            and t_near is not None and t_far is not None
+            and 0.0 < t_near < t_far <= 48.0
+        )
+        return [
+            Check("narrow_cone_sees_exactly_the_lane",
+                  slo["narrow_peers"] == lane,
+                  slo["narrow_peers"], lane),
+            Check("wide_cone_admits_flanker_never_behind",
+                  slo["wide_peers"] == wide,
+                  slo["wide_peers"], wide,
+                  "95° half-angle: flanker in, the peer behind out"),
+            Check("first_hit_is_the_nearest_cube",
+                  slo["first_hit_peers"] == [hexes["near"]]
+                  and slo["first_hit_t"] is not None,
+                  slo["first_hit_peers"], [hexes["near"]]),
+            Check("all_hits_ladder_ordered", ladder_ok,
+                  slo["all_hits_t_by_peer"],
+                  "near strictly before far, both within max_t"),
+            Check("empty_ray_still_answered",
+                  slo["empty_ray_peers"] == []
+                  and slo["empty_ray_t"] is None,
+                  slo["empty_ray_peers"], [],
+                  "the sender is owed a reply frame either way"),
+            Check("sender_never_in_own_results",
+                  not slo["sniper_in_own_results"],
+                  slo["sniper_in_own_results"], False),
+            Check("malformed_payload_dropped_with_counter",
+                  slo["malformed_dropped"] >= 1,
+                  slo["malformed_dropped"], ">= 1"),
+            Check("kind_replies_accounted",
+                  slo["kind_replies"] >= 5,
+                  slo["kind_replies"], ">= 5"),
+            Check("broker_answers_after_malformed_probe",
+                  slo["broker_answers"], slo["broker_answers"], True),
+        ]
+
+
+class ProjectileStorm(Scenario):
+    """A sustained mixed kind-query storm (ISSUE 17): a firing line
+    with a 3-peer hotspot cube drives ``query.knn`` +
+    ``query.raycast`` + ``query.density`` rounds concurrently through
+    the batched tick path, request-response paced so every reply is
+    accounted. The last round's replies are checked EXACTLY — the kNN
+    neighbor ladder (nearest cube first, then the hotspot pair in uuid
+    order), the raycast peer→t hit map, the density survey with the
+    hotspot count on top — and the density results must have fed the
+    live region heatmap the /metrics gauge and /debug/heatmap read."""
+
+    name = "projectile_storm"
+    description = "mixed knn/raycast/density storm feeding the heatmap"
+
+    def build_config(self, shape: str) -> Config:
+        return Config(
+            store_url="memory://",
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+            spatial_backend="tpu", tick_interval=0.02,
+            precompile_tiers=False,
+            sub_region_size=16,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        world = "warzone"
+        rounds = 8 if ctx.smoke else 30
+        size = ctx.config.sub_region_size
+        # three shooters share ONE cube (the hotspot the density query
+        # must rank first); two more hold the lane cubes along +x
+        spots = [
+            Vector3(4.0, 8.0, 8.0), Vector3(8.0, 8.0, 8.0),
+            Vector3(12.0, 8.0, 8.0),                       # hotspot cube
+            Vector3(24.0, 8.0, 8.0), Vector3(40.0, 8.0, 8.0),
+        ]
+        shooters = [await ctx.connect() for _ in spots]
+        observer = await ctx.connect()
+        obs_spot = Vector3(8.0, 40.0, 8.0)
+        for peer, spot in zip(shooters, spots):
+            await peer.send(Message(
+                instruction=Instruction.AREA_SUBSCRIBE,
+                world_name=world, position=spot,
+            ))
+        await observer.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name=world, position=obs_spot,
+        ))
+        deadline = time.perf_counter() + 10.0
+        while ctx.server.backend.subscription_count() < len(spots) + 1:
+            if time.perf_counter() > deadline:
+                raise AssertionError("subscriptions never landed")
+            await asyncio.sleep(0.02)
+
+        requests0 = ctx.counters().get("queries.kind_requests", 0)
+        replies0 = ctx.counters().get("queries.kind_replies", 0)
+        heatmap = ctx.server.heatmap
+        updates0 = heatmap.updates if heatmap is not None else 0
+        survey_apex = Vector3(8.0, 8.0, 8.0)
+        last: dict[str, dict] = {}
+        for i in range(rounds):
+            # first round pays the kind-kernel jit compile cold
+            timeout = 90.0 if i == 0 else 15.0
+            knn, ray, density = await asyncio.gather(
+                _query_roundtrip(
+                    shooters[4], world, spots[4], "query.knn",
+                    {"k": 3, "max_range": 48}, timeout,
+                ),
+                _query_roundtrip(
+                    shooters[0], world, spots[0], "query.raycast",
+                    {"dir": [1, 0, 0], "max_t": 64, "mode": "all_hits"},
+                    timeout,
+                ),
+                _query_roundtrip(
+                    observer, world, survey_apex, "query.density",
+                    {"extent": 2, "top_n": 8}, timeout,
+                ),
+            )
+            last = {"knn": knn, "ray": ray, "density": density}
+
+        drained = await ctx.drain_ticker()
+        counters = ctx.counters()
+        hot = [s.uuid for s in shooters[:3]]
+        from ..queries.results import _uuid_key
+
+        hot_sorted = [u.hex for u in sorted(hot, key=_uuid_key)]
+        ray_t = dict(zip(last["ray"]["peers"], last["ray"]["ts"]))
+        expected_survey = sorted(
+            [
+                [*_cube_of(8.0, 8.0, 8.0, size), 3],     # the hotspot
+                [*_cube_of(24.0, 8.0, 8.0, size), 1],
+                [*_cube_of(40.0, 8.0, 8.0, size), 1],
+                [*_cube_of(8.0, 40.0, 8.0, size), 1],    # the observer
+            ],
+            key=lambda r: (-r[3], r[0], r[1], r[2]),
+        )
+        return {
+            "rounds": rounds,
+            "knn_k": last["knn"]["k"],
+            "knn_peers": last["knn"]["peers"],
+            "knn_expected": [shooters[3].uuid.hex, *hot_sorted[:2]],
+            "ray_t_by_peer": ray_t,
+            # the shooter's own hotspot cube answers at t=0 (minus the
+            # sender), the lane cubes at the first in-cube sample
+            "ray_expected": {
+                **{h: 0.0 for h in hot_sorted
+                   if h != shooters[0].uuid.hex},
+                shooters[3].uuid.hex: 16.0,
+                shooters[4].uuid.hex: 32.0,
+            },
+            "density_cubes": last["density"]["cubes"],
+            "density_expected": expected_survey,
+            "heatmap_top": heatmap.top() if heatmap is not None else [],
+            "heatmap_updates":
+                (heatmap.updates - updates0) if heatmap is not None else 0,
+            "kind_requests":
+                counters.get("queries.kind_requests", 0) - requests0,
+            "kind_replies":
+                counters.get("queries.kind_replies", 0) - replies0,
+            "drained": drained,
+            "broker_answers": await ctx.heartbeat_ok(observer),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        n = slo["rounds"] * 3
+        top = slo["heatmap_top"]
+        hot_cube = slo["density_expected"][0]
+        heatmap_hot = (
+            bool(top)
+            and top[0][0] == "warzone"
+            and top[0][1:4] == hot_cube[:3]
+            and top[0][4] == 3
+        )
+        return [
+            Check("knn_ladder_exact",
+                  slo["knn_k"] == 3
+                  and slo["knn_peers"] == slo["knn_expected"],
+                  slo["knn_peers"], slo["knn_expected"],
+                  "nearest lane cube first, then the hotspot pair in "
+                  "uuid order"),
+            Check("raycast_hit_map_exact",
+                  slo["ray_t_by_peer"] == slo["ray_expected"],
+                  slo["ray_t_by_peer"], slo["ray_expected"]),
+            Check("density_survey_exact",
+                  slo["density_cubes"] == slo["density_expected"],
+                  slo["density_cubes"], slo["density_expected"],
+                  "hotspot count 3 ranked first, full extent surveyed"),
+            Check("heatmap_tracked_the_hotspot", heatmap_hot,
+                  top[:1], f"['warzone', *{hot_cube[:3]}, 3]"),
+            Check("heatmap_updates_advanced",
+                  slo["heatmap_updates"] >= slo["rounds"],
+                  slo["heatmap_updates"], f">= {slo['rounds']}"),
+            Check("every_query_answered",
+                  slo["kind_requests"] >= n and slo["kind_replies"] >= n,
+                  (slo["kind_requests"], slo["kind_replies"]),
+                  f">= {n} each",
+                  "request-response paced: replies never lag requests"),
+            Check("queue_drained", slo["drained"], slo["drained"], True),
+            Check("broker_answers_after_storm", slo["broker_answers"],
+                  slo["broker_answers"], True),
         ]
 
 

@@ -45,10 +45,22 @@ class LocalQuery:
     position: Vector3
     sender: uuid_mod.UUID
     replication: Replication = Replication.EXCEPT_SELF
+    #: query-library kind (queries/kinds.py): 0 = plain radius row,
+    #: anything else routes through the kind-dispatched expansion with
+    #: ``params`` carrying the parsed f64 parameter lanes
+    kind: int = 0
+    params: tuple = ()
 
 
 class SpatialBackend(abc.ABC):
     """Subscription index + proximity query engine for all worlds."""
+
+    #: query-library expansion clamps (engine/config.py wires the
+    #: ``query_stencil_max`` / ``query_ray_steps`` flags through;
+    #: oracles and device expansion read the SAME values, so the clamp
+    #: is part of the query semantics on both paths)
+    query_stencil_max: int = 3
+    query_ray_steps: int = 64
 
     def __init__(self, cube_size: int):
         self.cube_size = cube_size
@@ -109,10 +121,23 @@ class SpatialBackend(abc.ABC):
         (local_message.rs:60-86).
 
         Base implementation loops ``query_cube``; accelerated backends
-        override with one fused device batch.
+        override with one fused device batch. Kind queries (``q.kind``
+        != 0) resolve through the library's CPU-parity oracles
+        (queries/oracle.py) to a ``KindResult`` row — this IS the
+        reference path the device expansion is pinned against, and the
+        degraded path ResilientBackend's CPU mirror answers with.
         """
-        out: list[list[uuid_mod.UUID]] = []
+        out: list = []
         for q in queries:  # wql: allow(per-query-python-loop) — the CPU reference path IS per-query
+            if q.kind:
+                from ..queries.oracle import match_kind
+
+                out.append(match_kind(
+                    self, q, q.params,
+                    stencil_max=self.query_stencil_max,
+                    ray_steps_max=self.query_ray_steps,
+                ))
+                continue
             peers = self.query_cube(q.world, q.position)
             out.append(_apply_replication(peers, q.sender, q.replication))
         return out
@@ -169,10 +194,14 @@ class SpatialBackend(abc.ABC):
         return 0
 
     def dispatch_staged_batch(
-        self, world_ids, positions, sender_ids, repls, fallback=None,
+        self, world_ids, positions, sender_ids, repls,
+        kinds=None, params=None, fallback=None,
     ):
         """Launch a batch from staged columnar arrays (already
-        interned). ``fallback`` is an opaque sequence of
+        interned). ``kinds``/``params`` are the query-library lanes
+        (i8 kind + f64 parameter rows); ``None`` — or an all-zero kind
+        column — is the pure-radius fast path, byte-for-byte the
+        pre-library pipeline. ``fallback`` is an opaque sequence of
         ``(message, LocalQuery)`` pairs a degraded wrapper may use to
         re-resolve the batch without the columns (robustness/
         resilient.py); array backends ignore it."""

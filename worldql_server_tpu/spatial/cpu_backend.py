@@ -120,6 +120,23 @@ class CpuSpatialBackend(SpatialBackend):
 
     # endregion
 
+    # region: query-library conveniences (tests, scenarios)
+
+    def query_kind(self, query) -> "object":
+        """Resolve one kind :class:`~worldql_server_tpu.spatial.backend.
+        LocalQuery` through the CPU oracles — the named single-query
+        face of the library (``match_local_batch`` is the batch
+        face)."""
+        from ..queries.oracle import match_kind
+
+        return match_kind(
+            self, query, query.params,
+            stencil_max=self.query_stencil_max,
+            ray_steps_max=self.query_ray_steps,
+        )
+
+    # endregion
+
     # region: introspection (tests, metrics)
 
     def world_names(self) -> list[str]:

@@ -65,6 +65,43 @@ def query_cap_ladder(backend, max_batch: int, min_batch: int | None):
     return out
 
 
+def _precompile_kind_tiers(backend, max_batch: int,
+                           *, max_calls: int = 96) -> dict:
+    """Query-library leg of the boot walk: warm every REGISTERED
+    kind's stencil kernel (queries/geometry.py, queries/knn.py) over
+    the kind-row tier ladder × the reachable stencil radii. The row
+    wrappers pad to pow2 tiers (geometry.KIND_ROW_FLOOR), so this
+    ladder is exactly the shape set serving can hit — with it walked,
+    a mixed-kind tick after boot keeps ``device.retraces == 0``. The
+    kernels are tiny (elementwise masks + one row sort), so the leg
+    gets its own small budget instead of competing with the dispatch
+    walk."""
+    try:
+        from ..queries.geometry import (
+            KIND_ROW_FLOOR, precompile_kind_kernels,
+        )
+        from ..queries.kinds import registered_kinds
+    except Exception:  # pragma: no cover - library unavailable/broken
+        logger.exception("kind-kernel precompilation unavailable")
+        return {"kind_dispatches": 0}
+    if not registered_kinds():
+        return {"kind_dispatches": 0}
+    calls = skipped = 0
+    stencil_max = int(getattr(backend, "query_stencil_max", 3))
+    tier = next_pow2(max(1, int(max_batch)), floor=KIND_ROW_FLOOR)
+    while tier >= KIND_ROW_FLOOR:
+        # largest shapes first, same priority logic as the main walk
+        for radius in range(1, stencil_max + 1):
+            if calls >= max_calls:
+                skipped += 1
+                continue
+            calls += precompile_kind_kernels(
+                tier, radius, backend.cube_size
+            )
+        tier //= 2
+    return {"kind_dispatches": calls, "kind_skipped_by_budget": skipped}
+
+
 def precompile_tiers(
     backend,
     *,
@@ -74,6 +111,7 @@ def precompile_tiers(
     include_pack: bool = True,
     max_compiles: int = 64,
     delivery_cap: int | None = None,
+    kind_tiers: bool = True,
 ) -> dict:
     """Trace every reachable hot-path kernel shape before serving.
 
@@ -100,8 +138,15 @@ def precompile_tiers(
             "tier precompilation skipped: empty index (no device "
             "segments to trace against)"
         )
+        # the kind stencil kernels trace against parameter shapes only
+        # — no index needed, so an empty-index boot still warms them
+        kind_stats = (
+            _precompile_kind_tiers(backend, max_batch) if kind_tiers
+            else {"kind_dispatches": 0}
+        )
         return {"skipped": "empty-index", "new_variants": 0,
-                "dispatches": 0, "pack_calls": 0, "wall_ms": 0.0}
+                "dispatches": 0, "pack_calls": 0, "wall_ms": 0.0,
+                **kind_stats}
 
     before = GUARD.counts()
     nseg = len(segs)
@@ -175,6 +220,10 @@ def precompile_tiers(
                 pack_calls += 1
                 bucket *= 2
 
+    kind_stats = (
+        _precompile_kind_tiers(backend, max_batch) if kind_tiers
+        else {"kind_dispatches": 0}
+    )
     delta = GUARD.delta(before)
     stats = {
         "dispatches": dispatches,
@@ -183,6 +232,7 @@ def precompile_tiers(
         "new_variants": sum(delta.values()),
         "families": delta,
         "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        **kind_stats,
     }
     logger.info(
         "tier precompilation: %d dispatch + %d pack shapes walked, "

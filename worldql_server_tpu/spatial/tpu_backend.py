@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from ..protocol.types import Replication, Vector3
+from ..queries.kinds import PARAM_LANES as _QUERY_PARAM_LANES
 from ..utils import retrace
 from .backend import Cube, LocalQuery, SpatialBackend, to_cube
 from .delta_ticks import TemporalCoherence, row_signatures
@@ -950,6 +951,10 @@ class TpuSpatialBackend(SpatialBackend):
         #: the bench smoke gate asserts the staged path actually fired
         self.staged_dispatches = 0
         self.list_dispatches = 0
+        #: mixed-kind batches routed through the query-library probe
+        #: expansion (queries/expand.py) — pure-radius ticks never
+        #: touch that path, so the bench parity leg can assert it fired
+        self.kind_expansions = 0
 
         # Delta ticks (ROADMAP 2, spatial/delta_ticks.py): per-cube
         # dirty tracking from the churn stream + the result-reuse
@@ -2458,6 +2463,19 @@ class TpuSpatialBackend(SpatialBackend):
         repls = np.fromiter(
             (int(q.replication) for q in queries), dtype=np.int8, count=m  # wql: allow(per-query-python-loop) — the legacy list-path encode
         )
+        if any(q.kind for q in queries):  # wql: allow(per-query-python-loop) — the legacy list-path encode
+            kind_col = np.fromiter(
+                (q.kind for q in queries), dtype=np.int8, count=m  # wql: allow(per-query-python-loop) — the legacy list-path encode
+            )
+            par_col = np.zeros((m, _QUERY_PARAM_LANES), np.float64)
+            for i, q in enumerate(queries):  # wql: allow(per-query-python-loop) — the legacy list-path encode
+                if q.params:
+                    par_col[i, : len(q.params)] = q.params
+            self.list_dispatches += 1
+            return self._dispatch_kind_batch(
+                world_ids, positions, sender_ids, repls,
+                kind_col, par_col, staged=False,
+            )
         self.list_dispatches += 1
         if self._delta_ticks:
             # object-list dispatches (staging desync, CPU-compat API)
@@ -2476,16 +2494,25 @@ class TpuSpatialBackend(SpatialBackend):
         )
 
     def dispatch_staged_batch(
-        self, world_ids, positions, sender_ids, repls, fallback=None,
+        self, world_ids, positions, sender_ids, repls,
+        kinds=None, params=None, fallback=None,
     ):
         """Launch a batch straight from the ticker's staged columnar
         arrays — world/peer interning already happened at enqueue time
         (engine/staging.py), so this is zero per-query Python: one
         fused vectorized encode (native when built) and the launch.
+        A batch carrying non-radius ``kinds`` lanes routes through the
+        query-library probe expansion first; ``None`` or an all-zero
+        kind column is the pure-radius pipeline, byte for byte.
         ``fallback`` is ignored here (see robustness/resilient.py)."""
         m = len(world_ids)
         if m == 0:
             return (0, None, {})
+        if kinds is not None and np.any(kinds):
+            return self._dispatch_kind_batch(
+                world_ids, positions, sender_ids, repls,
+                kinds, params, staged=True,
+            )
         t_start = time.perf_counter()
         self.staged_dispatches += 1
         if self._delta_ticks:
@@ -2496,6 +2523,38 @@ class TpuSpatialBackend(SpatialBackend):
             m, world_ids, positions, sender_ids, repls, t_start,
             staged=True,
         )
+
+    def _dispatch_kind_batch(
+        self, world_ids, positions, sender_ids, repls, kinds, params,
+        *, staged: bool,
+    ):
+        """Kind-dispatched leg of both dispatch paths: expand the mixed
+        batch into pure-radius probe rows (queries/expand.py) — device
+        stencil kernels pick the candidate cubes per kind — then send
+        the probes through the NORMAL staged pipeline against the same
+        persistent index (same CSR delivery, same capacity tiers, and
+        delta-tick reuse at probe granularity: probes are
+        content-addressed rows, so a repeated cone replays its cached
+        cubes). Collect sees a ``("qk", plan, inner)`` handle and folds
+        the per-probe fan-outs back into one result per query."""
+        from ..queries.expand import expand_staged
+
+        m = len(world_ids)
+        plan, p_wid, p_pos, p_sid, p_repl = expand_staged(
+            world_ids, positions, sender_ids, repls, kinds, params,
+            cube_size=self.cube_size,
+            stencil_max=self.query_stencil_max,
+            ray_steps_max=self.query_ray_steps,
+        )
+        self.kind_expansions += 1
+        if staged:
+            inner = self.dispatch_staged_batch(p_wid, p_pos, p_sid, p_repl)
+        else:
+            inner = self._dispatch_encoded(
+                len(p_wid), p_wid, p_pos, p_sid, p_repl,
+                time.perf_counter(), staged=False,
+            )
+        return (m, ("qk", plan, inner), inner[2])
 
     def _dispatch_delta(
         self, m, world_ids, positions, sender_ids, repls, t_start,
@@ -2674,6 +2733,15 @@ class TpuSpatialBackend(SpatialBackend):
         m, payload, timing = handle
         if payload is None:
             return [[] for _ in range(m)]
+        if payload[0] == "qk":
+            # kind-expanded batch: collect the probe fan-outs through
+            # whatever path the inner dispatch took (CSR, dense, delta
+            # replay), then fold them per original query
+            from ..queries.expand import fold_collected
+
+            return fold_collected(
+                payload[1], self.collect_local_batch(payload[2])
+            )
         if payload[0] == "tc":
             # delta-tick handle: replayed rows + dirty sub-batch; the
             # inner handle (when any) carries its own timing legs
@@ -3002,6 +3070,7 @@ class TpuSpatialBackend(SpatialBackend):
             "full_fetches": self.full_fetches,
             "staged_dispatches": self.staged_dispatches,
             "list_dispatches": self.list_dispatches,
+            "kind_expansions": self.kind_expansions,
             "last_fetch_bytes": self.last_collect_stats["fetch_bytes"],
             "last_compaction_bucket":
                 self.last_collect_stats["compaction_bucket"],

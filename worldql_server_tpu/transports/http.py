@@ -42,6 +42,10 @@ class HttpTransport:
             # otherwise, so the route 404s like any unknown path
             app.router.add_get("/failpoints", self._get_failpoints)
             app.router.add_post("/failpoints", self._post_failpoints)
+        if getattr(self.server, "heatmap", None) is not None:
+            # region-density heatmap feed (queries/heatmap.py) — exists
+            # only with the query library on, 404s otherwise
+            app.router.add_get("/debug/heatmap", self._get_debug_heatmap)
         if getattr(self.server, "recorder", None) is not None:
             # flight recorder debug surface — exists only when tracing
             # is on (--trace / --slow-tick-ms), 404s otherwise
@@ -165,6 +169,18 @@ class HttpTransport:
             "ticks": ticks,
             "loose": recorder.loose_snapshot(),
         })
+
+    async def _get_debug_heatmap(self, request: web.Request) -> web.Response:
+        """Region-density snapshot: the decayed per-cube counts feeding
+        the ``wql_region_density`` gauge, grouped by world — the raw
+        heatmap a dashboard tiles. ``?n=`` caps the per-world rows."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        try:
+            n = int(request.query.get("n", 0)) or None
+        except ValueError:
+            return web.Response(status=400)
+        return web.json_response(self.server.heatmap.snapshot(n=n))
 
     async def _get_debug_profile(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
