@@ -683,6 +683,13 @@ def bench_delivery(args, *, delivery_workers: int = 0,
             }
             return results, e2e, {
                 "ticks": ticker.ticks if ticker else 0,
+                # outbound frame bytes at the delivery boundary
+                # (PeerMap.bytes_delivered, ISSUE 18) — the volume the
+                # interest manager exists to shrink
+                "bytes_delivered": server.peer_map.bytes_delivered,
+                "delta_ratio": server.metrics.snapshot()["gauges"].get(
+                    "frame.delta_ratio"
+                ),
                 "last_batch": ticker.last_batch if ticker else 0,
                 "last_tick_ms": round(ticker.last_tick_ms, 2)
                 if ticker else None,
@@ -728,6 +735,17 @@ def bench_delivery(args, *, delivery_workers: int = 0,
         # variants compare like for like)
         "delivery_e2e": e2e.get("delivery"),
         "server_ticks": tick_stats["ticks"],
+        # byte-volume accounting (ISSUE 18): lower is better — the
+        # perf gate pins these via tools/bench_diff's _BYTES_LOWER
+        "delivered_bytes_per_tick": round(
+            tick_stats["bytes_delivered"]
+            / max(tick_stats["ticks"], 1), 1
+        ),
+        "bytes_per_recipient_per_s": round(
+            tick_stats["bytes_delivered"] / n_clients
+            / max(elapsed, 1e-9), 1
+        ),
+        "frame_delta_ratio": tick_stats["delta_ratio"] or 0.0,
     }
     if plane_stats is not None:
         out["n_workers"] = delivery_workers
@@ -2900,6 +2918,8 @@ def bench_config8(args) -> dict:
                     stable = 0
                 prev_ticks, prev_compiles = ticks_now, compiles
             server.metrics.histograms.pop("frame.e2e_ms", None)
+            bytes0 = server.peer_map.bytes_delivered
+            ticks0 = plane_.applied_ticks
             end = time.perf_counter() + e2e_seconds
             while time.perf_counter() < end:
                 # stream updates to a rotating slice
@@ -2922,13 +2942,30 @@ def bench_config8(args) -> dict:
             hist = server.metrics.histograms.get("frame.e2e_ms")
             snap = hist.snapshot() if hist is not None else None
             stats = server.entity_plane.stats()
+            # byte volume over the measured window (ISSUE 18):
+            # bytes/tick at the delivery boundary plus the per-client
+            # wire rate — the leaves the interest bench (config 13)
+            # compares off vs on
+            bytes_win = server.peer_map.bytes_delivered - bytes0
+            ticks_win = plane_.applied_ticks - ticks0
+            vol = {
+                "delivered_bytes_per_tick": round(
+                    bytes_win / max(ticks_win, 1), 1
+                ),
+                "bytes_per_recipient_per_s": round(
+                    bytes_win / 2 / e2e_seconds, 1
+                ),
+                "frame_delta_ratio": server.metrics.snapshot()[
+                    "gauges"
+                ].get("frame.delta_ratio") or 0.0,
+            }
             await a.close()
             await b.close()
-            return snap, stats
+            return snap, stats, vol
         finally:
             await server.stop()
 
-    e2e_hist, e2e_stats = asyncio.run(e2e_scenario())
+    e2e_hist, e2e_stats, e2e_vol = asyncio.run(e2e_scenario())
 
     if args.smoke:
         assert plane.dispatches > 0, "smoke: sim device path never fired"
@@ -2993,6 +3030,13 @@ def bench_config8(args) -> dict:
             ),
             "e2e_frames": e2e_stats["frames"],
             "e2e_wire_rows": e2e_stats["wire_rows"],
+            "delivered_bytes_per_tick": e2e_vol[
+                "delivered_bytes_per_tick"
+            ],
+            "bytes_per_recipient_per_s": e2e_vol[
+                "bytes_per_recipient_per_s"
+            ],
+            "frame_delta_ratio": e2e_vol["frame_delta_ratio"],
             "entities": n_entities,
             "peers": n_peers,
             "k": 8,
@@ -3887,10 +3931,263 @@ def bench_config12(args) -> dict:
 # --------------------------------------------------------------------
 
 
+def bench_config13(args) -> dict:
+    """Interest-managed fan-out (ISSUE 18): the game_tick shape — a
+    mostly-static population with a small moving minority — run twice
+    at IDENTICAL shapes over real ZMQ sockets, ``--interest off`` then
+    ``on``. The off leg re-broadcasts every visible entity every tick;
+    the on leg ships per-recipient deltas on the stamped epoch:seq
+    wire. Reported: delivered bytes/tick and bytes/recipient/s for
+    both legs, the reduction ratio, the on-leg ``frame.delta_ratio``,
+    and the eventual-state parity verdict — one observer's socket is
+    replayed through the :class:`ReplayClient` oracle and compared
+    against the server's own per-peer ledger after quiescing.
+
+    ``--smoke`` asserts parity is green (zero refused deltas, zero
+    gaps, snapshot == ledger), deltas actually flowed, and the
+    reduction clears 2x; the record run must clear the ISSUE's 5x."""
+    import struct
+    import uuid as _uuid
+
+    from tests.client_util import ZmqClient, free_port
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+    from worldql_server_tpu.interest import ReplayClient
+    from worldql_server_tpu.protocol import Instruction, Message
+    from worldql_server_tpu.protocol.types import Entity, Vector3
+    from worldql_server_tpu.utils.retrace import GUARD
+
+    quick = args.quick
+    n_watchers = 4 if quick else 8
+    ents_per_watcher = 4 if quick else 12
+    n_movers = 2 if quick else 8
+    measure_s = 2.0 if quick else 6.0
+    tick = 0.05
+    rng = np.random.default_rng(1813)
+
+    async def variant(interest: str) -> dict:
+        config = Config()
+        config.store_url = "memory://"
+        config.http_enabled = False
+        config.ws_enabled = False
+        config.zmq_server_port = free_port()
+        config.zmq_server_host = "127.0.0.1"
+        config.spatial_backend = "tpu"
+        config.tick_interval = tick
+        config.entity_sim = True
+        config.entity_k = 8
+        config.interest = interest
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            clients = [
+                await ZmqClient.connect(config.zmq_server_port)
+                for _ in range(n_watchers)
+            ]
+            observer = clients[-1]
+            # static majority: a co-located cluster inside one cube
+            for c in clients:
+                await c.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name="bench",
+                    entities=[Entity(
+                        uuid=_uuid.uuid4(),
+                        position=Vector3(*rng.uniform(4, 12, 3)),
+                        world_name="bench",
+                    ) for _ in range(ents_per_watcher)],
+                ))
+            # moving minority: velocity-integrated by the device tick,
+            # no further client sends needed to generate churn
+            movers = [_uuid.uuid4() for _ in range(n_movers)]
+            await clients[0].send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="bench",
+                entities=[Entity(
+                    uuid=m, position=Vector3(*rng.uniform(6, 10, 3)),
+                    world_name="bench",
+                    flex=struct.pack("<3f", 1.0, 0.5, 0.0),
+                ) for m in movers],
+            ))
+
+            oracle = ReplayClient() if interest == "on" else None
+            observed = [0]
+
+            async def drain(client, sink=None):
+                try:
+                    while True:
+                        m = await client.recv(timeout=0.5)
+                        if sink is not None \
+                                and m.instruction == Instruction.LOCAL_MESSAGE:
+                            sink.apply(m)
+                            observed[0] += 1
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    pass
+
+            drains = [
+                asyncio.ensure_future(drain(c, oracle if c is observer
+                                            else None))
+                for c in clients
+            ]
+            # warmup: past the jit walls, ticking at rate (config 8's
+            # bounded stability loop)
+            plane_ = server.entity_plane
+            expect = max(3, int(0.5 / tick) - 3)
+            prev_ticks, prev_compiles, stable = -1, -1, 0
+            for _ in range(60):
+                await asyncio.sleep(0.5)
+                ticks_now = plane_.applied_ticks
+                compiles = sum(GUARD.counts().values())
+                if (prev_ticks >= 0
+                        and ticks_now - prev_ticks >= expect
+                        and compiles == prev_compiles):
+                    stable += 1
+                    if stable >= 2:
+                        break
+                else:
+                    stable = 0
+                prev_ticks, prev_compiles = ticks_now, compiles
+
+            bytes0 = server.peer_map.bytes_delivered
+            ticks0 = plane_.applied_ticks
+            await asyncio.sleep(measure_s)
+            bytes_win = server.peer_map.bytes_delivered - bytes0
+            ticks_win = max(plane_.applied_ticks - ticks0, 1)
+            # sample the per-tick delta ratio INSIDE the loaded window
+            # — after quiescing the last tick carries no frames and
+            # the gauge honestly reads 0
+            ratio_at_load = (
+                server.interest.stats()["delta_ratio"]
+                if server.interest is not None else None
+            )
+
+            out = {
+                "delivered_bytes_per_tick": round(
+                    bytes_win / ticks_win, 1
+                ),
+                "bytes_per_recipient_per_s": round(
+                    bytes_win / n_watchers / measure_s, 1
+                ),
+                "measured_ticks": ticks_win,
+                "frames_observed": 0,
+            }
+            parity = None
+            if interest == "on":
+                # quiesce: zero the movers' velocity, let the last
+                # deltas land, then the oracle must equal the server's
+                # own ledger for the observer — eventual-state parity
+                await clients[0].send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name="bench",
+                    entities=[Entity(
+                        uuid=m,
+                        position=Vector3(*rng.uniform(6, 10, 3)),
+                        world_name="bench",
+                        flex=struct.pack("<3f", 0.0, 0.0, 0.0),
+                    ) for m in movers],
+                ))
+                settled = observed[0] - 1
+                for _ in range(40):
+                    await asyncio.sleep(0.25)
+                    if observed[0] == settled:
+                        break
+                    settled = observed[0]
+                mgr = server.interest
+                st = mgr._peers.get(observer.uuid)
+                ledger = {}
+                if st is not None:
+                    for key, (_wid, pos_b) in st.state.items():
+                        x, y, z = np.frombuffer(pos_b, np.float32)
+                        ledger[_uuid.UUID(bytes=key)] = (
+                            float(x), float(y), float(z)
+                        )
+                got = oracle.snapshot().get("bench", {})
+                s = oracle.stats()
+                parity = {
+                    "entities_match": int(got == ledger),
+                    "entities": len(got),
+                    "deltas_refused": s["deltas_refused"],
+                    "gaps_seen": s["gaps_seen"],
+                    "deltas_applied": s["deltas_applied"],
+                    "fulls_applied": s["fulls_applied"],
+                }
+                ist = mgr.stats()
+                out["frame_delta_ratio"] = ratio_at_load
+                out["resyncs"] = ist["resyncs"]
+                out["templates_reused"] = ist["templates_reused"]
+                out["bytes_shed"] = ist["bytes_shed"]
+            for d in drains:
+                d.cancel()
+            await asyncio.gather(*drains, return_exceptions=True)
+            out["frames_observed"] = observed[0] if oracle else None
+            for c in clients:
+                await c.close()
+            return out, parity
+        finally:
+            await server.stop()
+
+    off, _ = asyncio.run(variant("off"))
+    on, parity = asyncio.run(variant("on"))
+    reduction = (
+        off["delivered_bytes_per_tick"]
+        / max(on["delivered_bytes_per_tick"], 1e-9)
+    )
+
+    if args.smoke:
+        assert parity is not None and parity["entities_match"], (
+            f"smoke: replay oracle diverged from the server ledger: "
+            f"{parity}"
+        )
+        assert parity["deltas_refused"] == 0 and parity["gaps_seen"] == 0, (
+            f"smoke: sequencing broke on a clean stream: {parity}"
+        )
+        assert parity["deltas_applied"] > 0, (
+            "smoke: movement never rode a delta frame"
+        )
+        floor = 2.0
+        assert reduction >= floor, (
+            f"smoke: interest reduced bytes/tick only {reduction:.2f}x "
+            f"(off {off['delivered_bytes_per_tick']} -> on "
+            f"{on['delivered_bytes_per_tick']}), need >= {floor}x"
+        )
+        log(f"smoke: {reduction:.1f}x byte reduction, parity green "
+            f"({parity['deltas_applied']} deltas, "
+            f"{parity['fulls_applied']} fulls, 0 refused)")
+    else:
+        assert reduction >= 5.0, (
+            f"ISSUE 18 acceptance: need >= 5x fewer bytes/tick with "
+            f"interest on, got {reduction:.2f}x"
+        )
+
+    log(f"interest: off {off['delivered_bytes_per_tick']:,.0f} B/tick "
+        f"-> on {on['delivered_bytes_per_tick']:,.0f} B/tick "
+        f"({reduction:.1f}x), delta_ratio "
+        f"{on.get('frame_delta_ratio')}, parity {parity}")
+    return {
+        "metric": "interest_bytes_reduction_x",
+        "value": round(reduction, 2),
+        "unit": "x",
+        # named like vs_baseline so the perf gate reads shrinkage of
+        # this leaf as the good direction
+        "vs_baseline": round(reduction, 2),
+        "interest": {
+            "off": off,
+            "on": on,
+            "parity": parity,
+            "watchers": n_watchers,
+            "entities": n_watchers * ents_per_watcher + n_movers,
+            "movers": n_movers,
+        },
+        "config": 13,
+    }
+
+
+# --------------------------------------------------------------------
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -3909,7 +4206,11 @@ def main() -> None:
                          "audit); 12 = query_library (per-kind "
                          "cone/raycast/kNN/density device throughput, "
                          "mixed-kind batch p50/p99 vs a pure-radius "
-                         "batch of the same size, CPU-oracle parity)")
+                         "batch of the same size, CPU-oracle parity); "
+                         "13 = interest-managed fan-out (delivered "
+                         "bytes/tick --interest off vs on at the "
+                         "game_tick shape over real ZMQ, replay-"
+                         "oracle parity, ISSUE 18 5x acceptance)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -3949,13 +4250,14 @@ def main() -> None:
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
         10: bench_config10, 11: bench_config11, 12: bench_config12,
+        13: bench_config13,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12, 13]
     else:
         selected = [args.config or 5]
     for n in selected:

@@ -724,3 +724,80 @@ extern "C" int wql_encode_entity_frames(
   *out = mem;
   return WQL_OK;
 }
+
+// Encode ONE interest-managed frame (ISSUE 18): a LocalMessage whose
+// parameter is the caller's stamped "entity.frame.{full,fullc,delta}"
+// string, carrying n entities of one world — live entries as
+// positioned entities, departures (tomb[i] != 0) as the same entity
+// at its last-known position plus a 1-byte flex tombstone marker
+// (short flex is ignored by the velocity decode, so pre-interest
+// readers see a harmless entity). The sender is the NIL uuid: these
+// frames originate from the server, not a peer. Byte-identical to
+// wql_encode / serialize_message of the equivalent Message (same
+// builder, same write order, entities field omitted when n == 0 like
+// the object encoders omit empty vectors). One malloc'd buffer; free
+// with wql_buffer_free.
+extern "C" int wql_encode_interest_frame(
+    const uint8_t* param, int32_t param_len, const uint8_t* world,
+    int32_t world_len, const uint8_t* ent_keys, const double* pos,
+    const uint8_t* tomb, int64_t n, uint8_t** out, int64_t* out_len) {
+  static const uint8_t NIL36[] = "00000000-0000-0000-0000-000000000000";
+  static const uint8_t TOMB1[] = {0};
+  if (n < 0 || param == nullptr || world == nullptr || out == nullptr ||
+      out_len == nullptr)
+    return WQL_E_BOUNDS;
+
+  Builder b(512 + static_cast<size_t>(n) * 160);
+  size_t entities_vec = 0;
+  if (n > 0) {
+    // write_obj_vector without the WQL_MAX_OBJS staging array: frames
+    // are chunked by the caller but the encoder itself has no cap
+    std::vector<size_t> offs(static_cast<size_t>(n));
+    std::vector<uint8_t> keys36(static_cast<size_t>(n) * 36);
+    for (int64_t i = 0; i < n; i++) {
+      uint8_t* ent36 = keys36.data() + 36 * i;
+      unparse_uuid(ent_keys + 16 * i, ent36);
+      const double* p = pos + 3 * i;
+      WqlObj ent;
+      std::memset(&ent, 0, sizeof(ent));
+      ent.uuid = ent36;
+      ent.uuid_len = 36;
+      ent.world = world;
+      ent.world_len = world_len;
+      ent.has_pos = 1;
+      ent.x = p[0];
+      ent.y = p[1];
+      ent.z = p[2];
+      if (tomb != nullptr && tomb[i]) {
+        ent.flex = TOMB1;
+        ent.flex_len = 1;
+      }
+      offs[static_cast<size_t>(i)] = write_obj(b, &ent);
+    }
+    b.prep(4, static_cast<size_t>(n) * 4);
+    for (int64_t i = n - 1; i >= 0; i--)
+      b.push_uoffset(offs[static_cast<size_t>(i)]);
+    b.push_scalar<uint32_t>(static_cast<uint32_t>(n));
+    entities_vec = b.offset();
+  }
+  size_t param_off = b.create_blob(param, param_len, true);
+  size_t sender_off = b.create_blob(NIL36, 36, true);
+  size_t world_off = b.create_blob(world, world_len, true);
+  TableBuilder t(b);
+  t.field_u8(MSG_INSTRUCTION, INSTR_LOCAL_MESSAGE, 0);
+  t.field_uoffset(MSG_PARAMETER, param_off);
+  t.field_uoffset(MSG_SENDER, sender_off);
+  t.field_uoffset(MSG_WORLD, world_off);
+  if (entities_vec != 0) t.field_uoffset(MSG_ENTITIES, entities_vec);
+  size_t root = t.end();
+  b.prep(std::max<size_t>(b.minalign, 4), 4);
+  b.push_uoffset(root);
+
+  const size_t len = b.offset();
+  uint8_t* mem = static_cast<uint8_t*>(std::malloc(len ? len : 1));
+  if (!mem) return WQL_E_ALLOC;
+  std::memcpy(mem, b.store.data() + b.head, len);
+  *out = mem;
+  *out_len = static_cast<int64_t>(len);
+  return WQL_OK;
+}

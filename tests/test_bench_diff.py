@@ -121,13 +121,13 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     gate = ["--fail", "--threshold", "100", "--min-abs", "1.0"]
     assert main([str(baseline), str(baseline), *gate]) == 0
 
-    # JSON-lines baseline: one record per smoke config (5+8+9+10+11+12)
+    # JSON-lines baseline: one record per smoke config (5+8+9+10+11+12+13)
     records = [
         json.loads(line)
         for line in baseline.read_text().splitlines() if line.strip()
     ]
     by_config = {rec["config"]: rec for rec in records}
-    assert set(by_config) == {5, 8, 9, 10, 11, 12}
+    assert set(by_config) == {5, 8, 9, 10, 11, 12, 13}
     # config 9's gate leaves are the admission RATES; the volatile
     # fsync-bound record p99s are pruned from the baseline on purpose
     # (the bench still reports them) — pin that they stay pruned
@@ -328,3 +328,30 @@ def test_higher_better_drop_ratio_vs_new_value():
     assert diff(old, mild, threshold_pct=100.0)[1] == []
     # …and an IMPROVEMENT past the threshold never flags
     assert diff(new, old, threshold_pct=100.0)[1] == []
+
+
+def test_direction_bytes_volume_is_lower_better():
+    """ISSUE 18: delivered-byte leaves classify lower-is-better even
+    when their names contain higher-better tokens ('per_s')."""
+    assert direction("delivered_bytes_per_tick") == -1
+    assert direction("interest.bytes_per_recipient_per_s") == -1
+    assert direction("delivery.bytes_shed") == -1
+    # throughput leaves keep their higher-better reading
+    assert direction("deliveries_per_s") == 1
+
+
+def test_bytes_growth_flags_regression_red_case():
+    """The pinned red case: interest regresses, bytes/tick balloons,
+    the gate must go red (not read the growth as a throughput win)."""
+    old = {"13": {"config": 13, "delivered_bytes_per_tick": 40_000.0,
+                  "bytes_per_recipient_per_s": 52_000.0}}
+    new = {"13": {"config": 13, "delivered_bytes_per_tick": 400_000.0,
+                  "bytes_per_recipient_per_s": 510_000.0}}
+    rows, regressions = diff(old, new, threshold_pct=10.0)
+    assert {(c, n) for c, n, *_ in regressions} == {
+        ("13", "delivered_bytes_per_tick"),
+        ("13", "bytes_per_recipient_per_s"),
+    }
+    # the reverse (bytes shrinking 10x) is an improvement, not a flag
+    rows, regressions = diff(new, old, threshold_pct=10.0)
+    assert regressions == []

@@ -842,6 +842,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "full-rebuild-on-tick",
         "per-query-python-loop",
         "unregistered-query-kind",
+        "unsequenced-frame",
         "host-sync-in-sim-tick",
         "store-on-loop",
         "unspanned-stage",
@@ -2144,5 +2145,75 @@ def test_unlocked_shared_write_honors_pragma():
 
 
 # endregion
+
+# endregion
+
+
+# region: unsequenced-frame (ISSUE 18)
+
+
+def test_unsequenced_frame_fires_on_hand_minted_stamps():
+    src = """
+    def send(e, s):
+        a = f"entity.frame.delta:{e:08x}:{s:08x}"
+        b = "entity.frame.full:00000001:00000000"
+        return a, b
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/delivery/pump.py",
+        select="unsequenced-frame",
+    ) == [("unsequenced-frame", 3), ("unsequenced-frame", 4)]
+
+
+def test_unsequenced_frame_scopes_to_delivery_paths_only():
+    src = """
+    FIXTURE = "entity.frame.delta:00000001:00000002"
+    """
+    # out-of-scope modules (tests, scenarios, protocol) may spell
+    # fixtures freely; the delivery/pump paths may not
+    assert violations(
+        src, relpath="worldql_server_tpu/scenarios/catalog.py",
+        select="unsequenced-frame",
+    ) == []
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/peers.py",
+        select="unsequenced-frame",
+    ) == [("unsequenced-frame", 2)]
+
+
+def test_unsequenced_frame_quiet_on_bare_kind_and_stamp_authority():
+    src = """
+    def route(parameter):
+        if parameter.startswith("entity.frame.delta"):
+            return "delta"
+        KIND = "entity.frame.full"
+        return KIND
+    """
+    # comparing/routing on the bare kind is parse_stamp consumption,
+    # not stamp minting
+    assert violations(
+        src, relpath="worldql_server_tpu/delivery/plane.py",
+        select="unsequenced-frame",
+    ) == []
+    # the manager IS the stamp authority
+    minted = """
+    def stamp(kind, e, s):
+        return f"entity.frame.full:{e:08x}:{s:08x}"
+    """
+    assert violations(
+        minted, relpath="worldql_server_tpu/interest/manager.py",
+        select="unsequenced-frame",
+    ) == []
+
+
+def test_unsequenced_frame_honors_pragma():
+    src = """
+    PINNED = "entity.frame.full:00000001:00000000"  # wql: allow(unsequenced-frame)
+    """
+    assert violations(
+        src, relpath="worldql_server_tpu/engine/ticker.py",
+        select="unsequenced-frame",
+    ) == []
+
 
 # endregion

@@ -1,0 +1,830 @@
+"""Interest-managed fan-out (ISSUE 18): per-recipient delta frames,
+LOD cadence tiers, and per-peer bandwidth budgets.
+
+The contract under test, from ``interest/manager.py``'s docstring:
+every frame is stamped ``<kind>:<epoch>:<seq>`` with seq contiguous
+per peer within an epoch; every loss path lands in ``mark_resync`` and
+forces the next frame full under a bumped epoch; LOD deferral and
+bandwidth deferral are LOSSLESS (the diff accumulates, nothing is
+truncated); and the :class:`ReplayClient` oracle proves it — its
+``deltas_refused`` counter staying at zero IS the "no recipient ever
+applies a delta against a frame it never got" guarantee.
+
+The churn property at the bottom drives a REAL ``EntityPlane`` (full
+wire ingest + device ticks, ``--delta-ticks on`` variant included) and
+checks replayed state against the ground-truth visible set — the exact
+state the ``--interest off`` stream conveys — every tick.
+"""
+
+import itertools
+import random
+import uuid
+
+import numpy as np
+import pytest
+
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.interest import (
+    InterestManager,
+    ReplayClient,
+    parse_stamp,
+    stamp,
+)
+from worldql_server_tpu.interest.manager import (
+    DEMOTE_KEYFRAME,
+    FRAME_CHUNK,
+    PARAM_DELTA,
+    PARAM_FULL,
+    PARAM_FULL_CONT,
+)
+from worldql_server_tpu.interest.replay import LegacyClient
+from worldql_server_tpu.protocol import deserialize_message
+
+
+# region: stamp grammar
+
+
+def test_stamp_roundtrip_and_fixed_width():
+    s = stamp(PARAM_DELTA, 7, 300)
+    assert s == "entity.frame.delta:00000007:0000012c"
+    assert parse_stamp(s) == (PARAM_DELTA, 7, 300)
+    # fixed width holds across the whole u32 range — that is what lets
+    # a cohort template be byte-patched per peer
+    assert len(stamp(PARAM_FULL, 0, 0)) == len(stamp(PARAM_FULL, 2**32 - 1, 1))
+    assert parse_stamp(stamp(PARAM_FULL_CONT, 1, 2)) == (PARAM_FULL_CONT, 1, 2)
+
+
+def test_parse_stamp_rejects_unstamped_parameters():
+    assert parse_stamp("entity.frame") is None          # legacy frame
+    assert parse_stamp("entity.frame.delta") is None    # bare kind
+    assert parse_stamp("entity.frame.delta:zz:00") is None
+    assert parse_stamp("entity.remove") is None
+    assert parse_stamp(None) is None
+
+
+# endregion
+
+# region: fake plane (the five columns build_pairs reads)
+
+
+class FakePlane:
+    """Just the plane surface the manager touches: live/pos/uuid/world
+    columns plus the peer registry. No device, no index."""
+
+    def __init__(self, cap=2048, worlds=("arena",)):
+        self._cap = cap
+        self._live = np.zeros(cap, bool)
+        self._pos = np.zeros((cap, 3), np.float32)
+        self._uuid_bytes = np.zeros((cap, 16), np.uint8)
+        self._wid = np.full(cap, -1, np.int32)
+        self._world_names = list(worlds)
+        self._peer_ids: dict[uuid.UUID, int] = {}
+        self._peer_uuids: list[uuid.UUID] = []
+        self._peer_slots: dict[int, set[int]] = {}
+        self._wire = None  # object encode path
+
+    def pid(self, peer: uuid.UUID) -> int:
+        p = self._peer_ids.get(peer)
+        if p is None:
+            p = self._peer_ids[peer] = len(self._peer_uuids)
+            self._peer_uuids.append(peer)
+        return p
+
+    def put(self, slot, ent, pos, wid=0, owner=None):
+        self._live[slot] = True
+        self._uuid_bytes[slot] = np.frombuffer(ent.bytes, np.uint8)
+        self._pos[slot] = pos
+        self._wid[slot] = wid
+        if owner is not None:
+            self._peer_slots.setdefault(self.pid(owner), set()).add(slot)
+
+    def drop(self, slot):
+        self._live[slot] = False
+
+
+def run_tick(mgr, plane, vis):
+    """One manager tick: ``vis`` maps entity slot -> recipient pids."""
+    cap = plane._cap
+    k = max((len(v) for v in vis.values()), default=1)
+    targets = np.full((cap, k), -1, np.int64)
+    for slot, pids in vis.items():
+        targets[slot, : len(pids)] = pids
+    return mgr.build_pairs(plane, plane._pos, targets, cap)
+
+
+def frames_for(pairs, peer):
+    return [m for m, targets in pairs if peer in targets]
+
+
+def params(pairs):
+    return [m.parameter for m, _ in pairs]
+
+
+# endregion
+
+# region: delta lifecycle on the fake plane
+
+
+def test_first_contact_quiet_delta_tombstone_resync_flow():
+    plane = FakePlane()
+    mgr = InterestManager()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    e1, e2 = uuid.uuid4(), uuid.uuid4()
+    plane.put(0, e1, (1.0, 0.0, 0.0))
+    plane.put(1, e2, (2.0, 0.0, 0.0))
+    rc = ReplayClient()
+
+    # tick 1: first contact is a keyframe opening epoch 1 at seq 0
+    pairs = run_tick(mgr, plane, {0: [vp], 1: [vp]})
+    assert params(pairs) == [stamp(PARAM_FULL, 1, 0)]
+    for m in frames_for(pairs, viewer):
+        assert rc.apply(m)
+    assert rc.snapshot() == {"arena": {
+        e1: (1.0, 0.0, 0.0), e2: (2.0, 0.0, 0.0),
+    }}
+
+    # tick 2: nothing moved — no frame, no seq consumed
+    assert run_tick(mgr, plane, {0: [vp], 1: [vp]}) == []
+
+    # tick 3: one entity moves — a delta carrying only that entity
+    plane._pos[0] = (5.0, 0.0, 0.0)
+    pairs = run_tick(mgr, plane, {0: [vp], 1: [vp]})
+    assert params(pairs) == [stamp(PARAM_DELTA, 1, 1)]
+    assert len(pairs[0][0].entities) == 1
+    rc.apply(pairs[0][0])
+    assert rc.worlds["arena"][e1] == (5.0, 0.0, 0.0)
+    assert rc.worlds["arena"][e2] == (2.0, 0.0, 0.0)
+
+    # tick 4: e2 leaves — a delta tombstone deletes it client-side
+    pairs = run_tick(mgr, plane, {0: [vp]})
+    assert params(pairs) == [stamp(PARAM_DELTA, 1, 2)]
+    rc.apply(pairs[0][0])
+    assert set(rc.worlds["arena"]) == {e1}
+
+    # loss: the next frame opens epoch 2 with a complete keyframe
+    mgr.mark_resync(viewer)
+    pairs = run_tick(mgr, plane, {0: [vp]})
+    assert params(pairs) == [stamp(PARAM_FULL, 2, 0)]
+    rc.apply(pairs[0][0])
+    assert rc.snapshot() == {"arena": {e1: (5.0, 0.0, 0.0)}}
+    assert rc.stats()["deltas_refused"] == 0
+    assert rc.stats()["gaps_seen"] == 0
+    assert mgr.stats()["resyncs"] == 1
+
+
+def test_mark_resync_is_idempotent_and_unknown_peer_safe():
+    mgr = InterestManager()
+    mgr.mark_resync(uuid.uuid4())          # never seen: no-op
+    assert mgr.resyncs == 0
+    plane = FakePlane()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    plane.put(0, uuid.uuid4(), (1, 1, 1))
+    run_tick(mgr, plane, {0: [vp]})
+    mgr.mark_resync(viewer)
+    mgr.mark_resync(viewer)                # second is a no-op
+    assert mgr.resyncs == 1
+
+
+def test_world_hop_tombstones_old_world_and_enters_new():
+    plane = FakePlane(worlds=("arena", "lobby"))
+    mgr = InterestManager()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    ent = uuid.uuid4()
+    plane.put(0, ent, (1, 0, 0), wid=0)
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, {0: [vp]}):
+        rc.apply(m)
+    plane._wid[0] = 1
+    pairs = run_tick(mgr, plane, {0: [vp]})
+    # leave(arena) + enter(lobby), contiguous seqs, both applied
+    kinds = [parse_stamp(m.parameter)[0] for m, _ in pairs]
+    assert kinds == [PARAM_DELTA, PARAM_DELTA]
+    for m, _ in pairs:
+        assert rc.apply(m)
+    assert rc.snapshot() == {"lobby": {ent: (1.0, 0.0, 0.0)}}
+
+
+def test_vacated_world_ships_empty_full_clear_marker():
+    plane = FakePlane()
+    mgr = InterestManager()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    plane.put(0, uuid.uuid4(), (1, 1, 1))
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, {0: [vp]}):
+        rc.apply(m)
+    assert rc.snapshot() != {}
+    # the peer's ledger survives a resync even when nothing is visible
+    # anymore: the new epoch must CLEAR the stale world
+    mgr.mark_resync(viewer)
+    plane.drop(0)
+    pairs = run_tick(mgr, plane, {})
+    assert params(pairs) == [stamp(PARAM_FULL, 2, 0)]
+    assert pairs[0][0].entities in (None, [])
+    rc.apply(pairs[0][0])
+    assert rc.snapshot() == {}
+
+
+def test_cohort_dedup_shares_template_across_recipients():
+    plane = FakePlane()
+    mgr = InterestManager()
+    a, b = uuid.uuid4(), uuid.uuid4()
+    pa, pb = plane.pid(a), plane.pid(b)
+    plane.put(0, uuid.uuid4(), (3, 3, 3))
+    pairs = run_tick(mgr, plane, {0: [pa, pb]})
+    # identical content -> ONE encode, two stamped copies
+    assert len(pairs) == 2
+    assert mgr.templates_reused == 1
+    wires = {m.wire for m, _ in pairs}
+    assert len(wires) == 1       # same epoch:seq cursor position too
+    ra, rb = ReplayClient(), ReplayClient()
+    for m in frames_for(pairs, a):
+        ra.apply(m)
+    for m in frames_for(pairs, b):
+        rb.apply(m)
+    assert ra.snapshot() == rb.snapshot() != {}
+
+    # next tick: one mover, still one template for both recipients —
+    # and the per-peer stamp patch touches ONLY the stamp bytes
+    plane._pos[0] = (4, 4, 4)
+    pairs = run_tick(mgr, plane, {0: [pa, pb]})
+    assert len(pairs) == 2 and mgr.templates_reused == 2
+    for m, targets in pairs:
+        (ra if a in targets else rb).apply(m)
+    assert ra.snapshot() == rb.snapshot()
+    assert ra.stats()["deltas_refused"] == rb.stats()["deltas_refused"] == 0
+
+
+def test_desynced_cursor_stamps_diverge_but_both_converge():
+    plane = FakePlane()
+    mgr = InterestManager()
+    a, b = uuid.uuid4(), uuid.uuid4()
+    pa, pb = plane.pid(a), plane.pid(b)
+    plane.put(0, uuid.uuid4(), (3, 3, 3))
+    ra, rb = ReplayClient(), ReplayClient()
+    # a joins one tick before b: cursors diverge, content still shared
+    for m in frames_for(run_tick(mgr, plane, {0: [pa]}), a):
+        ra.apply(m)
+    plane._pos[0] = (4, 4, 4)
+    pairs = run_tick(mgr, plane, {0: [pa, pb]})
+    by_peer = {tuple(t): m.parameter for m, t in pairs}
+    assert by_peer[(a,)] == stamp(PARAM_DELTA, 1, 1)
+    assert by_peer[(b,)] == stamp(PARAM_FULL, 1, 0)
+    for m, targets in pairs:
+        (ra if a in targets else rb).apply(m)
+    assert ra.snapshot() == rb.snapshot()
+
+
+# endregion
+
+# region: LOD cadence
+
+
+def test_far_updates_defer_to_cadence_and_never_drop():
+    plane = FakePlane()
+    mgr = InterestManager(near_radius=10.0, far_every_k=4)
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    # the viewer's own entity anchors its subscription center
+    plane.put(0, uuid.uuid4(), (0, 0, 0), owner=viewer)
+    near, far = uuid.uuid4(), uuid.uuid4()
+    plane.put(1, near, (1, 0, 0))
+    plane.put(2, far, (100, 0, 0))
+    vis = {0: [vp], 1: [vp], 2: [vp]}
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, vis):
+        rc.apply(m)
+    assert rc.worlds["arena"][far] == (100.0, 0.0, 0.0)
+
+    # move BOTH every tick for a full far period: the near entity
+    # updates every tick, the far one exactly once — and its one
+    # update carries the LATEST position (deferral is lossless)
+    far_updates = 0
+    for t in range(1, 5):
+        plane._pos[1] = (1.0 + t, 0.0, 0.0)
+        plane._pos[2] = (100.0 + t, 0.0, 0.0)
+        for m, _ in run_tick(mgr, plane, vis):
+            before = rc.worlds["arena"].get(far)
+            rc.apply(m)
+            if rc.worlds["arena"].get(far) != before:
+                far_updates += 1
+        assert rc.worlds["arena"][near] == (1.0 + t, 0.0, 0.0)
+    assert far_updates == 1
+    # the one update carried the position AS OF its due tick; the tail
+    # move is deferred, not dropped — it ships on the next due tick
+    assert rc.worlds["arena"][far] == (103.0, 0.0, 0.0)
+    for _ in range(4):
+        for m, _ in run_tick(mgr, plane, vis):
+            rc.apply(m)
+    assert rc.worlds["arena"][far] == (104.0, 0.0, 0.0)
+    assert rc.stats()["gaps_seen"] == 0
+    st = mgr.stats()
+    assert st["near"] >= 1 and st["far"] >= 1
+
+
+def test_far_departure_defers_to_cadence_then_tombstones():
+    plane = FakePlane()
+    mgr = InterestManager(near_radius=10.0, far_every_k=4)
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    plane.put(0, uuid.uuid4(), (0, 0, 0), owner=viewer)
+    far = uuid.uuid4()
+    plane.put(1, far, (50, 0, 0))
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, {0: [vp], 1: [vp]}):
+        rc.apply(m)
+    assert far in rc.worlds["arena"]
+    plane.drop(1)
+    # the leave ships on the next far-due tick, not instantly — but it
+    # DOES ship within one full period
+    for _ in range(4):
+        for m, _ in run_tick(mgr, plane, {0: [vp]}):
+            rc.apply(m)
+    assert far not in rc.worlds.get("arena", {})
+    assert rc.stats()["deltas_refused"] == 0
+
+
+def test_governor_shed_widens_far_cadence_and_degrades_near():
+    mgr = InterestManager(near_radius=10.0, far_every_k=4)
+    assert mgr.stats()["far_every_k"] == 4
+    mgr.note_governor(2, False)
+    assert mgr.stats()["far_every_k"] == 16
+    mgr.note_governor(9, True)          # level clamps at 3
+    assert mgr.stats()["far_every_k"] == 32
+
+    # degraded tick tier halves the near cadence but stays lossless
+    plane = FakePlane()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    ent = uuid.uuid4()
+    plane.put(0, ent, (1, 0, 0))
+    mgr2 = InterestManager()
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr2, plane, {0: [vp]}):
+        rc.apply(m)
+    mgr2.note_governor(0, True)
+    sent = 0
+    for t in range(1, 5):
+        plane._pos[0] = (1.0 + t, 0.0, 0.0)
+        pairs = run_tick(mgr2, plane, {0: [vp]})
+        sent += len(pairs)
+        for m, _ in pairs:
+            rc.apply(m)
+    assert sent == 2                    # every other tick
+    # the tail move rides the next due tick — deferred, never lost
+    for m, _ in run_tick(mgr2, plane, {0: [vp]}):
+        rc.apply(m)
+    assert rc.worlds["arena"][ent] == (5.0, 0.0, 0.0)
+
+
+# endregion
+
+# region: bandwidth budgets
+
+
+def bw_manager(rate=100):
+    now = [1000.0]
+    mgr = InterestManager(bandwidth_bytes=rate, clock=lambda: now[0])
+    return mgr, now
+
+
+def test_unaffordable_tick_defers_whole_and_walks_demote_ladder():
+    mgr, now = bw_manager()
+    plane = FakePlane()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    ent = uuid.uuid4()
+    plane.put(0, ent, (1, 0, 0))
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, {0: [vp]}):
+        rc.apply(m)
+    st = mgr._peers[viewer]
+
+    # drain the bucket; with a frozen clock nothing refills
+    st.tokens = 0.0
+    plane._pos[0] = (2, 0, 0)
+    assert run_tick(mgr, plane, {0: [vp]}) == []       # deferred whole
+    assert st.demote == 1 and mgr.deferrals == 1
+    assert st.seq == 1                                  # no seq burned
+    # at demote=FAR the retry waits for the far cadence; walk ticks
+    # (still broke) until the due-tick attempt escalates the ladder
+    for _ in range(mgr.far_every_k):
+        if st.demote == DEMOTE_KEYFRAME:
+            break
+        plane._pos[0] += 1.0
+        assert run_tick(mgr, plane, {0: [vp]}) == []
+        st.tokens = 0.0
+    assert st.demote == DEMOTE_KEYFRAME and mgr.bytes_shed == 0
+
+    # refill: the peer is in keyframe-only mode, so the catch-up frame
+    # is a FULL on the far cadence — and it carries the latest state
+    st.tokens = mgr.bandwidth_burst
+    for _ in range(mgr.far_every_k):
+        plane._pos[0] = (9, 0, 0)
+        for m, _ in run_tick(mgr, plane, {0: [vp]}):
+            rc.apply(m)
+    assert rc.worlds["arena"][ent] == (9.0, 0.0, 0.0)
+    assert rc.stats()["deltas_refused"] == 0
+    assert st.demote < DEMOTE_KEYFRAME                  # walked back up
+
+
+def test_bytes_shed_counts_only_unaffordable_keyframes():
+    mgr, now = bw_manager()
+    plane = FakePlane()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    plane.put(0, uuid.uuid4(), (1, 0, 0))
+    run_tick(mgr, plane, {0: [vp]})
+    st = mgr._peers[viewer]
+    st.tokens = 0.0
+    st.demote = DEMOTE_KEYFRAME
+    # keyframe-only + unaffordable on a due tick: the ONE shed point
+    shed = 0
+    for _ in range(mgr.far_every_k + 1):
+        plane._pos[0] += 1.0
+        run_tick(mgr, plane, {0: [vp]})
+        shed = mgr.bytes_shed
+        st.tokens = 0.0
+    assert shed > 0
+    assert mgr.stats()["bytes_shed"] == shed
+
+
+def test_zero_budget_means_no_bandwidth_gating():
+    plane = FakePlane()
+    mgr = InterestManager(bandwidth_bytes=0)
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    plane.put(0, uuid.uuid4(), (1, 0, 0))
+    for t in range(5):
+        plane._pos[0] = (1.0 + t, 0, 0)
+        assert len(run_tick(mgr, plane, {0: [vp]})) == 1
+    assert mgr.deferrals == 0 and mgr.bytes_shed == 0
+
+
+# endregion
+
+# region: chunking + oversized deltas
+
+
+def test_large_keyframe_chunks_full_then_fullc():
+    plane = FakePlane(cap=2048)
+    mgr = InterestManager()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    n = FRAME_CHUNK + 40
+    ents = [uuid.uuid4() for _ in range(n)]
+    vis = {}
+    for i, e in enumerate(ents):
+        plane.put(i, e, (float(i), 0, 0))
+        vis[i] = [vp]
+    pairs = run_tick(mgr, plane, vis)
+    kinds = [parse_stamp(m.parameter)[0] for m, _ in pairs]
+    assert kinds == [PARAM_FULL, PARAM_FULL_CONT]
+    assert [parse_stamp(m.parameter)[2] for m, _ in pairs] == [0, 1]
+    rc = ReplayClient()
+    for m, _ in pairs:
+        assert rc.apply(m)
+    assert len(rc.worlds["arena"]) == n
+
+
+def test_oversized_delta_escalates_to_epoch_bump_keyframes():
+    plane = FakePlane(cap=2048)
+    mgr = InterestManager()
+    viewer = uuid.uuid4()
+    vp = plane.pid(viewer)
+    n = FRAME_CHUNK + 40
+    vis = {}
+    for i in range(n):
+        plane.put(i, uuid.uuid4(), (float(i), 0, 0))
+        vis[i] = [vp]
+    rc = ReplayClient()
+    for m, _ in run_tick(mgr, plane, vis):
+        rc.apply(m)
+    # every entity moves: a >FRAME_CHUNK delta beats no full frame —
+    # the manager DECLARES a resync instead of shipping a monster
+    plane._pos[:n, 1] = 7.0
+    pairs = run_tick(mgr, plane, vis)
+    stamps = [parse_stamp(m.parameter) for m, _ in pairs]
+    assert stamps[0] == (PARAM_FULL, 2, 0)
+    assert all(s[1] == 2 for s in stamps)
+    for m, _ in pairs:
+        assert rc.apply(m)
+    assert all(
+        p == (float(i), 7.0, 0.0)
+        for i, p in ((i, rc.worlds["arena"][uuid.UUID(
+            bytes=plane._uuid_bytes[i].tobytes()
+        )]) for i in range(n))
+    )
+    assert rc.stats()["deltas_refused"] == 0
+
+
+# endregion
+
+# region: ReplayClient oracle semantics
+
+
+def _frame(kind, epoch, seq, world="arena", ents=()):
+    from worldql_server_tpu.protocol.types import (
+        NIL_UUID, Entity, Instruction, Message, Vector3,
+    )
+
+    return Message(
+        instruction=Instruction.LOCAL_MESSAGE,
+        parameter=stamp(kind, epoch, seq),
+        sender_uuid=NIL_UUID,
+        world_name=world,
+        entities=[
+            Entity(uuid=e, position=Vector3(*p), world_name=world,
+                   flex=b"\x00" if dead else None)
+            for e, p, dead in ents
+        ],
+    )
+
+
+def test_replay_refuses_deltas_past_a_gap_until_new_epoch():
+    rc = ReplayClient()
+    e = uuid.uuid4()
+    assert rc.apply(_frame(PARAM_FULL, 1, 0, ents=[(e, (1, 1, 1), False)]))
+    # seq 1 lost; seq 2 arrives: gap -> desync, frame discarded
+    assert not rc.apply(_frame(PARAM_DELTA, 1, 2, ents=[(e, (9, 9, 9), False)]))
+    assert rc.gaps_seen == 1 and rc.deltas_refused == 1
+    assert rc.worlds["arena"][e] == (1.0, 1.0, 1.0)    # state unpoisoned
+    # more same-epoch traffic stays refused
+    assert not rc.apply(_frame(PARAM_DELTA, 1, 3))
+    assert rc.deltas_refused == 2
+    # recovery REQUIRES a new epoch opening with full@0
+    assert not rc.apply(_frame(PARAM_DELTA, 2, 0))      # delta can't open
+    assert rc.deltas_refused == 3
+    assert rc.apply(_frame(PARAM_FULL, 3, 0, ents=[(e, (2, 2, 2), False)]))
+    assert not rc.desync
+    assert rc.worlds["arena"][e] == (2.0, 2.0, 2.0)
+
+
+def test_replay_discards_stale_epoch_stragglers():
+    rc = ReplayClient()
+    assert rc.apply(_frame(PARAM_FULL, 2, 0))
+    assert not rc.apply(_frame(PARAM_DELTA, 1, 5))      # closed epoch
+    assert rc.discarded == 1 and rc.deltas_refused == 0
+    assert not rc.apply(_frame(PARAM_FULL, 2, 0))       # replayed dup
+    assert rc.gaps_seen == 1                            # seq 0 != next 1
+
+
+def test_replay_full_replaces_world_and_fullc_appends():
+    rc = ReplayClient()
+    a, b, c = uuid.uuid4(), uuid.uuid4(), uuid.uuid4()
+    rc.apply(_frame(PARAM_FULL, 1, 0, ents=[(a, (1, 0, 0), False)]))
+    rc.apply(_frame(PARAM_DELTA, 1, 1, ents=[(b, (2, 0, 0), False)]))
+    # a new full REPLACES the world; its fullc continuation appends
+    rc.apply(_frame(PARAM_FULL, 1, 2, ents=[(c, (3, 0, 0), False)]))
+    rc.apply(_frame(PARAM_FULL_CONT, 1, 3, ents=[(a, (4, 0, 0), False)]))
+    assert rc.snapshot() == {"arena": {
+        c: (3.0, 0.0, 0.0), a: (4.0, 0.0, 0.0),
+    }}
+
+
+def test_legacy_client_folds_frames_and_removes():
+    from worldql_server_tpu.protocol.types import (
+        Entity, Instruction, Message, Vector3,
+    )
+
+    lc = LegacyClient()
+    e = uuid.uuid4()
+    lc.apply(Message(
+        instruction=Instruction.LOCAL_MESSAGE, parameter="entity.frame",
+        sender_uuid=uuid.uuid4(), world_name="w",
+        entities=[Entity(uuid=e, position=Vector3(1, 2, 3), world_name="w")],
+    ))
+    assert lc.snapshot() == {"w": {e: (1.0, 2.0, 3.0)}}
+    lc.apply(Message(
+        instruction=Instruction.LOCAL_MESSAGE, parameter="entity.remove",
+        sender_uuid=uuid.uuid4(), world_name="w",
+        entities=[Entity(uuid=e)],
+    ))
+    assert lc.snapshot() == {}
+
+
+# endregion
+
+# region: encode parity (native vs object path) + off-path pin
+
+
+def _entries(n, wid=0, tomb_every=0):
+    out = []
+    for i in range(n):
+        dead = tomb_every and i % tomb_every == 0
+        out.append((
+            uuid.uuid4().bytes, wid,
+            np.array([i, i * 2, i * 3], np.float32).tobytes(), bool(dead),
+        ))
+    return sorted(out)
+
+
+def test_template_native_matches_object_path_byte_for_byte():
+    from worldql_server_tpu.protocol import entity_wire
+
+    wire = entity_wire.shared()
+    if wire is None or not wire.can_encode_interest:
+        pytest.skip("native interest encoder unavailable")
+    plane = FakePlane()
+    mgr = InterestManager()
+    for entries in (_entries(3, tomb_every=2), _entries(1), []):
+        plane._wire = wire
+        native = mgr._encode_template(plane, PARAM_DELTA, 0, entries)
+        plane._wire = None
+        obj = mgr._encode_template(plane, PARAM_DELTA, 0, entries)
+        assert native == obj
+        # and the patched result still deserializes with the stamp
+        buf = bytearray(native[0])
+        buf[native[1]:native[1] + 8] = b"0000000a"
+        buf[native[2]:native[2] + 8] = b"00000005"
+        msg = deserialize_message(bytes(buf))
+        assert parse_stamp(msg.parameter) == (PARAM_DELTA, 10, 5)
+
+
+def test_interest_off_is_the_default_and_legacy_frames_unstamped():
+    config = Config()
+    assert config.interest == "off"
+    # the legacy broadcast parameter is NOT a stamped frame: off-path
+    # wire bytes carry no sequence fields at all
+    from worldql_server_tpu.entities import PARAM_FRAME
+
+    assert parse_stamp(PARAM_FRAME) is None
+
+
+def test_config_validates_interest_fields():
+    def errs(**kw):
+        c = Config()
+        c.store_url = "memory://"
+        for k, v in kw.items():
+            setattr(c, k, v)
+        try:
+            c.validate()
+        except ValueError as exc:
+            return str(exc)
+        return ""
+
+    assert "interest" in errs(interest="sometimes")
+    assert "entity_sim" in errs(interest="on", entity_sim=False)
+    assert errs(interest="on", entity_sim=True, spatial_backend="tpu",
+                tick_interval=0.05) == ""
+    assert "lod_near_radius" in errs(lod_near_radius=-1)
+    assert "lod_far_every_k" in errs(lod_far_every_k=0)
+    assert "peer_bandwidth_bytes" in errs(peer_bandwidth_bytes=-5)
+
+
+# endregion
+
+# region: churn property on a REAL plane
+
+
+@pytest.mark.parametrize("delta_ticks", ["off", "on"])
+def test_churn_property_replay_matches_ground_truth(delta_ticks):
+    """>=200 ticks of joins/leaves/movers/forced drops/cadence changes
+    against a real EntityPlane. With LOD off, every tick's diff is
+    complete, so each peer's ReplayClient must equal the ground-truth
+    visible set — the exact state the ``--interest off`` stream
+    conveys — after EVERY tick, and ``deltas_refused`` stays 0."""
+    from tests.test_entity_sim import ent_msg, make_plane
+    from worldql_server_tpu.protocol.types import Entity, Vector3
+
+    backend, plane = make_plane(k=4)
+    if delta_ticks == "on":
+        assert backend.configure_delta_ticks("on")
+        plane._delta_ticks = True
+    mgr = InterestManager()
+    plane.interest = mgr
+
+    rng = random.Random(0xC0FFEE)
+    peers = [uuid.uuid4() for _ in range(6)]
+    owned: dict[uuid.UUID, list] = {p: [] for p in peers}
+    clients = {p: ReplayClient() for p in peers}
+    ids = itertools.count()
+
+    def spawn(peer):
+        e = uuid.uuid4()
+        p = Vector3(rng.uniform(0, 60), rng.uniform(0, 60), 0.0)
+        plane.ingest(ent_msg(peer, [
+            Entity(uuid=e, position=p, world_name="w")
+        ]))
+        owned[peer].append(e)
+
+    for p in peers[:4]:
+        spawn(p)
+        spawn(p)
+
+    frames_total = delta_frames = 0
+    for t in range(220):
+        roll = rng.random()
+        if roll < 0.15 and any(owned.values()):
+            peer = rng.choice([p for p in peers if owned[p]])
+            e = owned[peer].pop(rng.randrange(len(owned[peer])))
+            plane.ingest(ent_msg(peer, [Entity(uuid=e)],
+                                 parameter="entity.remove"))
+        elif roll < 0.35:
+            spawn(rng.choice(peers))
+        elif roll < 0.45:
+            # forced drop / reconnect: any loss path lands here
+            victim = rng.choice(peers)
+            mgr.mark_resync(victim)
+        elif roll < 0.5:
+            mgr.note_governor(rng.randrange(3), rng.random() < 0.5)
+            mgr.note_governor(0, False)     # back to full cadence
+        # movers
+        for peer in peers:
+            for e in owned[peer]:
+                if rng.random() < 0.5:
+                    plane.ingest(ent_msg(peer, [Entity(
+                        uuid=e,
+                        position=Vector3(rng.uniform(0, 60),
+                                         rng.uniform(0, 60), 0.0),
+                        world_name="w",
+                    )]))
+
+        handle = plane.dispatch_tick()
+        if handle is None:
+            continue
+        result = plane.collect_tick(handle)
+        cap = result["cap"]
+        pairs = plane.apply(result)
+        for m, targets in pairs:
+            frames_total += 1
+            if parse_stamp(m.parameter)[0] == PARAM_DELTA:
+                delta_frames += 1
+            for peer in targets:
+                assert clients[peer].apply(m)
+
+        # ground truth straight off the plane columns: what a
+        # --interest off recipient would have been told this tick
+        for peer in peers:
+            pid = plane._peer_ids.get(peer)
+            if pid is None:
+                continue
+            st = mgr._peers.get(peer)
+            expect = {}
+            if st is not None:
+                for key, (wid, pos_b) in st.state.items():
+                    x, y, z = np.frombuffer(pos_b, np.float32)
+                    expect[uuid.UUID(bytes=key)] = (
+                        float(x), float(y), float(z)
+                    )
+            got = clients[peer].snapshot().get("w", {})
+            assert got == expect, f"tick {t} peer divergence"
+
+    # the oracle's core guarantees, over the whole run
+    for rc in clients.values():
+        s = rc.stats()
+        assert s["deltas_refused"] == 0
+        assert s["gaps_seen"] == 0
+    assert frames_total > 0 and delta_frames > 0
+    assert mgr.resyncs > 0
+
+
+def test_churn_ledger_equals_visible_set_without_lod():
+    """The ledger-vs-targets cross-check the property above leans on:
+    with LOD off and no bandwidth cap, the manager's committed state
+    for a peer IS the visible set from the tick's targets matrix."""
+    from tests.test_entity_sim import ent_msg, make_plane
+    from worldql_server_tpu.protocol.types import Entity, Vector3
+
+    backend, plane = make_plane(k=4)
+    mgr = InterestManager()
+    plane.interest = mgr
+    rng = random.Random(7)
+    peers = [uuid.uuid4() for _ in range(3)]
+    ents = {}
+    for p in peers:
+        for _ in range(3):
+            e = uuid.uuid4()
+            ents[e] = p
+            plane.ingest(ent_msg(p, [Entity(
+                uuid=e, position=Vector3(rng.uniform(0, 30),
+                                         rng.uniform(0, 30), 0.0),
+                world_name="w",
+            )]))
+    handle = plane.dispatch_tick()
+    result = plane.collect_tick(handle)
+    cap = result["cap"]
+    targets = np.array(result["targets"])
+    plane.apply(result)
+    live = plane._live[:cap]
+    for peer in peers:
+        pid = plane._peer_ids[peer]
+        visible_rows = {
+            int(r) for r in np.flatnonzero(live)
+            if pid in targets[r][targets[r] >= 0]
+        }
+        st = mgr._peers.get(peer)
+        ledger_rows = set()
+        if st is not None:
+            key_to_row = {
+                plane._uuid_bytes[r].tobytes(): int(r)
+                for r in np.flatnonzero(live)
+            }
+            ledger_rows = {key_to_row[k] for k in st.state}
+        assert ledger_rows == visible_rows
+
+
+# endregion

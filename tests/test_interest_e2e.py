@@ -1,0 +1,278 @@
+"""Interest management end to end (ISSUE 18): the stamped stream over
+real ZMQ sockets, the full-frame resync contract across park→resume
+and worker loss, and the one ``mark_resync`` hook every loss path
+shares.
+
+Each test feeds a :class:`ReplayClient` from the recipient's actual
+socket — ``deltas_refused == 0`` on that oracle IS the acceptance
+guarantee that no recipient ever applies a delta against a frame it
+never got, across reconnects, parked sessions, and a SIGKILLed sender
+worker."""
+
+import asyncio
+import os
+import signal
+import uuid
+
+import pytest
+
+from tests.client_util import ZmqClient, free_port
+from tests.test_entity_sim import vel_flex
+from worldql_server_tpu.engine.config import Config
+from worldql_server_tpu.engine.server import WorldQLServer
+from worldql_server_tpu.interest import ReplayClient, parse_stamp
+from worldql_server_tpu.interest.manager import PARAM_FULL
+from worldql_server_tpu.protocol import Instruction, Message
+from worldql_server_tpu.protocol.types import Entity, Vector3
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def make_server(**overrides) -> WorldQLServer:
+    config = Config(
+        store_url="memory://",
+        http_enabled=False, ws_enabled=False,
+        zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+        spatial_backend="tpu", tick_interval=0.03,
+        entity_sim=True, entity_k=4, interest="on",
+        precompile_tiers=False,
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return WorldQLServer(config)
+
+
+async def _register(client, ent, pos, vel=None, world="w"):
+    await client.send(Message(
+        instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+        entities=[Entity(
+            uuid=ent, position=pos, world_name=world,
+            flex=vel_flex(*vel) if vel else None,
+        )],
+    ))
+
+
+async def _pump(client, rc, want_frames, timeout=20.0):
+    """Feed the recipient's socket into its replay oracle until it has
+    applied ``want_frames`` more frames."""
+    goal = rc.frames_applied + want_frames
+    deadline = asyncio.get_event_loop().time() + timeout
+    while rc.frames_applied < goal:
+        left = deadline - asyncio.get_event_loop().time()
+        assert left > 0, f"stalled at {rc.frames_applied}/{goal} frames"
+        m = await client.recv_until(Instruction.LOCAL_MESSAGE, left)
+        rc.apply(m)
+    return rc
+
+
+def _wired(server):
+    mgr = server.interest
+    assert mgr is not None
+    return mgr
+
+
+def test_loss_hooks_all_route_to_mark_resync():
+    """Satellite 3's unification: pump drops, worker ring drops,
+    undelivered-to-parked and local send failures all land in the ONE
+    ``mark_resync`` hook — no second bookkeeping path to drift."""
+    server = make_server(delivery_workers=1, session_ttl=5.0)
+    mgr = _wired(server)
+    assert server.peer_map.on_frame_loss == mgr.mark_resync
+    assert server.delivery_plane.on_frame_drop == mgr.mark_resync
+    assert server.sessions.on_undelivered == mgr.mark_resync
+
+
+async def _interest_stream_scenario(server):
+    """Shared ZMQ scenario, both delivery paths: recipient's first
+    frame is the epoch-opening keyframe, movement then streams as
+    deltas, and the oracle sees zero gaps and zero refused deltas."""
+    await server.start()
+    try:
+        port = server.config.zmq_server_port
+        a = await ZmqClient.connect(port)
+        b = await ZmqClient.connect(port)
+        ea, eb = uuid.uuid4(), uuid.uuid4()
+        await _register(a, ea, Vector3(1, 2, 3), vel=(25.0,))
+        await _register(b, eb, Vector3(2, 2, 3))
+
+        first = await b.recv_until(Instruction.LOCAL_MESSAGE, 15)
+        stamped = parse_stamp(first.parameter)
+        assert stamped is not None, first.parameter
+        kind, epoch, seq = stamped
+        assert kind == PARAM_FULL and seq == 0
+        rc = ReplayClient()
+        assert rc.apply(first)
+        # except-self holds on the interest path too
+        assert ea in rc.worlds["w"] and eb not in rc.worlds["w"]
+        x0 = rc.worlds["w"][ea][0]
+
+        await _pump(b, rc, 6)
+        s = rc.stats()
+        assert s["deltas_applied"] > 0          # movement rode deltas
+        assert s["deltas_refused"] == 0 and s["gaps_seen"] == 0
+        assert rc.worlds["w"][ea][0] > x0       # integration visible
+
+        mgr = _wired(server)
+        assert mgr.last_delta_frames + mgr.last_full_frames >= 0
+        snap = server.metrics.snapshot()
+        assert snap["gauges"].get("frame.delta_ratio") is not None
+        assert snap["gauges"].get("delivery.bytes_per_tick") is not None
+        await a.close()
+        await b.close()
+    finally:
+        await server.stop()
+
+
+def test_interest_stream_over_zmq_in_process_delivery():
+    run(_interest_stream_scenario(make_server()))
+
+
+def test_interest_stream_over_zmq_with_delivery_workers():
+    run(_interest_stream_scenario(make_server(delivery_workers=1)))
+
+
+def test_park_resume_forces_full_frame_and_converges():
+    """Satellite 2: frames missed while a session is parked can never
+    be papered over by a delta — the resumed client's FIRST frame is a
+    keyframe under a new epoch, and its oracle converges with zero
+    refused deltas."""
+
+    async def scenario():
+        server = make_server(session_ttl=10.0)
+        mgr = _wired(server)
+        await server.start()
+        try:
+            port = server.config.zmq_server_port
+            a = await ZmqClient.connect(port)
+            b = await ZmqClient.connect(port)
+            ea, eb = uuid.uuid4(), uuid.uuid4()
+            await _register(a, ea, Vector3(1, 2, 3), vel=(25.0,))
+            await _register(b, eb, Vector3(2, 2, 3))
+            rc = ReplayClient()
+            await _pump(b, rc, 3)
+            epoch0 = rc.epoch
+            assert rc.deltas_applied >= 1
+
+            # hard drop; the removal parks the session
+            token, u = b.token, b.uuid
+            await b.close()
+            await server.peer_map.remove(u)
+            assert server.sessions.parked_count() == 1
+            resyncs0 = mgr.resyncs
+            # the sim keeps ticking at the parked peer: undelivered
+            # frames land in mark_resync, not in a void
+            deadline = asyncio.get_event_loop().time() + 10
+            while mgr.resyncs == resyncs0:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+
+            resumed = await ZmqClient.resume(port, token, u)
+            assert resumed.token == token
+            first = await resumed.recv_until(Instruction.LOCAL_MESSAGE, 15)
+            kind, epoch, seq = parse_stamp(first.parameter)
+            assert kind == PARAM_FULL and seq == 0
+            assert epoch > epoch0           # a DECLARED resync, not a gap
+            assert rc.apply(first)
+            await _pump(resumed, rc, 3)
+            s = rc.stats()
+            assert s["deltas_refused"] == 0 and s["gaps_seen"] == 0
+            assert s["epochs_seen"] >= 2
+            # converged: the mover is present and kept advancing
+            assert ea in rc.worlds["w"]
+            await resumed.close()
+            await a.close()
+        finally:
+            await server.stop()
+
+    run(scenario())
+
+
+def test_worker_loss_forces_full_frame_for_rebound_peer():
+    """Satellite 3's regression: SIGKILL a sender worker mid-stream.
+    The victim's eviction routes through ``mark_resync`` before the
+    session parks; when the peer comes back (re-adopted wherever a
+    live shard has room) its next frame is FULL under a new epoch.
+    A survivor on the other shard sees an unbroken stream."""
+
+    async def scenario():
+        server = make_server(delivery_workers=2, session_ttl=10.0)
+        mgr = _wired(server)
+        await server.start()
+        try:
+            port = server.config.zmq_server_port
+            mover = await ZmqClient.connect(port)
+            await _register(mover, uuid.uuid4(), Vector3(1, 2, 3),
+                            vel=(25.0,))
+            watchers = []
+            for i in range(4):
+                c = await ZmqClient.connect(port)
+                await _register(c, uuid.uuid4(),
+                                Vector3(2.0 + 0.1 * i, 2, 3))
+                watchers.append(c)
+            await asyncio.sleep(0.3)    # adoption settles
+
+            plane = server.delivery_plane
+            shard0 = plane._shards[0]
+            victims = set(shard0.peers)
+            victim = next(
+                (c for c in watchers if c.uuid in victims), None
+            )
+            survivor = next(
+                (c for c in watchers if c.uuid not in victims), None
+            )
+            if victim is None or survivor is None:
+                pytest.skip("adoption landed every watcher on one shard")
+
+            rc_v, rc_s = ReplayClient(), ReplayClient()
+            await _pump(victim, rc_v, 3)
+            await _pump(survivor, rc_s, 3)
+            epoch0 = rc_v.epoch
+
+            os.kill(shard0.proc.pid, signal.SIGKILL)
+
+            # eviction (reason worker_lost) parks the victim's session
+            token, u = victim.token, victim.uuid
+            deadline = asyncio.get_event_loop().time() + 15
+            while True:
+                snap = server.metrics.snapshot()
+                if snap["counters"].get("peers.evicted_worker_lost", 0):
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            deadline = asyncio.get_event_loop().time() + 10
+            while server.sessions.parked_count() == 0:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            await victim.close()
+
+            # survivor's stream never broke
+            await _pump(survivor, rc_s, 3)
+            s = rc_s.stats()
+            assert s["deltas_refused"] == 0 and s["gaps_seen"] == 0
+
+            # the rebound peer's FIRST frame is full under a new epoch
+            resumed = await ZmqClient.resume(port, token, u)
+            first = await resumed.recv_until(Instruction.LOCAL_MESSAGE, 15)
+            kind, epoch, seq = parse_stamp(first.parameter)
+            assert kind == PARAM_FULL and seq == 0
+            assert epoch > epoch0
+            assert rc_v.apply(first)
+            await _pump(resumed, rc_v, 3)
+            v = rc_v.stats()
+            assert v["deltas_refused"] == 0 and v["gaps_seen"] == 0
+            assert mgr.resyncs >= 1
+
+            await resumed.close()
+            for c in [mover, survivor] + [
+                w for w in watchers if w not in (victim, survivor)
+            ]:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+        finally:
+            await server.stop()
+
+    run(scenario())

@@ -22,7 +22,7 @@ def test_catalog_names():
     assert set(CATALOG) == {
         "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
         "reconnect_storm_replay", "cluster_flash_crowd",
-        "sniper_scope", "projectile_storm",
+        "sniper_scope", "projectile_storm", "bandwidth_cap",
     }
     # the replay-storm variant is catalogued but NOT CI-smoke-blocking;
     # the cluster variant spawns shard subprocesses and runs in its

@@ -31,6 +31,11 @@ _LOWER_BETTER = (
     "_ms", "_s", "drops", "errors", "lost", "retraces", "failures",
     "evictions", "slow_ticks",
 )
+#: byte-volume metrics are lower-is-better and must be classified
+#: BEFORE the higher-better pass: ``bytes_per_recipient_per_s``
+#: contains "per_s" and would otherwise read as a throughput win when
+#: the interest manager ships MORE bytes (ISSUE 18)
+_BYTES_LOWER = ("bytes_per", "bytes_shed")
 #: substrings that mark a metric higher-is-better
 _HIGHER_BETTER = (
     "per_s", "vs_baseline", "speedup", "deliveries", "sends_ok",
@@ -103,8 +108,12 @@ def flatten(rec: dict, prefix: str = "") -> dict:
 
 def direction(name: str) -> int:
     """+1 = higher is better, -1 = lower is better, 0 = informational.
-    Higher-better wins ties ('deliveries_per_s' contains '_s')."""
+    Byte-volume leaves (``*bytes_per_tick``/``*bytes_per_recipient_
+    per_s``/``bytes_shed``) resolve lower-better FIRST; after that,
+    higher-better wins ties ('deliveries_per_s' contains '_s')."""
     leaf = name.rsplit(".", 1)[-1]
+    if any(tok in leaf for tok in _BYTES_LOWER):
+        return -1
     if any(tok in leaf for tok in _HIGHER_BETTER):
         return 1
     if any(leaf.endswith(tok) or tok in leaf for tok in _LOWER_BETTER):

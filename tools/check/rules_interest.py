@@ -1,0 +1,106 @@
+"""Interest-managed frame sequencing rule (ISSUE 18).
+
+Every stamped frame parameter (``entity.frame.full`` / ``fullc`` /
+``delta`` plus ``:<epoch>:<seq>``) MUST come from
+``worldql_server_tpu/interest/manager.py``'s ``stamp()`` helper — it
+is the one place the per-peer epoch:seq cursor advances, and the one
+place the resync contract (epoch bump on any loss) is enforced. A
+delivery- or pump-path module that builds such a parameter literal by
+hand (a raw string, or an f-string like ``f"entity.frame.delta:..."``)
+has minted an UNSEQUENCED frame: the peer's replay client will either
+see a phantom gap (desync storm) or — worse — apply a delta the
+server's ledger never committed, silently corrupting its state. The
+parity oracle can only prove "no delta past a gap" if the stamp
+authority is singular.
+
+Scope: the delivery and pump paths that touch outbound frames —
+``engine/peers.py``, ``engine/ticker.py``, ``engine/server.py``,
+``entities/plane.py``, everything under ``delivery/`` and
+``interest/`` — with ``interest/manager.py`` itself exempt (it IS the
+helper). Suppress a deliberate use (e.g. a hand-rolled fixture) with
+``# wql: allow(unsequenced-frame)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation
+
+#: the stamped parameter bases (interest/manager.py PARAM_*)
+_STAMPED_PREFIXES = (
+    "entity.frame.full", "entity.frame.fullc", "entity.frame.delta",
+)
+
+#: delivery/pump-path modules where a raw stamp literal is a bug
+_SCOPED = (
+    "engine/peers.py", "engine/ticker.py", "engine/server.py",
+    "entities/plane.py",
+)
+_SCOPED_DIRS = ("delivery/", "interest/")
+
+#: the ONE module allowed to spell the literals: the stamp authority
+_EXEMPT = ("interest/manager.py",)
+
+
+def _in_scope(relpath: str) -> bool:
+    if relpath.endswith(_EXEMPT):
+        return False
+    if relpath.endswith(_SCOPED):
+        return True
+    norm = relpath.replace("\\", "/")
+    return any(f"/{d}" in norm or norm.startswith(d) for d in _SCOPED_DIRS)
+
+
+def _literal_head(node: ast.AST) -> str | None:
+    """The leading literal text of a string expression: a plain
+    constant's value, or an f-string's first constant chunk (the
+    hand-rolled ``f"entity.frame.delta:{e}:{s}"`` shape)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+def _check_unsequenced(ctx: FileContext) -> Iterator[Violation]:
+    if not _in_scope(ctx.relpath):
+        return
+    # an f-string's leading chunk is ALSO an ast.Constant in the walk;
+    # flag the JoinedStr once, not its fragment a second time
+    fstring_heads = {
+        id(n.values[0]) for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.JoinedStr) and n.values
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Constant) and id(node) in fstring_heads:
+            continue
+        head = _literal_head(node)
+        if head is None or not head.startswith(_STAMPED_PREFIXES):
+            continue
+        if isinstance(node, ast.Constant) and head in _STAMPED_PREFIXES:
+            # the bare kind with no :epoch:seq tail — comparing or
+            # routing on the prefix (parse_stamp consumers) is fine;
+            # only a stamped PAYLOAD parameter is sequenced
+            continue
+        yield from ctx.flag(
+            UNSEQUENCED_FRAME, node,
+            "stamped frame parameter built outside interest/manager.py "
+            "— every entity.frame.{full,fullc,delta} payload must go "
+            "through stamp() so the per-peer epoch:seq cursor (and the "
+            "resync contract behind it) stays singular; a hand-minted "
+            "stamp ships a frame the delivery ledger never sequenced",
+        )
+
+
+UNSEQUENCED_FRAME = Rule(
+    "unsequenced-frame",
+    "stamped entity.frame payloads in delivery/pump paths must come "
+    "from the interest manager's stamp() helper",
+    _check_unsequenced,
+)
+
+RULES = [UNSEQUENCED_FRAME]

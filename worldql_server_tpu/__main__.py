@@ -288,6 +288,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "--cluster-shards N) or 'shard' (spawned by the "
                         "router-tier supervisor; requires the "
                         "WQL_CLUSTER_SPEC topology env)")
+    p.add_argument("--interest", choices=["off", "on"],
+                   help="interest-managed fan-out: per-recipient "
+                        "delta frames under a stamped epoch:seq wire "
+                        "contract (entity.frame.full/fullc/delta) "
+                        "with forced full-frame resync on every loss "
+                        "path, LOD cadence tiers and per-peer "
+                        "bandwidth budgets (requires --entity-sim; "
+                        "default off = the broadcast delivery path "
+                        "byte for byte)")
+    p.add_argument("--lod-near-radius", type=float,
+                   dest="lod_near_radius",
+                   help="LOD cadence partition radius: neighbors "
+                        "within this distance of the recipient's own "
+                        "entity centroid deliver every tick, farther "
+                        "ones every --lod-far-every-k ticks as "
+                        "accumulated (lossless) diffs; 0 (default) "
+                        "puts every neighbor in the near cohort")
+    p.add_argument("--lod-far-every-k", type=int,
+                   dest="lod_far_every_k",
+                   help="far-cohort delivery cadence in ticks; the "
+                        "overload governor's SHED tiers widen it "
+                        "(k << level) instead of skipping frames "
+                        "(default 4)")
+    p.add_argument("--peer-bandwidth-bytes", type=int,
+                   dest="peer_bandwidth_bytes",
+                   help="per-peer delivery budget in bytes/s (token "
+                        "bucket): an over-budget peer degrades "
+                        "cadence first, then keyframe-only, and only "
+                        "then sheds whole keyframes "
+                        "(delivery.bytes_shed) — deltas are never "
+                        "truncated (default 0 = off)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -319,6 +350,8 @@ _OVERRIDES = [
     "session_ttl", "session_resume_rate",
     "delta_ticks", "delta_rebuild_threshold",
     "cluster_shards", "cluster_role",
+    "interest", "lod_near_radius", "lod_far_every_k",
+    "peer_bandwidth_bytes",
 ]
 
 

@@ -104,6 +104,14 @@ class DeliveryPlane:
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else Tracer()
         self.on_peer_lost = on_peer_lost
+        # Optional drop hook (--interest on): called with the affected
+        # peer UUIDs whenever deliver() sheds a frame on a full/dead
+        # ring. The ONE observability point the in-process pump and the
+        # worker plane share — the server wires both it and the
+        # PeerMap's on_frame_loss to InterestManager.mark_resync, so a
+        # ring drop forces the peer's next frame full exactly like a
+        # local send error does.
+        self.on_frame_drop = None
         self._budget = config.supervisor_budget
         self._backoff = config.supervisor_backoff
         # worker processes arm their own failpoint registry from the
@@ -586,6 +594,11 @@ class DeliveryPlane:
                 shard, frame, slots.tobytes(), t_ingress_ns
             ):
                 self._count_drop(len(slots))
+                if self.on_frame_drop is not None:
+                    for slot in slots:
+                        u = shard.slots.get(slot)
+                        if u is not None:
+                            self.on_frame_drop(u)
         return n
 
     # endregion

@@ -453,6 +453,40 @@ class Config:
     device_telemetry: bool = field(
         default_factory=lambda: _env("WQL_DEVICE_TELEMETRY", "1") == "1"
     )
+    # Interest-managed fan-out (worldql_server_tpu/interest, ROADMAP
+    # item 3): 'on' replaces the per-entity neighbor-frame broadcast
+    # with per-recipient delta frames — each peer receives a diff
+    # (entered/left/moved) against its last delivered state under an
+    # epoch:seq stamped wire contract (`entity.frame.full` /
+    # `entity.frame.delta`), with a forced full-frame resync on every
+    # loss path (reconnect, session resume, ring drop, worker loss,
+    # overload shed). 'off' (the default) never constructs the
+    # manager: the delivery path — frame bytes, parameter strings,
+    # sequence-field absence — is byte for byte the pre-interest
+    # pipeline.
+    interest: str = field(
+        default_factory=lambda: _env("WQL_INTEREST", "off")
+    )
+    # LOD cadence partition: recipients within `lod_near_radius` of a
+    # neighbor entity (distance to the recipient's own entity
+    # centroid) deliver every tick; farther rows deliver every
+    # `lod_far_every_k` ticks (lossless deferral — the diff
+    # accumulates, never drops). near_radius 0 puts every row in the
+    # near cohort.
+    lod_near_radius: float = field(
+        default_factory=lambda: float(_env("WQL_LOD_NEAR_RADIUS", "0"))
+    )
+    lod_far_every_k: int = field(
+        default_factory=lambda: int(_env("WQL_LOD_FAR_EVERY_K", "4"))
+    )
+    # Per-peer bandwidth budget (bytes/s, token bucket, 0 = off): an
+    # over-budget peer degrades CADENCE first (forced far tier), then
+    # coalesces to keyframe-only, and only then sheds whole keyframes
+    # (`delivery.bytes_shed`) — a delta is never silently truncated,
+    # so eventual-state parity holds under any budget.
+    peer_bandwidth_bytes: int = field(
+        default_factory=lambda: int(_env("WQL_PEER_BANDWIDTH_BYTES", "0"))
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation
@@ -637,6 +671,19 @@ class Config:
             )
         if self.delta_ticks not in ("auto", "on", "off"):
             errors.append("delta_ticks must be 'auto', 'on' or 'off'")
+        if self.interest not in ("on", "off"):
+            errors.append("interest must be 'on' or 'off'")
+        if self.interest == "on" and not self.entity_sim:
+            errors.append(
+                "interest requires entity_sim — the manager diffs the "
+                "entity plane's per-tick neighbor frames"
+            )
+        if self.lod_near_radius < 0:
+            errors.append("lod_near_radius must be >= 0 (0 = all near)")
+        if self.lod_far_every_k < 1:
+            errors.append("lod_far_every_k must be >= 1")
+        if self.peer_bandwidth_bytes < 0:
+            errors.append("peer_bandwidth_bytes must be >= 0 (0 = off)")
         if self.delta_ticks == "on" and self.spatial_backend == "cpu":
             errors.append(
                 "delta_ticks='on' requires a device spatial backend "

@@ -165,6 +165,18 @@ class PeerMap:
         # buffering. None (the default) costs one attribute test on
         # the map-miss path only.
         self._sessions = sessions
+        # Optional loss hook (--interest on): called with a peer UUID
+        # whenever a frame addressed to it could not be delivered on
+        # THIS path — map miss (parked/unknown) or slow-path send
+        # error. The server wires it to InterestManager.mark_resync so
+        # no local loss can leak a delta past a gap; the worker plane
+        # reports its own losses through on_peer_lost/on_frame_drop.
+        self.on_frame_loss: Callable[[uuid_mod.UUID], None] | None = None
+        #: cumulative wire bytes handed to transports by deliver_batch
+        #: (both paths; failed slow-path sends subtracted) — the
+        #: ticker diffs this into the delivery.bytes_per_tick gauge
+        #: and the bench into bytes/recipient/s
+        self.bytes_delivered = 0
 
     # region: lookups
 
@@ -339,6 +351,8 @@ class PeerMap:
                     if p is None:
                         if self._sessions is not None:
                             self._sessions.note_undelivered(u)
+                        if self.on_frame_loss is not None:
+                            self.on_frame_loss(u)
                         continue
                     if p.shard is not None:
                         group = groups.get(p.shard)
@@ -351,6 +365,9 @@ class PeerMap:
                 if groups:
                     worker_sends += await plane.deliver(
                         groups, t_ingress_ns
+                    )
+                    self.bytes_delivered += len(data) * sum(
+                        len(g[1]) for g in groups.values()
                     )
                 if local_targets:
                     local_pairs.append((message, local_targets))
@@ -390,8 +407,11 @@ class PeerMap:
                 if p is None:
                     if self._sessions is not None:
                         self._sessions.note_undelivered(u)
+                    if self.on_frame_loss is not None:
+                        self.on_frame_loss(u)
                     continue
                 n += 1
+                self.bytes_delivered += len(framed.payload)
                 outbox.setdefault(p, []).append(framed)
         slow: list[tuple[Peer, list[FramedPayload]]] = []
         for p, framed_list in outbox.items():
@@ -409,7 +429,12 @@ class PeerMap:
                         await p.send_raw(f.payload)
                     except Exception as exc:
                         failed += 1
+                        self.bytes_delivered -= len(f.payload)
                         logger.debug("batch delivery error: %s", exc)
+                if failed and self.on_frame_loss is not None:
+                    # the peer missed >= 1 frame of this batch: the
+                    # next interest frame must be a full resync
+                    self.on_frame_loss(p.uuid)
                 return failed
             for failed in await asyncio.gather(
                 *(drain_peer(p, fl) for p, fl in slow)

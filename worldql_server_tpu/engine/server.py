@@ -255,6 +255,10 @@ class WorldQLServer:
         # ticker exist for it); the broker-only path never imports it.
         self.entity_plane = None
         self.entity_ingest = None
+        # Interest-managed fan-out (--interest on, ISSUE 18): built
+        # below only alongside the entity plane (validate() enforces
+        # the pairing); None keeps every delivery path byte for byte.
+        self.interest = None
         if config.entity_sim:
             from ..entities import ColumnarIngest, EntityPlane
 
@@ -283,6 +287,28 @@ class WorldQLServer:
                 metrics=self.metrics,
                 on_error=lambda: self.metrics.inc("zmq.recv_errors"),
             )
+            if config.interest == "on":
+                from ..interest import InterestManager
+
+                self.interest = InterestManager(
+                    near_radius=config.lod_near_radius,
+                    far_every_k=config.lod_far_every_k,
+                    bandwidth_bytes=config.peer_bandwidth_bytes,
+                    metrics=self.metrics,
+                )
+                self.entity_plane.interest = self.interest
+                # every loss path funnels into ONE resync hook: local
+                # map-miss/send-error, worker-plane ring drops, and
+                # frames that landed on a parked session
+                self.peer_map.on_frame_loss = self.interest.mark_resync
+                if self.delivery_plane is not None:
+                    self.delivery_plane.on_frame_drop = (
+                        self.interest.mark_resync
+                    )
+                if self.sessions is not None:
+                    self.sessions.on_undelivered = (
+                        self.interest.mark_resync
+                    )
         if self.entity_plane is not None and hasattr(
             self.backend, "_note_failure"
         ):
@@ -455,6 +481,12 @@ class WorldQLServer:
             self.metrics.gauge("sessions", self.sessions.stats)
         if self.entity_plane is not None:
             self.metrics.gauge("entity_sim", self.entity_plane.stats)
+        if self.interest is not None:
+            # per-recipient fan-out accounting: resyncs, delta ratio,
+            # LOD tier sizes, bandwidth deferrals/shed — the ticker
+            # additionally pushes delivery.bytes_per_tick and the
+            # frame.delta_ratio / lod point gauges per applied tick
+            self.metrics.gauge("interest", self.interest.stats)
         if self.entity_ingest is not None:
             self.metrics.gauge("entity_ingest", self.entity_ingest.stats)
         # codec health: the WQL_MAX_OBJS overflow fallback is counted,
@@ -593,6 +625,10 @@ class WorldQLServer:
         subscription index rows, entity slots, governor bucket — PARKS
         for the TTL; otherwise the full teardown runs as always."""
         if self.sessions is not None and self.sessions.park(uuid):
+            if self.interest is not None:
+                # the transport died with frames possibly in flight —
+                # whatever resumes this session must start from a full
+                self.interest.mark_resync(uuid)
             self._release_transport_state(uuid)
             return
         self._teardown_peer_state(uuid)
@@ -646,6 +682,10 @@ class WorldQLServer:
         None when the peer was already out of the map (parked)."""
         old = self.peer_map.detach(uuid)
         self._release_transport_state(uuid)
+        if self.interest is not None:
+            # resume contract: the rebound binding's first frame is a
+            # forced full regardless of what the old transport saw
+            self.interest.mark_resync(uuid)
         return old
 
     def _on_delivery_peer_lost(self, uuid, reason: str) -> None:
@@ -656,6 +696,12 @@ class WorldQLServer:
         ``peers.evicted_*`` accounting), exactly like the in-process
         failed-send path."""
         self.metrics.inc(f"peers.evicted_{reason}")
+        if self.interest is not None:
+            # worker loss / ring eviction: if the peer's session parks
+            # and later resumes (possibly adopted on another shard),
+            # its next frame must be full — the in-process failed-send
+            # path marks the same way via PeerMap.on_frame_loss
+            self.interest.mark_resync(uuid)
         task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task)
             self.peer_map.remove(uuid)
         )

@@ -171,6 +171,9 @@ class TickBatcher:
         self.last_dispatch_ms = 0.0  # host encode + device launch
         self.last_collect_ms = 0.0   # device wait + UUID decode
         self.last_compaction_bucket = 0
+        # PeerMap.bytes_delivered high-water at the last _account —
+        # diffed into the delivery.bytes_per_tick gauge
+        self._bytes_mark = 0
 
     def start(self) -> None:
         if self._sup is not None:
@@ -275,6 +278,23 @@ class TickBatcher:
             logger.exception("entity sim dispatch failed — sim tick skipped")
             return None
 
+    def _frame_skip(self, sim_handle) -> bool:
+        """The governed frame-leg degradation decision for this tick.
+        An interest-managed plane NEVER blind-skips: the governor's
+        shed level widens the far-tier cadence (lossless deferral) via
+        ``note_governor`` instead — PR 10's alternate-tick drop
+        generalized into a cadence policy. Ungoverned or
+        interest-off paths keep ``take_frame_skip`` byte for byte."""
+        gov = self._governor
+        if gov is None or sim_handle is None:
+            return False
+        plane = self._entity_plane
+        interest = getattr(plane, "interest", None)
+        if interest is not None:
+            interest.note_governor(gov.level, gov.degraded())
+            return False
+        return gov.take_frame_skip()
+
     async def _sim_collect_apply(self, sim_handle, trace,
                                  skip_frames: bool = False) -> list:
         """Wait out the sim tick on a worker thread, then integrate it
@@ -291,7 +311,19 @@ class TickBatcher:
                     plane.collect_tick, sim_handle
                 )
             with trace.span("tick.sim.apply"):
-                return plane.apply(result, trace, skip_frames=skip_frames)
+                pairs = plane.apply(result, trace, skip_frames=skip_frames)
+            interest = plane.interest
+            if interest is not None and self.metrics is not None:
+                st = interest.stats()
+                self.metrics.set_gauge(
+                    "frame.delta_ratio", st["delta_ratio"]
+                )
+                self.metrics.set_gauge("lod", {
+                    "near": st["near"], "far": st["far"],
+                    "demoted": st["demoted"],
+                    "far_every_k": st["far_every_k"],
+                })
+            return pairs
         except asyncio.CancelledError:
             plane.abort_tick()
             raise
@@ -349,11 +381,7 @@ class TickBatcher:
                 # closed at delivery completion on whichever path
                 t_ingress_ns = time.monotonic_ns()
                 sim_handle = self._sim_dispatch(trace)
-                skip_frames = (
-                    self._governor is not None
-                    and sim_handle is not None
-                    and self._governor.take_frame_skip()
-                )
+                skip_frames = self._frame_skip(sim_handle)
                 handle = None
                 if batch:
                     try:
@@ -609,11 +637,7 @@ class TickBatcher:
             dispatched = not batch
             deliver_task = None
             sim_handle = self._sim_dispatch(trace)
-            skip_frames = (
-                self._governor is not None
-                and sim_handle is not None
-                and self._governor.take_frame_skip()
-            )
+            skip_frames = self._frame_skip(sim_handle)
             try:
                 targets = []
                 if batch:
@@ -756,6 +780,14 @@ class TickBatcher:
             self.metrics.observe_ms("tick.deliver_ms", self.last_deliver_ms)  # wql: allow(unspanned-stage)
             self.metrics.inc("tick.flushes")
             self.metrics.inc("tick.messages", len(batch))
+            # delivered wire bytes attributable to THIS flush: the
+            # PeerMap counter diffed across consecutive accounts (both
+            # flush variants route here after their delivery settles)
+            bd = getattr(self.peer_map, "bytes_delivered", 0)
+            self.metrics.set_gauge(
+                "delivery.bytes_per_tick", bd - self._bytes_mark
+            )
+            self._bytes_mark = bd
         if self._governor is not None:
             self._governor.note_tick(self.last_tick_ms, len(self._queue))
         trace.tag(tick_ms=round(self.last_tick_ms, 3))

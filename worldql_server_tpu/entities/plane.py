@@ -303,6 +303,13 @@ class EntityPlane:
         # degrades to the object path), None/instance for tests
         self._wire = entity_wire.shared() if wire == "auto" else wire
 
+        #: interest manager (``--interest on``): when set, apply()
+        #: routes the frame leg through per-recipient delta frames
+        #: instead of _build_frames. None (the default) keeps the
+        #: legacy broadcast path byte for byte — the manager is never
+        #: consulted, constructed, or imported on that path.
+        self.interest = None
+
         #: (wid, cx, cy, cz, pid) → live-entity refcount backing ONE
         #: index row; transitions through 0 mutate the index
         self._sub_refs: Counter = Counter()
@@ -781,6 +788,8 @@ class EntityPlane:
         ``backend.remove_peer`` BEFORE this hook runs, so only the
         plane-side bookkeeping (slots + refcounts) is released here."""
         pid = self._peer_ids.get(peer)
+        if self.interest is not None:
+            self.interest.forget_peer(peer)
         if pid is None:
             return 0
         removed = 0
@@ -1240,6 +1249,8 @@ class EntityPlane:
             self.frames_skipped += 1
             if self.metrics is not None:
                 self.metrics.inc("sim.frames_skipped")
+        elif self.interest is not None:
+            pairs = self.interest.build_pairs(self, pos, targets, cap)
         else:
             pairs = self._build_frames(pos, targets, counts, cap)
 

@@ -103,6 +103,17 @@ class EntityWire:
                 ctypes.c_char_p, ctypes.c_int32,
                 ctypes.POINTER(_c_u8p), _c_i64p, _c_i64p,
             ]
+        self._encode_interest = getattr(
+            lib, "wql_encode_interest_frame", None
+        )
+        if self._encode_interest is not None:
+            self._encode_interest.restype = ctypes.c_int
+            self._encode_interest.argtypes = [
+                ctypes.c_char_p, ctypes.c_int32,
+                ctypes.c_char_p, ctypes.c_int32,
+                _c_u8p, _c_f64p, _c_u8p, ctypes.c_int64,
+                ctypes.POINTER(_c_u8p), _c_i64p,
+            ]
         self._free = lib.wql_buffer_free
         self._free.argtypes = [_c_u8p]
         self._free.restype = None
@@ -124,6 +135,10 @@ class EntityWire:
     @property
     def can_encode_frames(self) -> bool:
         return self._encode_frames is not None
+
+    @property
+    def can_encode_interest(self) -> bool:
+        return self._encode_interest is not None
 
     # region: decode
 
@@ -204,6 +219,37 @@ class EntityWire:
             blob[o:o + ln]
             for o, ln in zip(off.tolist(), lens.tolist())
         ]
+
+    def encode_interest_frame(self, param: bytes, world: bytes,
+                              ent_keys: np.ndarray, pos: np.ndarray,
+                              tomb: np.ndarray) -> bytes:
+        """Encode ONE interest-managed frame (ISSUE 18) natively:
+        stamped parameter + shared world + ``[n,16]u8`` entity keys +
+        ``[n,3]f64`` positions + ``[n]u8`` tombstone flags → wire
+        bytes, byte-identical to ``serialize_message`` of the
+        equivalent Message (the cohort template the manager patches
+        per peer)."""
+        n = len(ent_keys)
+        ek = np.ascontiguousarray(ent_keys, np.uint8)
+        p = np.ascontiguousarray(pos, np.float64)
+        tb = np.ascontiguousarray(tomb, np.uint8)
+        out = _c_u8p()
+        out_len = ctypes.c_int64()
+        rc = self._encode_interest(
+            param, len(param), world, len(world),
+            ek.ctypes.data_as(_c_u8p),
+            p.ctypes.data_as(_c_f64p),
+            tb.ctypes.data_as(_c_u8p),
+            n,
+            ctypes.byref(out),
+            ctypes.byref(out_len),
+        )
+        if rc != 0:
+            raise RuntimeError(f"native interest encode failed (rc {rc})")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._free(out)
 
     # endregion
 

@@ -94,12 +94,18 @@ class SessionStore:
         *,
         metrics=None,
         on_expire: Callable[[uuid_mod.UUID], None] | None = None,
+        on_undelivered: Callable[[uuid_mod.UUID], None] | None = None,
         sweep_interval: float | None = None,
         clock=time.monotonic,
     ):
         self.ttl = float(ttl)
         self.metrics = metrics
         self.on_expire = on_expire
+        # Loss hook (--interest on): every frame that lands on a
+        # parked session is a GAP in that peer's stream — the server
+        # wires this to InterestManager.mark_resync so the first frame
+        # after resume is a forced full, never an unappliable delta.
+        self.on_undelivered = on_undelivered
         # sweep often enough that reclamation lag is a fraction of the
         # TTL, but never busy-spin tiny TTLs
         self.sweep_interval = (
@@ -215,6 +221,8 @@ class SessionStore:
         if session is not None and session.parked:
             session.undelivered += 1
             self.undelivered_frames += 1
+            if self.on_undelivered is not None:
+                self.on_undelivered(uuid)
 
     def expire_due(self) -> list[uuid_mod.UUID]:
         """One reclamation pass: every parked session past its

@@ -9,7 +9,7 @@ tests/test_scenarios.py.
 """
 
 from .catalog import (
-    BattleRoyale, ClusterFlashCrowd, FlashCrowd, GameTick,
+    BandwidthCap, BattleRoyale, ClusterFlashCrowd, FlashCrowd, GameTick,
     ProjectileStorm, ReconnectStorm, ReconnectStormReplay, SniperScope,
 )
 from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
@@ -19,12 +19,13 @@ CATALOG = {
     for scenario in (
         FlashCrowd, BattleRoyale, ReconnectStorm, GameTick,
         ReconnectStormReplay, ClusterFlashCrowd,
-        SniperScope, ProjectileStorm,
+        SniperScope, ProjectileStorm, BandwidthCap,
     )
 }
 
 __all__ = [
     "CATALOG",
+    "BandwidthCap",
     "BattleRoyale",
     "Check",
     "ClusterFlashCrowd",

@@ -1440,3 +1440,302 @@ class ClusterFlashCrowd(Scenario):
             Check("broker_answers_after_storm", slo["broker_answers"],
                   slo["broker_answers"], True),
         ]
+
+
+class BandwidthCap(Scenario):
+    """Per-peer bandwidth budgets under asymmetric demand (ISSUE 18):
+    a victim peer whose interest set is a dense mover swarm outruns
+    the per-peer byte budget while two bystanders in quiet pockets
+    stay far under it. Survival means the budget degrades the victim's
+    CADENCE, never its state: the victim racks up lossless deferrals
+    and walks the demote ladder, its replay oracle never refuses a
+    delta or sees a gap, and after the swarm quiesces it converges to
+    the server's own ledger; the bystanders never defer, never demote,
+    and stream at full rate throughout. The accounting is exact — the
+    bytes actually put on the victim's wire respect the token-bucket
+    bound (burst + rate x elapsed), and ``delivery.bytes_shed`` may
+    count only once some peer has bottomed out at keyframe-only."""
+
+    name = "bandwidth_cap"
+    description = "over-budget peer degrades cadence, never state"
+
+    def build_config(self, shape: str) -> Config:
+        return Config(
+            store_url="memory://",
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1", zmq_server_port=free_port(),
+            spatial_backend="tpu", tick_interval=0.02,
+            entity_sim=True, entity_k=12, interest="on",
+            peer_bandwidth_bytes=16384,
+            precompile_tiers=False,
+            supervisor_backoff=0.005,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        import struct
+
+        from ..interest import ReplayClient, parse_stamp
+        from ..protocol import deserialize_message
+
+        world = "cap"
+        n_movers = 48 if ctx.smoke else 96
+        n_victim = 8 if ctx.smoke else 12
+        load_s = 3.5 if ctx.smoke else 10.0
+        rng = np.random.default_rng(18)
+
+        hub = await ctx.connect()
+        victim = await ctx.connect()
+        bystanders = [await ctx.connect() for _ in range(2)]
+
+        # the swarm: a co-located mover cluster, velocity-integrated
+        # by the device tick — sustained per-tick deltas far beyond
+        # the per-peer budget for anyone whose interest set is ALL of
+        # it (the hub owns the swarm, so it is over budget too; the
+        # victim's checks below are keyed per peer, not globally)
+        movers = [uuid_mod.uuid4() for _ in range(n_movers)]
+        await hub.send(Message(
+            instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+            entities=[Entity(
+                uuid=m, position=Vector3(*rng.uniform(6.0, 10.0, 3)),
+                world_name=world,
+                flex=struct.pack("<3f", 2.0, 0.0, 0.0),
+            ) for m in movers],
+        ))
+        # the victim parks its own entities INSIDE the swarm: its kNN
+        # interest set is the whole mover cluster
+        await victim.send(Message(
+            instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+            entities=[Entity(
+                uuid=uuid_mod.uuid4(),
+                position=Vector3(*rng.uniform(6.0, 10.0, 3)),
+                world_name=world,
+            ) for _ in range(n_victim)],
+        ))
+        # each bystander lives in a distant pocket of statics plus ONE
+        # slow drifter: a small per-tick delta stream that stays well
+        # inside the budget for the whole scenario
+        drifters: list[tuple[uuid_mod.UUID, float]] = []
+        for i, b in enumerate(bystanders):
+            base = 300.0 * (i + 1)
+            await b.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+                entities=[Entity(
+                    uuid=uuid_mod.uuid4(),
+                    position=Vector3(base, 6.0, 6.0),
+                    world_name=world,
+                )],
+            ))
+            drifter = uuid_mod.uuid4()
+            drifters.append((drifter, base))
+            await hub.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+                entities=[Entity(
+                    uuid=drifter if j == 0 else uuid_mod.uuid4(),
+                    position=Vector3(
+                        base + float(j % 4), 6.0 + float(j // 4), 6.0
+                    ),
+                    world_name=world,
+                    flex=(struct.pack("<3f", 0.3, 0.0, 0.0)
+                          if j == 0 else None),
+                ) for j in range(13)],
+            ))
+
+        oracle_v = ReplayClient()
+        oracles_b = [ReplayClient() for _ in bystanders]
+        victim_bytes = [0]
+        stop = asyncio.Event()
+
+        async def pump(peer, oracle, byte_sink=None):
+            # raw socket reads: the byte count must be the exact wire
+            # length the budget was charged for, not a re-serialize
+            while not stop.is_set():
+                try:
+                    data = await asyncio.wait_for(peer.pull.recv(), 0.25)
+                except asyncio.TimeoutError:
+                    continue
+                m = deserialize_message(data)
+                if (m.instruction == Instruction.LOCAL_MESSAGE
+                        and m.parameter
+                        and parse_stamp(m.parameter) is not None):
+                    if byte_sink is not None:
+                        byte_sink[0] += len(data)
+                    oracle.apply(m)
+
+        pumps = [asyncio.ensure_future(pump(victim, oracle_v, victim_bytes))]
+        for b, o in zip(bystanders, oracles_b):
+            pumps.append(asyncio.ensure_future(pump(b, o)))
+
+        mgr = ctx.server.interest
+        plane = ctx.server.entity_plane
+        try:
+            # first keyframes mark the stream (and the buckets) live
+            t_start = time.perf_counter()
+            deadline = t_start + 90.0
+            while (oracle_v.frames_applied < 1
+                   or any(o.frames_applied < 1 for o in oracles_b)):
+                if time.perf_counter() > deadline:
+                    raise AssertionError("first interest frames never landed")
+                await asyncio.sleep(0.05)
+
+            # the loaded window, sampling the demote ladder as it moves
+            ticks0 = plane.applied_ticks
+            max_demote = {"victim": 0, "bystander": 0, "any": 0}
+
+            def sample():
+                st_v = mgr._peers.get(victim.uuid)
+                if st_v is not None:
+                    max_demote["victim"] = max(
+                        max_demote["victim"], st_v.demote
+                    )
+                for b in bystanders:
+                    st_b = mgr._peers.get(b.uuid)
+                    if st_b is not None:
+                        max_demote["bystander"] = max(
+                            max_demote["bystander"], st_b.demote
+                        )
+                for st in mgr._peers.values():
+                    max_demote["any"] = max(max_demote["any"], st.demote)
+
+            end = time.perf_counter() + load_s
+            while time.perf_counter() < end:
+                sample()
+                await asyncio.sleep(0.02)
+            ticks_loaded = plane.applied_ticks - ticks0
+            st_v = mgr._peers.get(victim.uuid)
+            victim_deferrals = st_v.deferrals if st_v is not None else 0
+            bystander_deferrals = sum(
+                mgr._peers[b.uuid].deferrals
+                for b in bystanders if b.uuid in mgr._peers
+            )
+
+            # quiesce the swarm and the drifters; the victim's pending
+            # (deferred) diff must still land — losslessly, on cadence
+            await hub.send(Message(
+                instruction=Instruction.LOCAL_MESSAGE, world_name=world,
+                entities=[Entity(
+                    uuid=m, position=Vector3(*rng.uniform(6.0, 10.0, 3)),
+                    world_name=world,
+                    flex=struct.pack("<3f", 0.0, 0.0, 0.0),
+                ) for m in movers] + [Entity(
+                    uuid=d, position=Vector3(base, 7.0, 6.0),
+                    world_name=world,
+                    flex=struct.pack("<3f", 0.0, 0.0, 0.0),
+                ) for d, base in drifters],
+            ))
+
+            def ledger_of(peer):
+                st = mgr._peers.get(peer.uuid)
+                if st is None:
+                    return None
+                out = {}
+                for key, (_wid, pos_b) in st.state.items():
+                    x, y, z = np.frombuffer(pos_b, np.float32)
+                    out[uuid_mod.UUID(bytes=key)] = (
+                        float(x), float(y), float(z)
+                    )
+                return out
+
+            def converged(oracle, peer) -> bool:
+                ledger = ledger_of(peer)
+                return (ledger is not None
+                        and oracle.snapshot().get(world, {}) == ledger)
+
+            deadline = time.perf_counter() + (25.0 if ctx.smoke else 40.0)
+            while not (converged(oracle_v, victim) and all(
+                converged(o, b) for o, b in zip(oracles_b, bystanders)
+            )):
+                if time.perf_counter() > deadline:
+                    break
+                await asyncio.sleep(0.1)
+            sample()
+            victim_converged = converged(oracle_v, victim)
+            bystanders_converged = all(
+                converged(o, b) for o, b in zip(oracles_b, bystanders)
+            )
+            elapsed = time.perf_counter() - t_start
+        finally:
+            stop.set()
+            await asyncio.gather(*pumps, return_exceptions=True)
+
+        drained = await ctx.drain_ticker()
+        sv = oracle_v.stats()
+        sb = [o.stats() for o in oracles_b]
+        # token-bucket conservation: what actually hit the victim's
+        # wire can never exceed burst + rate x elapsed (one frame of
+        # slack for the read race at the window edge)
+        budget_cap = round(
+            mgr.bandwidth_burst + mgr.bandwidth_bytes * elapsed + 4096.0
+        )
+        return {
+            "movers": n_movers,
+            "ticks_loaded": ticks_loaded,
+            "victim_deferrals": victim_deferrals,
+            "victim_max_demote": max_demote["victim"],
+            "victim_refused": sv["deltas_refused"],
+            "victim_gaps": sv["gaps_seen"],
+            "victim_deltas": sv["deltas_applied"],
+            "victim_fulls": sv["fulls_applied"],
+            "victim_converged": victim_converged,
+            "victim_bytes": victim_bytes[0],
+            "victim_budget_cap": budget_cap,
+            "bystander_deferrals": bystander_deferrals,
+            "bystander_max_demote": max_demote["bystander"],
+            "bystander_refused": sum(s["deltas_refused"] for s in sb),
+            "bystander_gaps": sum(s["gaps_seen"] for s in sb),
+            "bystander_deltas": sum(s["deltas_applied"] for s in sb),
+            "bystanders_converged": bystanders_converged,
+            "any_max_demote": max_demote["any"],
+            "bytes_shed": mgr.bytes_shed,
+            "drained": drained,
+            "broker_answers": await ctx.heartbeat_ok(victim),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        return [
+            Check("victim_cadence_degraded",
+                  slo["victim_deferrals"] > 0,
+                  slo["victim_deferrals"], "> 0",
+                  "over-budget ticks became lossless deferrals, "
+                  "not truncated sends"),
+            Check("victim_walked_the_demote_ladder",
+                  slo["victim_max_demote"] >= 1,
+                  slo["victim_max_demote"], ">= 1 (far-tier demotion)"),
+            Check("victim_correctness_intact",
+                  slo["victim_refused"] == 0 and slo["victim_gaps"] == 0,
+                  (slo["victim_refused"], slo["victim_gaps"]), (0, 0),
+                  "throttling never produced an unappliable delta or "
+                  "a sequence gap"),
+            Check("victim_converged_to_server_ledger",
+                  slo["victim_converged"],
+                  slo["victim_converged"], True,
+                  "after quiesce the oracle equals the server's own "
+                  "per-peer ledger"),
+            Check("victim_bytes_within_budget",
+                  slo["victim_bytes"] <= slo["victim_budget_cap"],
+                  slo["victim_bytes"], f"<= {slo['victim_budget_cap']}",
+                  "token-bucket conservation on the actual wire bytes"),
+            Check("bystanders_never_deferred",
+                  slo["bystander_deferrals"] == 0
+                  and slo["bystander_max_demote"] == 0,
+                  (slo["bystander_deferrals"], slo["bystander_max_demote"]),
+                  (0, 0)),
+            Check("bystander_stream_full_rate",
+                  slo["bystander_deltas"] > 0
+                  and slo["bystander_refused"] == 0
+                  and slo["bystander_gaps"] == 0,
+                  (slo["bystander_deltas"], slo["bystander_refused"],
+                   slo["bystander_gaps"]),
+                  ("> 0", 0, 0)),
+            Check("bystanders_converged_to_server_ledger",
+                  slo["bystanders_converged"],
+                  slo["bystanders_converged"], True),
+            Check("shed_only_at_ladder_bottom",
+                  slo["bytes_shed"] == 0 or slo["any_max_demote"] == 2,
+                  (slo["bytes_shed"], slo["any_max_demote"]),
+                  "shed 0, or some peer at keyframe-only first",
+                  "bytes_shed counts ONLY once cadence demotion is "
+                  "exhausted"),
+            Check("queue_drained", slo["drained"], slo["drained"], True),
+            Check("broker_answers_after_throttle",
+                  slo["broker_answers"], slo["broker_answers"], True),
+        ]
