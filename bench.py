@@ -4181,13 +4181,274 @@ def bench_config13(args) -> dict:
     }
 
 
+async def _reshard_run(window_s: float) -> dict:
+    """One live-resharding run: boot a 2-shard cluster, home a hot
+    world on shard 0 with a cross-shard subscriber, keep LocalMessage
+    + record traffic flowing, migrate the world to shard 1 mid-stream,
+    and close the books: per-state wall times (harness-polled state
+    transitions), the longest delivery gap the subscriber saw across
+    the freeze window, parked/replayed/shed counts from the transfer
+    buffer, and the zero-loss audit (every record offered before,
+    during and after the migration reads back from the new owner)."""
+    import uuid as uuid_mod
+
+    from worldql_server_tpu.cluster import ClusterRuntime, WorldMap
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.protocol.types import (
+        Instruction as Ins, Message as Msg, Record as Rec, Vector3 as V3,
+    )
+    from worldql_server_tpu.scenarios.client import ZmqPeer, free_port_block
+
+    config = Config(
+        store_url="memory://",
+        http_enabled=False, ws_enabled=False,
+        zmq_server_host="127.0.0.1",
+        zmq_server_port=free_port_block(3),
+        spatial_backend="cpu", tick_interval=0.02,
+        overload="on",
+        supervisor_backoff=0.005,
+        cluster_shards=2,
+    )
+    world_map = WorldMap(2)
+    world = next(
+        f"hot{i}" for i in range(10_000)
+        if world_map.shard_of_world(f"hot{i}") == 0
+    )
+    pos = V3(5.0, 5.0, 5.0)
+    runtime = ClusterRuntime(config)
+    await runtime.start()
+    clients: list[ZmqPeer] = []
+    try:
+        async def connect(**kw) -> ZmqPeer:
+            last = None
+            for _ in range(100):
+                try:
+                    peer = await ZmqPeer.connect(
+                        config.zmq_server_port, **kw
+                    )
+                    clients.append(peer)
+                    return peer
+                except Exception as exc:
+                    last = exc
+                    await asyncio.sleep(0.05)
+            raise AssertionError(f"bench client connect failed: {last!r}")
+
+        router = runtime.router
+
+        def uuid_for(shard: int) -> uuid_mod.UUID:
+            while True:
+                u = uuid_mod.uuid4()
+                if world_map.shard_of_peer(u) == shard:
+                    return u
+
+        # subscriber homed on the DESTINATION shard: its deliveries
+        # ride the ring before the flip and stay local after it
+        rx = await connect(peer_uuid=uuid_for(1))
+        tx = await connect(peer_uuid=uuid_for(0))
+        await rx.send(Msg(
+            instruction=Ins.AREA_SUBSCRIBE, world_name=world,
+            position=pos,
+        ))
+        await asyncio.sleep(0.3)
+
+        want: set = set()
+
+        async def put_record(tag: str) -> None:
+            rec = uuid_mod.uuid4()
+            await tx.send(Msg(
+                instruction=Ins.RECORD_CREATE, world_name=world,
+                records=[Rec(uuid=rec, position=pos, world_name=world,
+                             data=tag)],
+            ))
+            want.add(rec)
+
+        for i in range(50):
+            await put_record(f"pre{i}")
+
+        stop = asyncio.Event()
+        offered_locals = 0
+        arrivals: list[float] = []
+
+        async def traffic() -> None:
+            nonlocal offered_locals
+            n = 0
+            while not stop.is_set():
+                await tx.send(Msg(
+                    instruction=Ins.LOCAL_MESSAGE, world_name=world,
+                    position=pos, parameter="load",
+                ))
+                offered_locals += 1
+                n += 1
+                if n % 4 == 0:
+                    await put_record(f"mid{n}")
+                # paced fast relative to the ~10ms migration so the
+                # freeze window reliably parks frames (replayed > 0
+                # is a smoke gate, not a coincidence)
+                await asyncio.sleep(0.002)
+
+        async def receiver() -> None:
+            while True:
+                got = await rx.recv(30)
+                if got.instruction == Ins.LOCAL_MESSAGE:
+                    arrivals.append(time.perf_counter())
+
+        traffic_task = asyncio.ensure_future(traffic())
+        receiver_task = asyncio.ensure_future(receiver())
+        state_at: dict[str, float] = {}
+        try:
+            await asyncio.sleep(window_s)
+
+            t_start = time.perf_counter()
+            xfer = router.start_reshard(world, 1, reason="bench")
+            assert xfer is not None, "reshard refused"
+            while router.migration.state not in ("done", "aborted"):
+                state_at.setdefault(
+                    router.migration.state, time.perf_counter()
+                )
+                await asyncio.sleep(0.001)
+            state_at.setdefault(
+                router.migration.state, time.perf_counter()
+            )
+            migration_ms = (time.perf_counter() - t_start) * 1e3
+
+            await asyncio.sleep(window_s)  # post-flip delivery window
+            stop.set()
+            await traffic_task
+        finally:
+            stop.set()
+            for task in (traffic_task, receiver_task):
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        mig = router.migration
+        # zero-loss audit: every offered record reads back through the
+        # router from the NEW owner (retry: creates are async)
+        seen: set = set()
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline and not want <= seen:
+            await tx.send(Msg(
+                instruction=Ins.RECORD_READ, world_name=world,
+                position=pos,
+            ))
+            try:
+                reply = await tx.recv_until(Ins.RECORD_REPLY, 5)
+            except asyncio.TimeoutError:
+                continue
+            seen |= {r.uuid for r in reply.records}
+        lost = len(want - seen)
+
+        # per-state wall times from the first-seen transition stamps
+        order = [s for s in (
+            "freeze", "streaming", "importing", "flipping",
+            "replaying", "tombstoning", "done", "aborted",
+        ) if s in state_at]
+        state_ms = {
+            a: round((state_at[b] - state_at[a]) * 1e3, 2)
+            for a, b in zip(order, order[1:])
+        }
+        # the longest gap between consecutive subscriber deliveries
+        # that overlaps the migration — the freeze-window pause
+        pause_ms = 0.0
+        for a, b in zip(arrivals, arrivals[1:]):
+            if b >= t_start and a <= t_start + migration_ms / 1e3:
+                pause_ms = max(pause_ms, (b - a) * 1e3)
+        post_flip = sum(1 for t in arrivals if t > state_at[order[-1]])
+
+        return {
+            "state": mig.state,
+            "lost_records": lost,
+            "records_offered": len(want),
+            "buffer": mig.buffer.stats(),
+            "replayed": mig.replayed,
+            "rerouted": runtime.metrics.snapshot()["counters"].get(
+                "cluster.router_reroutes", 0
+            ),
+            "epoch": router.world_map.epoch,
+            "owner": router.world_map.shard_of_world(world),
+            "offered_locals": offered_locals,
+            "delivered_locals": len(arrivals),
+            "delivered_post_flip": post_flip,
+            "migration_ms": round(migration_ms, 2),
+            "state_ms": state_ms,
+            "delivery_pause_ms": round(pause_ms, 2),
+        }
+    finally:
+        for client in clients:
+            try:
+                client.close()
+            except Exception:
+                pass
+        await runtime.stop()
+
+
+def bench_config14(args) -> dict:
+    """Live resharding under load (ISSUE 19): migrate a hot world
+    between two real shard subprocesses while LocalMessage + record
+    traffic flows, and report the migration wall time split by
+    protocol state, the longest delivery gap a cross-shard subscriber
+    saw across the freeze window, the transfer-buffer park/replay/shed
+    books, and the zero-loss audit. ``--smoke`` asserts the migration
+    COMPLETED, no record was lost, the freeze window actually parked
+    and replayed traffic, nothing was shed, and delivery resumed on
+    the new owner after the flip. The gate leaves are the counts
+    (``lost_records`` / ``shed`` / ``aborted``); the wall times are
+    1-core-box noise and pruned from the checked-in baseline."""
+    window_s = 0.4 if args.quick else 1.5
+    log(f"resharding: 2 shards, {window_s}s load windows...")
+    run = asyncio.run(_reshard_run(window_s))
+    log(
+        f"  migration {run['state']} in {run['migration_ms']} ms "
+        f"(states {run['state_ms']}), parked "
+        f"{run['buffer']['parked_frames']} -> replayed "
+        f"{run['replayed']}, shed {run['buffer']['shed']}, rerouted "
+        f"{run['rerouted']}, pause {run['delivery_pause_ms']} ms, "
+        f"records {run['records_offered'] - run['lost_records']}/"
+        f"{run['records_offered']}, epoch {run['epoch']}, owner "
+        f"shard {run['owner']}"
+    )
+    aborted = 1 if run["state"] != "done" else 0
+    if args.smoke:
+        assert aborted == 0, f"smoke: migration did not complete: {run}"
+        assert run["lost_records"] == 0, (
+            f"smoke: records lost across the migration: {run}"
+        )
+        assert run["replayed"] > 0, (
+            "smoke: the freeze window never parked+replayed traffic — "
+            "the migration raced no load"
+        )
+        assert run["buffer"]["shed"] == 0, (
+            f"smoke: transfer buffer shed under bench load: {run}"
+        )
+        assert run["owner"] == 1 and run["epoch"] >= 1, (
+            f"smoke: placement never flipped: {run}"
+        )
+        assert run["delivered_post_flip"] > 0, (
+            "smoke: no delivery observed on the new owner post-flip"
+        )
+        log("smoke: migration done, zero loss, freeze window "
+            "parked+replayed, nothing shed, delivery resumed post-flip")
+    return {
+        "metric": "reshard_lost_records",
+        "value": run["lost_records"],
+        "unit": "count",
+        "lost_records": run["lost_records"],
+        "reshard_aborted": aborted,
+        "reshard": run,
+        "config": 14,
+    }
+
+
 # --------------------------------------------------------------------
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
+                             14],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -4210,7 +4471,12 @@ def main() -> None:
                          "13 = interest-managed fan-out (delivered "
                          "bytes/tick --interest off vs on at the "
                          "game_tick shape over real ZMQ, replay-"
-                         "oracle parity, ISSUE 18 5x acceptance)")
+                         "oracle parity, ISSUE 18 5x acceptance); "
+                         "14 = live resharding (migrate a hot world "
+                         "between shard processes under load: "
+                         "per-state wall times, freeze-window "
+                         "delivery pause, park/replay/shed books, "
+                         "zero-loss audit)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -4250,14 +4516,14 @@ def main() -> None:
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
         10: bench_config10, 11: bench_config11, 12: bench_config12,
-        13: bench_config13,
+        13: bench_config13, 14: bench_config14,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12, 13]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12, 13, 14]
     else:
         selected = [args.config or 5]
     for n in selected:

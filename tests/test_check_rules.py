@@ -828,6 +828,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
     assert names == {
         "async-dangling-task",
         "blocking-cross-shard",
+        "epochless-forward",
         "untraced-forward",
         "unbounded-ingest",
         "unguarded-handshake",
@@ -2217,3 +2218,88 @@ def test_unsequenced_frame_honors_pragma():
 
 
 # endregion
+
+
+# region: epochless-forward (ISSUE 19)
+
+
+def test_epochless_forward_fires_on_v1_wrap_in_router():
+    src = """
+    class ClusterRouter:
+        def _forward(self, shard, data, ctx):
+            self._push[shard].send(
+                tracectx.wrap(data, ctx[0], ctx[1]), flags=NOBLOCK
+            )
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="epochless-forward") == [
+        ("epochless-forward", 5),
+    ]
+
+
+def test_epochless_forward_fires_on_dropped_or_zero_epoch():
+    src = """
+    class ClusterRouter:
+        def _forward(self, shard, data, ctx):
+            self._push[shard].send(
+                tracectx.wrap_epoch(data, ctx[0], ctx[1]),
+                flags=NOBLOCK,
+            )
+
+        def send_fence(self, shard, xfer_id, ctx):
+            self._push[shard].send(
+                tracectx.wrap_epoch(payload, ctx[0], ctx[1], 0),
+                flags=NOBLOCK,
+            )
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="epochless-forward") == [
+        ("epochless-forward", 5), ("epochless-forward", 11),
+    ]
+
+
+def test_epochless_forward_quiet_when_epoch_threads_through():
+    src = """
+    class ClusterRouter:
+        def _forward(self, shard, data, ctx):
+            self._push[shard].send(
+                tracectx.wrap_epoch(data, ctx[0], ctx[1], ctx[2]),
+                flags=NOBLOCK,
+            )
+
+        def send_fence(self, shard, xfer_id):
+            payload = fence_payload(xfer_id)
+            self._push[shard].send(
+                tracectx.wrap_epoch(
+                    payload, tracectx.new_trace_id(),
+                    time.monotonic_ns(),
+                    epoch=self.world_map.epoch,
+                ),
+                flags=NOBLOCK,
+            )
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="epochless-forward") == []
+
+
+def test_epochless_forward_honors_pragma_and_scope():
+    src = """
+    class ClusterRouter:
+        def _replay_wal(self, shard, data):
+            self._push[shard].send(
+                tracectx.wrap(data, 0, 0),  # wql: allow(epochless-forward)
+            )
+    """
+    assert violations(src, relpath=CLUSTER_ROUTER_PATH,
+                      select="epochless-forward") == []
+    # the shard only ever UNWRAPS — wrap calls elsewhere are out of
+    # this rule's scope
+    src2 = """
+    class Replayer:
+        def reframe(self, data):
+            return tracectx.wrap(data, 0, 0)
+    """
+    assert violations(
+        src2, relpath="worldql_server_tpu/cluster/shard.py",
+        select="epochless-forward",
+    ) == []

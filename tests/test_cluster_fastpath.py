@@ -18,6 +18,7 @@ import pytest
 
 from tests.client_util import ZmqClient, free_port
 from worldql_server_tpu.cluster import tracectx
+from worldql_server_tpu.cluster.resharding import FENCE_MAGIC
 from worldql_server_tpu.engine.config import Config
 from worldql_server_tpu.engine.server import WorldQLServer
 from worldql_server_tpu.protocol import (
@@ -78,10 +79,19 @@ class _ShardStub:
 
     Installed AFTER server.start(), so the ticker (which captured
     cluster=None at construction) never drains through it — only the
-    recv loop's unwrap hook and the peer-teardown hook are live,
-    which is exactly the surface under test."""
+    recv loop's unwrap/fence/staleness hooks and the peer-teardown
+    hook are live, which is exactly the surface under test. Mirrors
+    ClusterShardExtension: epoch-aware unwrap (v1/bare frames decode
+    as epoch 0), no fences in flight, nothing ever stale."""
 
-    unwrap = staticmethod(tracectx.unwrap)
+    unwrap = staticmethod(tracectx.unwrap_epoch)
+    FENCE_MAGIC = FENCE_MAGIC
+
+    def frame_stale(self, epoch: int) -> bool:
+        return False
+
+    def on_fence(self, payload: bytes) -> None:
+        raise AssertionError("no fence frames in this test")
 
     def on_peer_torn_down(self, peer_uuid) -> None:
         pass

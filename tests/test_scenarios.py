@@ -23,15 +23,19 @@ def test_catalog_names():
         "flash_crowd", "battle_royale", "reconnect_storm", "game_tick",
         "reconnect_storm_replay", "cluster_flash_crowd",
         "sniper_scope", "projectile_storm", "bandwidth_cap",
+        "mega_city", "rolling_restart",
     }
     # the replay-storm variant is catalogued but NOT CI-smoke-blocking;
-    # the cluster variant spawns shard subprocesses and runs in its
+    # the cluster variants spawn shard subprocesses and run in their
     # own "Cluster smoke" CI step instead of the default set
-    assert CATALOG["reconnect_storm_replay"].ci_smoke is False
-    assert CATALOG["cluster_flash_crowd"].ci_smoke is False
+    cluster_side = {
+        "reconnect_storm_replay", "cluster_flash_crowd",
+        "mega_city", "rolling_restart",
+    }
+    for name in cluster_side:
+        assert CATALOG[name].ci_smoke is False
     assert all(
-        CATALOG[n].ci_smoke for n in CATALOG
-        if n not in ("reconnect_storm_replay", "cluster_flash_crowd")
+        CATALOG[n].ci_smoke for n in CATALOG if n not in cluster_side
     )
 
 

@@ -138,13 +138,15 @@ def enclosing_functions(tree: ast.Module):
 def all_rules() -> list[Rule]:
     from . import (
         rules_async, rules_cluster, rules_delivery, rules_ingest,
-        rules_interest, rules_jax, rules_obs, rules_store, rules_wire,
+        rules_interest, rules_jax, rules_obs, rules_resharding,
+        rules_store, rules_wire,
     )
 
     return [
         *rules_async.RULES, *rules_cluster.RULES, *rules_delivery.RULES,
         *rules_ingest.RULES, *rules_interest.RULES, *rules_jax.RULES,
-        *rules_obs.RULES, *rules_store.RULES, *rules_wire.RULES,
+        *rules_obs.RULES, *rules_resharding.RULES, *rules_store.RULES,
+        *rules_wire.RULES,
     ]
 
 

@@ -1,0 +1,112 @@
+"""Resharding safety rule (ISSUE 19): every router forward stamps the
+placement epoch.
+
+Live resharding's zero-loss argument leans on the epoch stamp: a frame
+forwarded under an OLDER placement map that lands on a shard which no
+longer owns its world must be detected (``frame_stale``) and re-routed
+instead of misapplied against tombstoned state. Detection only works
+if the router stamps the CURRENT epoch on every forward — one
+forwarding site still on the v1 (epoch-less) wrapper, or one
+``wrap_epoch`` call that drops or zeroes the epoch argument, silently
+re-opens the lost-update window a flip is supposed to close. The frame
+still arrives and nothing functional fails until a migration races the
+push backlog — exactly why a lint rule (not a test) has to guard it.
+
+Scope: ``cluster/router.py`` (the only process that stamps epochs —
+shards and transports only ever UNWRAP). Three shapes fail:
+
+* ``tracectx.wrap(...)`` — the v1 prefix has no epoch field; router
+  forwards must use :func:`~worldql_server_tpu.cluster.tracectx.wrap_epoch`.
+* ``wrap_epoch(...)`` with fewer than four arguments — the epoch was
+  dropped on the floor.
+* ``wrap_epoch(..., 0)`` / ``wrap_epoch(..., epoch=0)`` — a literal
+  zero epoch is the "no placement claim" sentinel; stamping it on a
+  router forward disables staleness detection for that frame.
+
+Suppress a deliberate case with ``# wql: allow(epochless-forward)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import FileContext, Rule, Violation
+
+_ROUTER_SCOPED = ("cluster/router.py",)
+
+
+def _chain_mentions(node: ast.AST, token: str) -> bool:
+    for sub in ast.walk(node):
+        name = (
+            sub.id if isinstance(sub, ast.Name)
+            else sub.attr if isinstance(sub, ast.Attribute) else None
+        )
+        if name is not None and token in name.lower():
+            return True
+    return False
+
+
+def _epoch_arg(call: ast.Call) -> ast.AST | None:
+    """The expression passed as ``wrap_epoch``'s epoch parameter
+    (4th positional or the ``epoch=`` keyword), or None if absent."""
+    for kw in call.keywords:
+        if kw.arg == "epoch":
+            return kw.value
+    if len(call.args) >= 4:
+        return call.args[3]
+    return None
+
+
+def _check_epochless_forward(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_ROUTER_SCOPED):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        leaf = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else None
+        )
+        if leaf == "wrap" and isinstance(func, ast.Attribute) \
+                and _chain_mentions(func.value, "tracectx"):
+            yield from ctx.flag(
+                EPOCHLESS_FORWARD, node,
+                "`tracectx.wrap(...)` in the router — the v1 prefix "
+                "carries no placement epoch, so a shard receiving this "
+                "frame across a migration flip cannot tell it was "
+                "routed under the OLD map; forward with "
+                "`tracectx.wrap_epoch(data, trace_id, t_ingress, "
+                "epoch)`",
+            )
+            continue
+        if leaf != "wrap_epoch":
+            continue
+        epoch = _epoch_arg(node)
+        if epoch is None:
+            yield from ctx.flag(
+                EPOCHLESS_FORWARD, node,
+                "`wrap_epoch(...)` without the epoch argument — the "
+                "stamp this wrapper exists for was dropped; pass the "
+                "routing ctx's epoch (ctx[2] / placement.epoch)",
+            )
+        elif isinstance(epoch, ast.Constant) and epoch.value == 0:
+            yield from ctx.flag(
+                EPOCHLESS_FORWARD, node,
+                "`wrap_epoch(..., 0)` stamps the 'no placement claim' "
+                "sentinel on a router forward — staleness detection "
+                "is disabled for this frame across a migration flip; "
+                "stamp the CURRENT epoch (ctx[2] / placement.epoch)",
+            )
+
+
+EPOCHLESS_FORWARD = Rule(
+    "epochless-forward",
+    "router forwards must stamp the current placement epoch "
+    "(wrap_epoch with a real epoch) — an epoch-less forward re-opens "
+    "the stale-frame lost-update window across a migration flip",
+    _check_epochless_forward,
+)
+
+RULES = [EPOCHLESS_FORWARD]

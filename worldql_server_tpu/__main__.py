@@ -288,6 +288,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "--cluster-shards N) or 'shard' (spawned by the "
                         "router-tier supervisor; requires the "
                         "WQL_CLUSTER_SPEC topology env)")
+    p.add_argument("--autoshard", choices=["off", "on"],
+                   dest="cluster_autoshard",
+                   help="live resharding: 'on' arms the router-side "
+                        "autoshard controller (watches federated "
+                        "per-shard overload state, migrates the "
+                        "hottest world off a sustained-hot shard); "
+                        "'off' (default) keeps migrations manual via "
+                        "POST /reshard")
+    p.add_argument("--reshard-buffer-bytes", type=int,
+                   dest="reshard_buffer_bytes",
+                   help="byte budget for a migrating world's router-"
+                        "side transfer buffer; overflow frames are "
+                        "shed and counted, never silently lost "
+                        "(default 8 MiB)")
     p.add_argument("--interest", choices=["off", "on"],
                    help="interest-managed fan-out: per-recipient "
                         "delta frames under a stamped epoch:seq wire "
@@ -349,7 +363,8 @@ _OVERRIDES = [
     "overload_evict_after", "overload_rss_limit_mb",
     "session_ttl", "session_resume_rate",
     "delta_ticks", "delta_rebuild_threshold",
-    "cluster_shards", "cluster_role",
+    "cluster_shards", "cluster_role", "cluster_autoshard",
+    "reshard_buffer_bytes",
     "interest", "lod_near_radius", "lod_far_every_k",
     "peer_bandwidth_bytes",
 ]

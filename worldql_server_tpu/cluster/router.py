@@ -65,8 +65,13 @@ from ..protocol import (
 from ..utils.names import GLOBAL_WORLD  # noqa: F401  (routing contract doc)
 from . import tracectx
 from .federation import MetricsFederation
+from .resharding import (
+    AutoshardController,
+    MigrationCoordinator,
+    PlacementMap,
+    fence_payload,
+)
 from .supervisor import ClusterSupervisor, shard_zmq_port
-from .world_map import WorldMap
 
 logger = logging.getLogger(__name__)
 
@@ -114,7 +119,9 @@ class ClusterRouter:
         self.config = config
         self.supervisor = supervisor
         self.n_shards = supervisor.n_shards
-        self.world_map = WorldMap(self.n_shards)
+        # the epoch-versioned placement document (live resharding):
+        # at epoch 0 with no overrides it IS the stable WorldMap hash
+        self.world_map = PlacementMap(self.n_shards)
         self.metrics = metrics if metrics is not None else Metrics()
         self.mirror = ShedMirror(self.n_shards)
         self.ctx = zmq.asyncio.Context()
@@ -150,6 +157,21 @@ class ClusterRouter:
         #: in-flight /debug/cluster dump collections: req_id → slot
         self._dump_reqs: dict[int, dict] = {}
         self._dump_seq = 0
+        # Live resharding (ISSUE 19): at most one migration in flight;
+        # its coordinator intercepts the moving world's traffic into a
+        # bounded transfer buffer until the epoch flips.
+        self.migration: MigrationCoordinator | None = None
+        self._migration_task: asyncio.Task | None = None
+        self._xfer_seq = 0
+        self.resharded = 0
+        #: tombstones owed to a shard that was down when its migration
+        #: completed: shard → {xfer: world}, re-issued on every ready
+        self._pending_tombstones: dict[int, dict[int, str]] = {}
+        #: decayed per-world forward counts — the autoshard
+        #: controller's hottest-world signal
+        self._world_load: dict[str, float] = {}
+        self.autoshard = AutoshardController(self)
+        self._autoshard_task: asyncio.Task | None = None
         self.metrics.gauge("cluster", self.status)
         self.metrics.gauge("cluster_federation", self.federation.stats)
         self.metrics.gauge(
@@ -181,12 +203,24 @@ class ClusterRouter:
         )
         if config.http_enabled:
             await self._start_http()
+        if getattr(config, "cluster_autoshard", "off") == "on":
+            self._autoshard_task = asyncio.create_task(  # wql: allow(unsupervised-task) — poll loop contains its own errors; cancelled in stop()
+                self.autoshard.run(), name="cluster-autoshard"
+            )
         logger.info(
             "cluster router listening on %s:%s, %d shards behind it",
             config.zmq_server_host, config.zmq_server_port, self.n_shards,
         )
 
     async def stop(self) -> None:
+        for task in (self._autoshard_task, self._migration_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._autoshard_task = self._migration_task = None
         if self._recv_task is not None:
             self._recv_task.cancel()
             try:
@@ -216,13 +250,45 @@ class ClusterRouter:
         if op == "state":
             self.mirror.note_state(shard, msg)
             self.federation.ingest(shard, msg)
+            # placement convergence via the ~1s state packets: a shard
+            # reporting an older epoch (missed a flip broadcast, or
+            # restarted) gets the current document re-pushed — every
+            # process converges with no external coordinator
+            try:
+                reported = int(msg.get("placement_epoch", 0))
+            except (TypeError, ValueError):
+                reported = 0
+            if reported < self.world_map.epoch:
+                self.supervisor.ctl_send(shard, {
+                    "op": "placement", "spec": self.world_map.to_spec(),
+                })
         elif op == "dump_chunk":
             self._note_dump_chunk(msg)
+        elif op == "reroute":
+            self._note_reroute(shard, msg)
+        elif op == "fence_ack":
+            if self.migration is not None:
+                self.migration.on_fence_ack(shard, msg)
+        elif op == "reshard_chunk":
+            if self.migration is not None:
+                self.migration.on_chunk(shard, msg)
+        elif op == "reshard_imported":
+            if self.migration is not None:
+                self.migration.on_import_ack(shard, msg)
+        elif op == "reshard_tombstoned":
+            try:
+                xfer = int(msg.get("xfer", -1))
+            except (TypeError, ValueError):
+                xfer = -1
+            self._pending_tombstones.get(shard, {}).pop(xfer, None)
+            if self.migration is not None:
+                self.migration.on_tombstone_ack(shard, msg)
         elif op == "peer_gone":
             try:
                 peer = uuid_mod.UUID(hex=msg["uuid"])
             except (KeyError, ValueError):
                 return
+            self.world_map.clear_peer(peer)
             if self._peers.pop(peer, None) is not None:
                 for i in range(self.n_shards):
                     if i != shard:
@@ -239,6 +305,24 @@ class ClusterRouter:
         # re-baseline from zero, so merged series only ever grow
         self.federation.reset(shard)
         self.federation.note_pid(shard, self.supervisor.shard_pid(shard))
+        # placement replay: a restarted shard boots at epoch 0 — it
+        # must learn every override BEFORE serving, or it would apply
+        # frames for worlds it no longer owns
+        if self.world_map.epoch > 0:
+            self.supervisor.ctl_send(shard, {
+                "op": "placement", "spec": self.world_map.to_spec(),
+            })
+        # a source shard that died before acking its tombstone comes
+        # back holding a WAL copy of a world it no longer owns: the
+        # re-issued tombstone deletes it through that same WAL
+        for xfer, world in list(
+            self._pending_tombstones.get(shard, {}).items()
+        ):
+            self.supervisor.ctl_send(shard, {
+                "op": "reshard_tombstone", "xfer": xfer, "world": world,
+            })
+        if self.migration is not None:
+            self.migration.on_shard_ready(shard)
         for peer, home in self._peers.items():
             if home != shard:
                 self.supervisor.ctl_send(
@@ -250,6 +334,8 @@ class ClusterRouter:
         drop their proxies cluster-wide and forget them — the clients
         reconnect through the router and re-adopt."""
         self.mirror.reset(shard)
+        if self.migration is not None:
+            self.migration.on_shard_down(shard)
         gone = [u for u, h in self._peers.items() if h == shard]
         for peer in gone:
             del self._peers[peer]
@@ -298,6 +384,9 @@ class ClusterRouter:
         instruction = message.instruction
         if instruction in _WORLD_ROUTED:
             shard = self.world_map.shard_of_world(message.world_name)
+            self._world_load[message.world_name] = (
+                self._world_load.get(message.world_name, 0.0) + 1.0
+            )
         elif instruction in (Instruction.HANDSHAKE, Instruction.HEARTBEAT):
             shard = self.world_map.shard_of_peer(message.sender_uuid)
         else:
@@ -305,11 +394,27 @@ class ClusterRouter:
             # would only log-and-drop them anyway
             self.metrics.inc("cluster.router_dropped_unroutable")
             return
+        # Live resharding interception: a migrating world's traffic
+        # (and its migrated parked peers' resume handshakes) parks in
+        # the bounded transfer buffer for post-flip replay in arrival
+        # order — overflow is shed AND counted, never silently lost.
+        mig = self.migration
+        if mig is not None and mig.should_park(
+            instruction, message.world_name, message.sender_uuid
+        ):
+            if mig.buffer.park(data):
+                self.metrics.inc("cluster.reshard_parked")
+            else:
+                self.metrics.inc("cluster.reshard_buffer_shed")
+            return
         if not self._admit(message, instruction, shard):
             return
         if instruction == Instruction.HANDSHAKE:
             self._note_handshake(message.sender_uuid, shard)
-        ctx = (tracectx.new_trace_id(self._trace_rng), t_ingress_ns)
+        ctx = (
+            tracectx.new_trace_id(self._trace_rng), t_ingress_ns,
+            self.world_map.epoch,
+        )
         payload = message.wire if message.wire is not None else data
         if self.tracer.enabled:
             with self.tracer.span(
@@ -354,15 +459,17 @@ class ClusterRouter:
         return True
 
     def _forward(self, shard: int, data: bytes, ctx: tuple) -> None:
-        """Non-blocking forward, trace context framed on (``ctx`` is
-        ``(trace_id, t_ingress_ns)`` — the ``untraced-forward`` lint
-        rule keeps every forwarding site threading it). A full push
-        queue (shard mid-restart past the 100K backlog) drops +
-        counts — the router's recv loop must never wedge on one dead
-        shard while the others serve."""
+        """Non-blocking forward, trace context + placement epoch
+        framed on (``ctx`` is ``(trace_id, t_ingress_ns, epoch)`` —
+        the ``untraced-forward`` and ``epochless-forward`` lint rules
+        keep every forwarding site threading both). A full push queue
+        (shard mid-restart past the 100K backlog) drops + counts —
+        the router's recv loop must never wedge on one dead shard
+        while the others serve."""
         try:
             self._push[shard].send(
-                tracectx.wrap(data, ctx[0], ctx[1]), flags=zmq.NOBLOCK
+                tracectx.wrap_epoch(data, ctx[0], ctx[1], ctx[2]),
+                flags=zmq.NOBLOCK,
             )
             self.forwarded += 1
             self.metrics.inc("cluster.router_forwarded")
@@ -379,6 +486,142 @@ class ClusterRouter:
                 self.supervisor.ctl_send(
                     i, {"op": "adopt", "uuid": peer.hex, "home": home}
                 )
+
+    # region: live resharding (cluster/resharding)
+
+    def route_replay(self, data: bytes) -> None:
+        """Post-flip transfer-buffer replay: each parked frame
+        re-enters ``_route`` — re-decoded, re-admitted, stamped with
+        the NEW epoch, landing on the new owner in arrival order."""
+        try:
+            self._route(data)
+        except Exception:
+            self.metrics.inc("cluster.router_recv_errors")
+            logger.exception("error replaying parked frame — dropped")
+
+    def send_fence(self, shard: int, xfer_id: int) -> bool:
+        """Push the freeze fence through the DATA path: the shard's
+        PULL is FIFO and processing is in-order, so the fence's
+        control ack proves every earlier frame for the frozen world
+        was already processed (and is therefore in the capsule)."""
+        ctx = (
+            tracectx.new_trace_id(self._trace_rng), time.monotonic_ns(),
+            self.world_map.epoch,
+        )
+        try:
+            self._push[shard].send(
+                tracectx.wrap_epoch(
+                    fence_payload(xfer_id), ctx[0], ctx[1], ctx[2]
+                ),
+                flags=zmq.NOBLOCK,
+            )
+            return True
+        except zmq.Again:
+            return False
+
+    def _note_reroute(self, shard: int, msg: dict) -> None:
+        """A shard rejected a stale-epoch frame for a world it no
+        longer owns and bounced the wire bytes back: re-route under
+        the CURRENT placement (one hop, re-stamped epoch) instead of
+        misapplying or dropping."""
+        import base64
+
+        try:
+            data = base64.b64decode(msg["data"])
+        except (KeyError, TypeError, ValueError):
+            return
+        self.metrics.inc("cluster.router_reroutes")
+        self.route_replay(data)
+
+    def broadcast_placement(self) -> None:
+        """Push the placement document to every live shard (the flip
+        path); stragglers converge via the epoch check on their ~1s
+        state packets."""
+        spec = self.world_map.to_spec()
+        for i in range(self.n_shards):
+            self.supervisor.ctl_send(i, {"op": "placement", "spec": spec})
+
+    def queue_tombstone(self, shard: int, world: str, xfer: int) -> None:
+        """Issue (and remember) a tombstone: re-sent on every ready of
+        ``shard`` until its ack arrives, so a source SIGKILLed at any
+        point after the flip still deletes its stale WAL copy."""
+        self._pending_tombstones.setdefault(shard, {})[xfer] = world
+        self.supervisor.ctl_send(shard, {
+            "op": "reshard_tombstone", "xfer": xfer, "world": world,
+        })
+
+    def start_reshard(self, world: str, target: int,
+                      reason: str = "manual") -> int | None:
+        """Begin migrating ``world`` to ``target``. Returns the xfer
+        id, or None when refused (already where it belongs, shard out
+        of range, or a migration is already in flight)."""
+        if not 0 <= target < self.n_shards:
+            return None
+        if self.migration is not None and self.migration.active:
+            return None
+        source = self.world_map.shard_of_world(world)
+        if source == target:
+            return None
+        self._xfer_seq += 1
+        self._xfer_seq %= 1 << 31
+        xfer = self._xfer_seq
+        coordinator = MigrationCoordinator(
+            self, world, source, target, xfer,
+            getattr(self.config, "reshard_buffer_bytes", 8 << 20),
+        )
+        # interception must be live BEFORE the fence goes out: every
+        # frame between now and the flip parks (or sheds, counted)
+        self.migration = coordinator
+        coordinator.state = "freeze"
+        logger.warning(
+            "reshard %d (%s): migrating world %r from shard %d to %d",
+            xfer, reason, world, source, target,
+        )
+        self.metrics.inc("cluster.reshard_started")
+        self._migration_task = asyncio.get_running_loop().create_task(  # wql: allow(unsupervised-task) — run() contains its own abort path; cancelled in stop()
+            self._run_migration(coordinator),
+            name=f"cluster-reshard-{xfer}",
+        )
+        return xfer
+
+    async def _run_migration(self, coordinator: MigrationCoordinator
+                             ) -> None:
+        try:
+            if await coordinator.run():
+                self.resharded += 1
+        finally:
+            if self.migration is coordinator:
+                # keep the coordinator for describe(); interception is
+                # off (state done/aborted → should_park False)
+                self._migration_task = None
+
+    def hottest_world(self, shard: int) -> str | None:
+        """The decayed-forward-count argmax among worlds the placement
+        currently puts on ``shard`` — the autoshard pick."""
+        best, best_load = None, 0.0
+        for world, load in self._world_load.items():
+            if load > best_load and \
+                    self.world_map.shard_of_world(world) == shard:
+                best, best_load = world, load
+        return best
+
+    def shard_forward_load(self, shard: int) -> float:
+        return sum(
+            load for world, load in self._world_load.items()
+            if self.world_map.shard_of_world(world) == shard
+        )
+
+    def decay_world_load(self, factor: float = 0.5) -> None:
+        """Exponential decay of the per-world forward window (called
+        each autoshard poll) — the hottest-world signal tracks RECENT
+        load, not lifetime totals."""
+        drop = [w for w, v in self._world_load.items() if v * factor < 1.0]
+        for world in drop:
+            del self._world_load[world]
+        for world in self._world_load:
+            self._world_load[world] *= factor
+
+    # endregion
 
     def _send_refusal(self, message: Message) -> None:
         """Budgeted jittered retry-after hint for a router-shed NEW
@@ -453,7 +696,7 @@ class ClusterRouter:
                 ),
                 "telemetry_stale": is_stale,
             }
-        return {
+        body = {
             "shards": self.n_shards,
             "alive": self.supervisor.alive_count(),
             "restarts": self.supervisor.stats()["restarts"],
@@ -461,7 +704,16 @@ class ClusterRouter:
             "forwarded": self.forwarded,
             "telemetry_stale": stale,
             "shard_states": shard_states,
+            "placement": {
+                "epoch": self.world_map.epoch,
+                "world_overrides": len(self.world_map.world_overrides),
+            },
+            "resharded": self.resharded,
+            "autoshard": self.autoshard.stats(),
         }
+        if self.migration is not None:
+            body["migration"] = self.migration.describe()
+        return body
 
     async def _start_http(self) -> None:
         from aiohttp import web
@@ -471,6 +723,7 @@ class ClusterRouter:
         app.router.add_get("/metrics", self._get_metrics)
         app.router.add_get("/debug/cluster", self._get_debug_cluster)
         app.router.add_post("/global_message", self._post_global_message)
+        app.router.add_post("/reshard", self._post_reshard)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         site = web.TCPSite(
@@ -597,6 +850,36 @@ class ClusterRouter:
         })
 
     # endregion
+
+    async def _post_reshard(self, request):
+        """Manual migration trigger: ``{"world": ..., "target": N}``.
+        202 with the xfer id when accepted; 409 while another migration
+        is in flight; 400 on a bad body or a no-op placement."""
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+            world = body["world"]
+            target = int(body["target"])
+            if not isinstance(world, str) or not world:
+                raise ValueError("world must be a non-empty string")
+        except Exception:
+            return web.Response(status=400)
+        if self.migration is not None and self.migration.active:
+            return web.json_response(
+                {"error": "migration in flight",
+                 "migration": self.migration.describe()},
+                status=409,
+            )
+        xfer = self.start_reshard(world, target, reason="manual")
+        if xfer is None:
+            return web.json_response(
+                {"error": "refused (bad target or world already there)"},
+                status=400,
+            )
+        return web.json_response(
+            {"xfer": xfer, "world": world, "target": target}, status=202
+        )
 
     async def _post_global_message(self, request):
         from aiohttp import web

@@ -71,10 +71,20 @@ import uuid as uuid_mod
 from collections import deque
 
 from ..engine.peers import Peer
+from ..protocol import Instruction
 from ..robustness import failpoints
 from . import tracectx
 from .bus import InterShardBus
-from .world_map import WorldMap
+from .resharding import (
+    FENCE_MAGIC,
+    ChunkAssembler,
+    PlacementMap,
+    encode_chunks,
+    export_world,
+    import_world,
+    parse_fence,
+    tombstone_world,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -134,12 +144,19 @@ def load_spec(env: dict | None = None) -> dict:
 
 
 class ClusterShardExtension:
+    #: re-exported for the transports (which check the fence payload
+    #: prefix via this attribute, never importing the cluster package)
+    FENCE_MAGIC = FENCE_MAGIC
+
     def __init__(self, server, spec: dict | None = None):
         self.server = server
         spec = spec if spec is not None else load_spec()
         self.shard_id = int(spec["shard_id"])
         self.n_shards = int(spec["n_shards"])
-        self.world_map = WorldMap(self.n_shards)
+        # epoch-versioned placement (live resharding): converged from
+        # router broadcasts + the epoch check on the ~1s state exchange
+        self.placement = PlacementMap(self.n_shards)
+        self.world_map = self.placement  # compatibility alias
         self.bus = InterShardBus(self.shard_id)
         rings = spec.get("rings") or {"out": {}, "in": {}}
         self.bus.attach(rings.get("out", {}), rings.get("in", {}))
@@ -157,6 +174,15 @@ class ClusterShardExtension:
         self.slow_frame_ms = getattr(server.config, "slow_frame_ms", None)
         self.slow_frames_dumped = 0
         self.slow_frames_skipped = 0
+        # live resharding (destination side): one capsule stream at a
+        # time, resumable from chunk 0 after a restart re-stream
+        self._import_xfer: int | None = None
+        self._import_assembler = ChunkAssembler()
+        #: completed imports: xfer → counts — a re-streamed capsule
+        #: after a lost ack is RE-ACKED, never re-applied
+        self._import_counts: dict[int, dict] = {}
+        self._reshard_tasks: set = set()
+        self.rerouted = 0
 
     # region: lifecycle
 
@@ -183,6 +209,8 @@ class ClusterShardExtension:
         )
 
     async def stop(self) -> None:
+        for task in list(self._reshard_tasks):
+            task.cancel()
         if self._ctl is not None:
             self._ctl.close()
             self._ctl = None
@@ -264,10 +292,12 @@ class ClusterShardExtension:
     # region: trace context (the router-stamped frame clock)
 
     @staticmethod
-    def unwrap(data: bytes) -> tuple[int, int, bytes]:
-        """Strip the router's trace-context prefix (transport hook —
-        the transports never import the cluster package directly)."""
-        return tracectx.unwrap(data)
+    def unwrap(data: bytes) -> tuple[int, int, int, bytes]:
+        """Strip the router's trace+epoch prefix (transport hook — the
+        transports never import the cluster package directly). Returns
+        ``(trace_id, t_ingress_ns, epoch, payload)``; v1/unprefixed
+        frames decode as epoch 0, which is never stale."""
+        return tracectx.unwrap_epoch(data)
 
     def close_frames(self, messages) -> None:
         """Close the router-ingress clock for locally-delivered frames
@@ -483,6 +513,183 @@ class ClusterShardExtension:
 
     # endregion
 
+    # region: live resharding (the shard half of the protocol)
+
+    def frame_stale(self, epoch: int) -> bool:
+        """True when the frame was stamped under an OLDER placement
+        than this shard holds: the transport diverts it off the fast
+        path into the full decode + ownership check — a stale entity
+        frame must never touch the SoA columns directly. Epoch 0
+        (pre-resharding router, replayed WAL, direct client) is never
+        stale."""
+        return epoch != 0 and epoch < self.placement.epoch
+
+    def frame_misrouted(self, message, epoch: int) -> bool:
+        """Post-decode ownership check for a stale-epoch frame: a frame
+        for a world (or peer) this shard no longer owns under the
+        CURRENT placement bounces back to the router over control as a
+        re-route hint — applied here it would mutate state the
+        placement already moved away. True = bounced, caller drops."""
+        if message.instruction in (
+            Instruction.HANDSHAKE, Instruction.HEARTBEAT
+        ):
+            if message.sender_uuid is None:
+                return False
+            owner = self.placement.shard_of_peer(message.sender_uuid)
+        else:
+            owner = self.placement.shard_of_world(message.world_name)
+        if owner == self.shard_id:
+            return False  # stale stamp, still the right owner: process
+        wire = message.wire
+        if wire is None:
+            return False
+        import base64
+
+        self.rerouted += 1
+        self.server.metrics.inc("cluster.shard_rerouted")
+        self._spawn_reshard(self._ctl_send_retry({
+            "op": "reroute",
+            "data": base64.b64encode(wire).decode(),
+        }, deadline_s=2.0))
+        return True
+
+    def on_fence(self, payload: bytes) -> None:
+        """A freeze fence arrived on the DATA path: the PULL socket is
+        FIFO and processing is in-order, so every frame the router
+        forwarded before the fence has already been handled — the
+        control ack is the drain proof the migration coordinator waits
+        on before exporting."""
+        xfer = parse_fence(payload)
+        if xfer is None:
+            return
+        self.server.metrics.inc("cluster.fence_seen")
+        self._spawn_reshard(self._ctl_send_retry({
+            "op": "fence_ack", "xfer": xfer,
+        }))
+
+    def _spawn_reshard(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)  # wql: allow(unsupervised-task) — one-shot, retained below, cancelled in stop()
+        self._reshard_tasks.add(task)
+        task.add_done_callback(self._reshard_tasks.discard)
+
+    async def _ctl_send_retry(self, packet: dict,
+                              deadline_s: float = 5.0) -> bool:
+        """The dump-chunk deadline-retry idiom for migration control
+        packets: a momentarily full control socket retries briefly
+        instead of silently dropping a protocol step (the coordinator's
+        timeouts catch a genuinely dead channel)."""
+        deadline = time.monotonic() + deadline_s
+        while not self._ctl_send(packet):
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.01)
+        return True
+
+    async def _do_export(self, xfer: int, world: str) -> None:
+        """Source side, STREAMING: capture the capsule (behind a
+        durability drain) and chunk it to the router CRC-framed."""
+        try:
+            payload = await export_world(self.server, world)
+        except Exception:
+            logger.exception(
+                "reshard %d: export of %r failed", xfer, world
+            )
+            return
+        for chunk in encode_chunks(payload):
+            if not await self._ctl_send_retry({
+                "op": "reshard_chunk", "xfer": xfer, "chunk": chunk,
+            }):
+                logger.warning(
+                    "reshard %d: capsule chunk send timed out", xfer
+                )
+                return
+
+    def _on_import_chunk(self, msg: dict) -> None:
+        """Destination side: feed one retained chunk. A transfer id
+        change (new migration, or the router re-streaming from zero
+        after this shard restarted) resets assembly; corruption resets
+        and waits — the coordinator's CRC check aborts its side."""
+        try:
+            xfer = int(msg["xfer"])
+            chunk = msg["chunk"]
+        except (KeyError, TypeError, ValueError):
+            return
+        if xfer != self._import_xfer:
+            self._import_xfer = xfer
+            self._import_assembler.reset()
+        doc = self._import_assembler.feed(chunk)
+        if self._import_assembler.corrupt:
+            logger.warning(
+                "reshard %d: corrupt capsule chunk — assembly reset, "
+                "awaiting re-stream", xfer,
+            )
+            self._import_assembler.reset()
+            return
+        if doc is not None:
+            self._spawn_reshard(self._do_import(xfer, doc))
+
+    async def _do_import(self, xfer: int, doc: dict) -> None:
+        """Apply the capsule THROUGH the durability pipeline (+ drain
+        barrier), then ack with the counts: from the ack on, this shard
+        can recover the world from its OWN WAL. Idempotent: a re-stream
+        after a lost ack re-acks the cached counts."""
+        if xfer not in self._import_counts:
+            try:
+                counts = await import_world(self.server, doc)
+            except Exception:
+                logger.exception("reshard %d: capsule import failed", xfer)
+                return
+            self._import_counts[xfer] = counts
+            while len(self._import_counts) > 8:
+                self._import_counts.pop(next(iter(self._import_counts)))
+        await self._ctl_send_retry({
+            "op": "reshard_imported", "xfer": xfer,
+            "counts": self._import_counts[xfer],
+        })
+
+    async def _do_tombstone(self, xfer: int, world: str) -> None:
+        """Source side, AFTER the destination's ack is durable: delete
+        the moved world through this shard's own WAL. Idempotent — the
+        router re-issues on every ready until the ack lands."""
+        try:
+            counts = await tombstone_world(self.server, world)
+        except Exception:
+            logger.exception(
+                "reshard %d: tombstone of %r failed", xfer, world
+            )
+            return
+        await self._ctl_send_retry({
+            "op": "reshard_tombstoned", "xfer": xfer, "counts": counts,
+        })
+
+    def _on_reshard_abort(self, msg: dict) -> None:
+        """The coordinator aborted: ownership stays with the source.
+        Drop any partial assembly and scrub whatever this shard already
+        applied (tombstone_world is idempotent; a no-op for nothing)."""
+        try:
+            xfer = int(msg["xfer"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if xfer == self._import_xfer:
+            self._import_xfer = None
+            self._import_assembler.reset()
+        world = msg.get("world")
+        if isinstance(world, str) and world:
+            self._import_counts.pop(xfer, None)
+            self._spawn_reshard(self._scrub_aborted(xfer, world))
+
+    async def _scrub_aborted(self, xfer: int, world: str) -> None:
+        try:
+            counts = await tombstone_world(self.server, world)
+            logger.warning(
+                "reshard %d aborted: scrubbed partial import of %r: %s",
+                xfer, world, counts,
+            )
+        except Exception:
+            logger.exception("reshard %d: abort scrub failed", xfer)
+
+    # endregion
+
     # region: control channel
 
     def _ctl_send(self, msg: dict) -> bool:
@@ -506,6 +713,9 @@ class ClusterShardExtension:
             "level": 0,
             "state": "ok",
             "peers": self.server.peer_map.size(),
+            # the router re-pushes the placement spec when this lags
+            # its epoch — restart convergence with no coordinator
+            "placement_epoch": self.placement.epoch,
             "bus": self.bus.stats(),
             "counters": {
                 k: v for k, v in counters.items()
@@ -583,6 +793,22 @@ class ClusterShardExtension:
             # router-side GET /debug/cluster: chunk this shard's
             # flight-recorder snapshot back over the control channel
             await self._send_dump(int(msg.get("req_id", 0)))
+        elif op == "placement":
+            spec = msg.get("spec")
+            if isinstance(spec, dict) and self.placement.apply_spec(spec):
+                self.server.metrics.inc("cluster.placement_applied")
+        elif op == "reshard_export":
+            self._spawn_reshard(self._do_export(
+                int(msg.get("xfer", 0)), str(msg.get("world", ""))
+            ))
+        elif op == "reshard_import_chunk":
+            self._on_import_chunk(msg)
+        elif op == "reshard_tombstone":
+            self._spawn_reshard(self._do_tombstone(
+                int(msg.get("xfer", 0)), str(msg.get("world", ""))
+            ))
+        elif op == "reshard_abort":
+            self._on_reshard_abort(msg)
         elif op == "inject":
             # router-side HTTP /global_message: a trusted in-process
             # injection stretched across the process boundary — the
@@ -649,6 +875,8 @@ class ClusterShardExtension:
             "shard_id": self.shard_id,
             "n_shards": self.n_shards,
             "remote_peers": len(self._remote),
+            "placement_epoch": self.placement.epoch,
+            "rerouted": self.rerouted,
             "xshard_frames": self.xshard_frames,
             "slow_frames_dumped": self.slow_frames_dumped,
             "slow_frames_skipped": self.slow_frames_skipped,

@@ -447,6 +447,21 @@ class ClusterSupervisor:
         proc = self._shards[idx].proc
         return proc.pid if proc is not None else None
 
+    def kill_shard(self, idx: int, sig: int = 9) -> bool:
+        """Chaos helper: signal shard ``idx``'s current incarnation
+        (default SIGKILL — no cleanup handlers run). The normal
+        death→restart machinery takes it from there; the resharding
+        kill-at-every-protocol-state suite drives this at each step.
+        True when a signal was delivered."""
+        proc = self._shards[idx].proc
+        if proc is None or proc.returncode is not None:
+            return False
+        try:
+            proc.send_signal(sig)
+            return True
+        except ProcessLookupError:
+            return False
+
     def alive_count(self) -> int:
         return sum(1 for s in self._shards if s.alive)
 

@@ -30,6 +30,19 @@ UNWRAPPED wire bytes (``Message.wire`` is set after stripping), and
 inter-shard ring frames carry the context in their own fixed header
 (``cluster/bus.py``) — the delivery ring record layout itself is
 untouched, so ``--cluster-shards 0`` stays byte-for-byte.
+
+Live resharding (ISSUE 19) extends the prefix with the PLACEMENT
+EPOCH the router stamped the forward under:
+
+    [4B magic "WQT2"][u64 trace_id][u64 t_ingress_ns][u64 epoch]  (28B)
+
+A shard compares the frame's epoch against its control-synced
+:class:`~.resharding.placement.PlacementMap`: a frame stamped under an
+OLDER epoch whose world the shard no longer owns is rejected with a
+re-route hint instead of misapplied (router push backlogs drain across
+a migration flip). ``unwrap_epoch`` decodes BOTH magics — v1 frames
+carry epoch 0, which never fails the staleness check — so mixed
+fleets and pre-cluster tests keep decoding.
 """
 
 from __future__ import annotations
@@ -40,6 +53,11 @@ import struct
 MAGIC = b"WQTX"
 _PREFIX = struct.Struct("<4sQQ")
 PREFIX_LEN = _PREFIX.size  # 20
+
+#: epoch-stamped v2 prefix (live resharding)
+MAGIC2 = b"WQT2"
+_PREFIX2 = struct.Struct("<4sQQQ")
+PREFIX2_LEN = _PREFIX2.size  # 28
 
 #: module-owned RNG for trace-id minting (seedable in tests)
 _rng = random.Random()
@@ -67,6 +85,29 @@ def unwrap(data: bytes) -> tuple[int, int, bytes]:
         _, trace_id, t_ingress = _PREFIX.unpack_from(data)
         return trace_id, t_ingress, data[PREFIX_LEN:]
     return 0, 0, data
+
+
+def wrap_epoch(
+    data: bytes, trace_id: int, t_ingress_ns: int, epoch: int
+) -> bytes:
+    """Prefix one wire message with trace context + the placement
+    epoch it was routed under (the resharding router's forward path —
+    the ``epochless-forward`` lint rule keeps every forwarding site on
+    this wrapper)."""
+    return _PREFIX2.pack(MAGIC2, trace_id, t_ingress_ns, epoch) + data
+
+
+def unwrap_epoch(data: bytes) -> tuple[int, int, int, bytes]:
+    """Strip either prefix generation → ``(trace_id, t_ingress_ns,
+    epoch, payload)``. v1 ("WQTX") frames and unprefixed bytes carry
+    epoch 0 — "no placement claim", never stale."""
+    if len(data) >= PREFIX2_LEN and data[:4] == MAGIC2:
+        _, trace_id, t_ingress, epoch = _PREFIX2.unpack_from(data)
+        return trace_id, t_ingress, epoch, data[PREFIX2_LEN:]
+    if len(data) >= PREFIX_LEN and data[:4] == MAGIC:
+        _, trace_id, t_ingress = _PREFIX.unpack_from(data)
+        return trace_id, t_ingress, 0, data[PREFIX_LEN:]
+    return 0, 0, 0, data
 
 
 def trace_id_hex(trace_id: int) -> str:

@@ -26,6 +26,11 @@ replay re-covers exactly the records routed to it.
 hot world's regions across shards — the region key is already part of
 the spatial key, so a future RegionMap can route by
 ``(world, region)`` without touching the router's forwarding loop).
+Live resharding (``resharding/placement.py``) takes exactly this seam:
+:class:`~.resharding.placement.PlacementMap` layers epoch-versioned
+per-world/per-peer overrides on top of the stable hash, so a migrated
+world routes to its NEW owner while everything else stays on the pure
+hash below.
 """
 
 from __future__ import annotations

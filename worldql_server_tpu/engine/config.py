@@ -418,6 +418,24 @@ class Config:
     cluster_role: str = field(
         default_factory=lambda: _env("WQL_CLUSTER_ROLE", "")
     )
+    # Live resharding (cluster/resharding, ISSUE 19): 'on' arms the
+    # router-side autoshard controller — it watches the federated
+    # per-shard overload state and migrates the hottest world off a
+    # sustained-hot shard automatically. 'off' (the default) never
+    # self-triggers; manual POST /reshard is always available on the
+    # router's HTTP surface either way.
+    cluster_autoshard: str = field(
+        default_factory=lambda: _env("WQL_CLUSTER_AUTOSHARD", "off")
+    )
+    # Byte budget for the per-migration transfer buffer: while a world
+    # migrates, the router PARKS its inbound traffic here for post-flip
+    # replay; past the budget frames are shed AND COUNTED
+    # (cluster.reshard_buffer_shed) — bounded memory, never silent loss.
+    reshard_buffer_bytes: int = field(
+        default_factory=lambda: int(
+            _env("WQL_RESHARD_BUFFER_BYTES", str(8 * 1024 * 1024))
+        )
+    )
     # Spatial query library (worldql_server_tpu/queries, ISSUE 17):
     # 'on' (the default) routes LocalMessages whose parameter names a
     # registered query kind (query.cone / query.raycast / query.knn /
@@ -718,6 +736,10 @@ class Config:
                 )
         if self.cluster_role == "router" and self.cluster_shards < 1:
             errors.append("cluster_role='router' requires cluster_shards >= 1")
+        if self.cluster_autoshard not in ("off", "on"):
+            errors.append("cluster_autoshard must be 'off' or 'on'")
+        if self.reshard_buffer_bytes < 1:
+            errors.append("reshard_buffer_bytes must be >= 1")
         if self.cluster_role == "shard" and not os.environ.get(
             "WQL_CLUSTER_SPEC"
         ):

@@ -802,6 +802,67 @@ class EntityPlane:
             removed += 1
         return removed
 
+    # region: world migration (live resharding)
+
+    def export_world(self, world: str) -> list[dict]:
+        """Snapshot every live entity of ``world`` as JSON-safe rows —
+        the entity leg of a migration capsule. Ownership rides along
+        (``owner`` hex): the new shard must enforce the same
+        owner-only update rule the old one did."""
+        wid = self._world_ids.get(world)
+        if wid is None:
+            return []
+        rows = []
+        for slot in np.flatnonzero(self._live & (self._wid == wid)):
+            slot = int(slot)
+            rows.append({
+                "uuid": self._uuid_of[slot].hex,
+                "owner": self._peer_uuids[int(self._pid[slot])].hex,
+                "pos": [float(v) for v in self._pos[slot]],
+                "vel": [float(v) for v in self._vel[slot]],
+            })
+        return rows
+
+    def import_world(self, world: str, rows: list[dict]) -> int:
+        """Replay exported entity rows into THIS plane through the
+        normal registration path (``_upsert``), so index coupling,
+        refcounts, and device-dirty tracking all engage exactly as a
+        live registration would."""
+        applied = 0
+        for row in rows:
+            try:
+                ent = Entity(
+                    uuid=uuid_mod.UUID(hex=row["uuid"]),
+                    position=Vector3(*(float(v) for v in row["pos"])),
+                    world_name=world,
+                    flex=np.asarray(
+                        row.get("vel") or (0.0, 0.0, 0.0), np.float32
+                    ).tobytes(),
+                )
+                owner = uuid_mod.UUID(hex=row["owner"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            applied += self._upsert(ent, _WIRE_MSG, owner)
+        return applied
+
+    def remove_world(self, world: str) -> int:
+        """Tombstone leg: drop every entity of ``world`` through the
+        normal removal path (refcount transition included, so the
+        backend index rows leave with the slots)."""
+        wid = self._world_ids.get(world)
+        if wid is None:
+            return 0
+        removed = 0
+        for slot in np.flatnonzero(self._live & (self._wid == wid)):
+            slot = int(slot)
+            pid = int(self._pid[slot])
+            self._ref_drop(wid, self._cube[slot], pid)
+            self._release_slot(slot, pid)
+            removed += 1
+        return removed
+
+    # endregion
+
     def _grow(self, cap: int) -> None:
         """Double the capacity tier (pow2): reallocate every column,
         preserving slots. The next dispatch compiles the new tier —

@@ -212,6 +212,62 @@ class SessionStore:
 
     # endregion
 
+    # region: migration (live resharding)
+
+    def export_parked(self, uuids) -> list[dict]:
+        """Serialize the PARKED sessions among ``uuids`` for a world
+        migration. Tokens ride along verbatim — the resume capability
+        must survive the move, or a mid-park migration silently
+        orphans every affected client. Live (bound) sessions stay
+        home: their transport is still attached to THIS process."""
+        now = self._clock()
+        rows = []
+        for uuid in uuids:
+            session = self._by_uuid.get(uuid)
+            if session is None or not session.parked:
+                continue
+            rows.append({
+                "token": session.token,
+                "uuid": session.uuid.hex,
+                "kind": session.kind,
+                "remaining_s": max(0.0, session.deadline - now),
+                "resumes": session.resumes,
+                "undelivered": session.undelivered,
+            })
+        return rows
+
+    def import_parked(self, rows: list[dict]) -> list[uuid_mod.UUID]:
+        """Adopt migrated parked sessions under their ORIGINAL tokens.
+        The TTL continues from where the source left it (remaining
+        time, not a fresh ``self.ttl`` — migration must not extend the
+        reclamation deadline). Returns the adopted UUIDs so the caller
+        can funnel each through ``mark_resync``."""
+        now = self._clock()
+        adopted = []
+        for row in rows:
+            try:
+                uuid = uuid_mod.UUID(hex=row["uuid"])
+                token = str(row["token"])
+                kind = str(row.get("kind", "unknown"))
+                remaining = float(row.get("remaining_s", self.ttl))
+            except (KeyError, TypeError, ValueError):
+                continue
+            old = self._by_uuid.pop(uuid, None)
+            if old is not None:
+                self._by_token.pop(old.token, None)
+            session = Session(token, uuid, kind, now)
+            session.parked_at = now
+            session.deadline = now + max(0.0, remaining)
+            session.resumes = int(row.get("resumes", 0))
+            session.undelivered = int(row.get("undelivered", 0))
+            self._by_token[token] = session
+            self._by_uuid[uuid] = session
+            self.parked_total += 1
+            adopted.append(uuid)
+        return adopted
+
+    # endregion
+
     # region: accounting + sweep
 
     def note_undelivered(self, uuid: uuid_mod.UUID) -> None:

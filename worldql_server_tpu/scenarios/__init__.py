@@ -10,7 +10,8 @@ tests/test_scenarios.py.
 
 from .catalog import (
     BandwidthCap, BattleRoyale, ClusterFlashCrowd, FlashCrowd, GameTick,
-    ProjectileStorm, ReconnectStorm, ReconnectStormReplay, SniperScope,
+    MegaCity, ProjectileStorm, ReconnectStorm, ReconnectStormReplay,
+    RollingRestart, SniperScope,
 )
 from .engine import Check, Scenario, ScenarioContext, format_report, run_scenario
 
@@ -20,6 +21,7 @@ CATALOG = {
         FlashCrowd, BattleRoyale, ReconnectStorm, GameTick,
         ReconnectStormReplay, ClusterFlashCrowd,
         SniperScope, ProjectileStorm, BandwidthCap,
+        MegaCity, RollingRestart,
     )
 }
 
@@ -31,9 +33,11 @@ __all__ = [
     "ClusterFlashCrowd",
     "FlashCrowd",
     "GameTick",
+    "MegaCity",
     "ProjectileStorm",
     "ReconnectStorm",
     "ReconnectStormReplay",
+    "RollingRestart",
     "Scenario",
     "ScenarioContext",
     "SniperScope",

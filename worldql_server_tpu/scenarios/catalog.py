@@ -1739,3 +1739,451 @@ class BandwidthCap(Scenario):
             Check("broker_answers_after_throttle",
                   slo["broker_answers"], slo["broker_answers"], True),
         ]
+
+
+class MegaCity(Scenario):
+    """Live resharding under fire (ISSUE 19): a mega-city world keeps
+    one shard hot while district traffic spreads across the cluster,
+    and mid-traffic the city is live-resharded to the other shard.
+    Survival means the migration is INVISIBLE to the workload: the
+    protocol runs to ``done``, the placement epoch advances and the
+    city routes to its new owner, every record offered before, during
+    and after the move reads back (the freeze window parks frames in
+    the bounded transfer buffer and replays them — counted, never
+    shed), the pre-move subscription keeps delivering THROUGH the flip
+    (subscription rows rode the capsule), and the broker answers
+    after."""
+
+    name = "mega_city"
+    description = "hot world live-resharded mid-traffic, zero loss"
+    #: spawns shard subprocesses — runs in the dedicated "Cluster
+    #: smoke" CI step (and by explicit name), not the default set
+    ci_smoke = False
+
+    def build_config(self, shape: str) -> Config:
+        return Config(
+            store_url="memory://",
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1",
+            zmq_server_port=free_port_block(3),
+            spatial_backend="cpu", tick_interval=0.02,
+            max_batch=64, overload="on",
+            supervisor_backoff=0.005,
+            cluster_shards=2,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        runtime = ctx.server
+        router = runtime.router
+        placement = router.world_map
+        n_pre = 10 if ctx.smoke else 40
+        n_post = 6 if ctx.smoke else 20
+        post_flip_s = 0.8 if ctx.smoke else 2.0
+
+        def world_for(shard: int, stem: str) -> str:
+            for i in range(10_000):
+                name = f"{stem}{i}"
+                if placement.shard_of_world(name) == shard:
+                    return name
+            raise AssertionError("no world for shard")
+
+        def uuid_for(shard: int) -> uuid_mod.UUID:
+            while True:
+                u = uuid_mod.uuid4()
+                if placement.shard_of_peer(u) == shard:
+                    return u
+
+        city = world_for(0, "megacity")        # starts on shard 0
+        districts = [world_for(i, "district") for i in (0, 1)]
+        pos = Vector3(5.0, 5.0, 5.0)
+
+        # receiver homed on the DESTINATION shard, sender on the
+        # source: city delivery crosses the ring before the flip and
+        # stays local after — both legs exercised by one subscription
+        rx = await ctx.connect(peer_uuid=uuid_for(1))
+        tx = await ctx.connect(peer_uuid=uuid_for(0))
+        await rx.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name=city, position=pos,
+        ))
+        await asyncio.sleep(0.3)
+
+        created: list[tuple[str, uuid_mod.UUID]] = []
+
+        async def put(world: str, tag: str) -> None:
+            rec = uuid_mod.uuid4()
+            await tx.send(Message(
+                instruction=Instruction.RECORD_CREATE,
+                world_name=world,
+                records=[Record(uuid=rec, position=pos,
+                                world_name=world, data=tag)],
+            ))
+            created.append((world, rec))
+
+        for i in range(n_pre):
+            await put(city, f"pre{i}")
+            await put(districts[i % 2], f"d{i}")
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.2)
+
+        received = {"during": 0, "post": 0}
+        phase = {"v": "during"}
+        stop = asyncio.Event()
+
+        async def receiver() -> None:
+            while True:
+                got = await rx.recv(30)
+                if (
+                    got.instruction == Instruction.LOCAL_MESSAGE
+                    and got.parameter
+                    and got.parameter.startswith("city:")
+                ):
+                    received[phase["v"]] += 1
+
+        async def city_traffic() -> int:
+            # live locals + mid-flight record creates: the freeze
+            # window MUST catch some of these in the transfer buffer
+            sent = 0
+            while not stop.is_set():
+                await tx.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name=city, position=pos,
+                    parameter=f"city:{sent}",
+                ))
+                sent += 1
+                if sent % 4 == 0:
+                    await put(city, f"mid{sent}")
+                await asyncio.sleep(0.01)
+            return sent
+
+        async def reshard():
+            await asyncio.sleep(0.4)     # traffic provably flowing
+            xfer = router.start_reshard(city, 1, reason="scenario")
+            deadline = time.perf_counter() + (30 if ctx.smoke else 60)
+            while time.perf_counter() < deadline:
+                mig = router.migration
+                if mig is not None and mig.state in ("done", "aborted"):
+                    return (xfer, mig)
+                await asyncio.sleep(0.05)
+            return (xfer, router.migration)
+
+        receiver_task = asyncio.ensure_future(receiver())
+        try:
+            traffic = asyncio.ensure_future(city_traffic())
+            xfer, mig = await reshard()
+            phase["v"] = "post"
+            await asyncio.sleep(post_flip_s)   # post-flip delivery leg
+            stop.set()
+            sent = await traffic
+            for i in range(n_post):
+                await put(city, f"post{i}")
+            await asyncio.sleep(0.3)
+        finally:
+            # the receiver must be gone BEFORE the read-back phase —
+            # it would steal the RECORD_REPLYs off rx's pull socket
+            receiver_task.cancel()
+            try:
+                await receiver_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        # zero record loss: every record offered around the move is
+        # readable back through the router (now via the new owner)
+        async def readable(world: str, want: set) -> int:
+            deadline = time.perf_counter() + 20
+            seen: set = set()
+            while time.perf_counter() < deadline and not want <= seen:
+                await rx.send(Message(
+                    instruction=Instruction.RECORD_READ,
+                    world_name=world, position=pos,
+                ))
+                try:
+                    reply = await rx.recv_until(
+                        Instruction.RECORD_REPLY, 5
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                seen |= {r.uuid for r in reply.records}
+            return len(want & seen)
+
+        want_by_world: dict[str, set] = {}
+        for world, rec in created:
+            want_by_world.setdefault(world, set()).add(rec)
+        found = 0
+        for world, want in want_by_world.items():
+            found += await readable(world, want)
+
+        desc = mig.describe() if mig is not None else {}
+        return {
+            "xfer": xfer,
+            "migration_state": desc.get("state", "missing"),
+            "placement_epoch": placement.epoch,
+            "owner_after": placement.shard_of_world(city),
+            "records_offered": len(created),
+            "records_found": found,
+            "parked_replayed": desc.get("replayed", 0),
+            "buffer_shed": (desc.get("buffer") or {}).get("shed", 0),
+            "city_sent": sent,
+            "delivered_during": received["during"],
+            "delivered_post": received["post"],
+            "broker_answers": await ctx.heartbeat_ok(tx),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        return [
+            Check("reshard_completed", slo["migration_state"] == "done",
+                  slo["migration_state"], "done"),
+            Check("placement_epoch_advanced",
+                  slo["placement_epoch"] >= 1,
+                  slo["placement_epoch"], ">= 1"),
+            Check("ownership_flipped", slo["owner_after"] == 1,
+                  slo["owner_after"], 1,
+                  "the city routes to its NEW owner"),
+            Check("zero_record_loss",
+                  slo["records_found"] == slo["records_offered"],
+                  slo["records_found"], slo["records_offered"],
+                  "records offered before, during and after the move "
+                  "all read back"),
+            Check("freeze_window_parked_and_replayed",
+                  slo["parked_replayed"] > 0,
+                  slo["parked_replayed"], "> 0",
+                  "live traffic provably crossed the freeze window"),
+            Check("transfer_buffer_never_shed",
+                  slo["buffer_shed"] == 0, slo["buffer_shed"], 0),
+            Check("delivery_through_the_flip",
+                  slo["delivered_during"] > 0
+                  and slo["delivered_post"] > 0,
+                  (slo["delivered_during"], slo["delivered_post"]),
+                  ("> 0", "> 0"),
+                  "the pre-move subscription rode the capsule"),
+            Check("broker_answers_after_reshard",
+                  slo["broker_answers"], slo["broker_answers"], True),
+        ]
+
+
+class RollingRestart(Scenario):
+    """Rolling cluster restart (ISSUE 19): after a live reshard moved
+    a world off its hash-home, SIGKILL every shard in sequence under
+    traffic. Survival means the control plane heals itself: the
+    supervisor restarts each shard, the placement map (epoch +
+    override) replays to every restarted shard so the migrated world
+    still routes to its NEW owner, WAL replay recovers every record —
+    including the migrated capsule through the destination's OWN WAL
+    (the exactly-one-owner invariant) — fresh sessions land and
+    subscribe after the roll, and the broker answers."""
+
+    name = "rolling_restart"
+    description = "SIGKILL each shard in turn; placement + WAL recover"
+    #: spawns shard subprocesses — runs in the dedicated "Cluster
+    #: smoke" CI step (and by explicit name), not the default set
+    ci_smoke = False
+
+    def build_config(self, shape: str) -> Config:
+        import tempfile
+
+        return Config(
+            store_url="memory://",
+            durability="wal",
+            wal_dir=tempfile.mkdtemp(prefix="wql-rolling-"),
+            checkpoint_interval=0,  # SIGKILL must find the WAL whole
+            http_enabled=False, ws_enabled=False,
+            zmq_server_host="127.0.0.1",
+            zmq_server_port=free_port_block(3),
+            spatial_backend="cpu", tick_interval=0.02,
+            max_batch=64,
+            supervisor_backoff=0.005,
+            cluster_shards=2,
+        )
+
+    async def drive(self, ctx: ScenarioContext) -> dict:
+        runtime = ctx.server
+        router = runtime.router
+        placement = router.world_map
+        supervisor = runtime.supervisor
+        n_records = 8 if ctx.smoke else 30
+
+        def world_for(shard: int, stem: str) -> str:
+            for i in range(10_000):
+                name = f"{stem}{i}"
+                if placement.shard_of_world(name) == shard:
+                    return name
+            raise AssertionError("no world for shard")
+
+        async def wait_for(predicate, timeout_s: float,
+                           what: str) -> bool:
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                if predicate():
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        moved = world_for(0, "moved")      # migrates 0 → 1 pre-roll
+        steady = world_for(1, "steady")
+        pos = Vector3(5.0, 5.0, 5.0)
+
+        tx = await ctx.connect()
+        created: dict[str, set] = {moved: set(), steady: set()}
+        for i in range(n_records):
+            for world in (moved, steady):
+                rec = uuid_mod.uuid4()
+                await tx.send(Message(
+                    instruction=Instruction.RECORD_CREATE,
+                    world_name=world,
+                    records=[Record(uuid=rec, position=pos,
+                                    world_name=world, data=f"r{i}")],
+                ))
+                created[world].add(rec)
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.3)
+
+        # live reshard FIRST: the roll must not undo the move
+        xfer = router.start_reshard(moved, 1, reason="scenario")
+        moved_ok = await wait_for(
+            lambda: router.migration is not None
+            and router.migration.state in ("done", "aborted"),
+            30 if ctx.smoke else 60, "reshard",
+        )
+        migration_state = (
+            router.migration.state if router.migration else "missing"
+        )
+        epoch = placement.epoch
+
+        # the roll: SIGKILL each shard in turn, wait for the
+        # supervised restart AND placement re-convergence (the ~1s
+        # control-state packets carry the shard's epoch back)
+        attempts_during_roll = 0
+        roll = {"deaths": 0, "revivals": 0, "converged": 0}
+        for idx in range(supervisor.n_shards):
+            supervisor.kill_shard(idx)
+            if await wait_for(
+                lambda: not supervisor.shard_alive(idx), 30, "death"
+            ):
+                roll["deaths"] += 1
+            # traffic provably hits the half-dead cluster (best
+            # effort — the point is the cluster survives it)
+            for i in range(10):
+                try:
+                    await tx.send(Message(
+                        instruction=Instruction.LOCAL_MESSAGE,
+                        world_name=moved if i % 2 else steady,
+                        position=pos, parameter="roll",
+                    ))
+                    attempts_during_roll += 1
+                except Exception:
+                    pass
+            if await wait_for(
+                lambda: supervisor.shard_alive(idx), 90, "revival"
+            ):
+                roll["revivals"] += 1
+            if await wait_for(
+                lambda: supervisor.shard_state(idx).get(
+                    "placement_epoch", -1) >= epoch,
+                30, "placement convergence",
+            ):
+                roll["converged"] += 1
+
+        # post-roll verification rides FRESH sessions (each peer's
+        # home shard died at some point in the roll)
+        probe = await ctx.connect()
+        await probe.send(Message(
+            instruction=Instruction.AREA_SUBSCRIBE,
+            world_name=moved, position=pos,
+        ))
+        await asyncio.sleep(0.3)
+
+        post_rec = uuid_mod.uuid4()
+        await probe.send(Message(
+            instruction=Instruction.RECORD_CREATE, world_name=moved,
+            records=[Record(uuid=post_rec, position=pos,
+                            world_name=moved, data="post-roll")],
+        ))
+        created[moved].add(post_rec)
+
+        sender = await ctx.connect()
+        await sender.send(Message(
+            instruction=Instruction.LOCAL_MESSAGE, world_name=moved,
+            position=pos, parameter="after-roll",
+        ))
+        delivered_after = False
+        try:
+            while True:
+                got = await probe.recv(10)
+                if (got.instruction == Instruction.LOCAL_MESSAGE
+                        and got.parameter == "after-roll"):
+                    delivered_after = True
+                    break
+        except asyncio.TimeoutError:
+            pass
+
+        async def readable(world: str, want: set) -> int:
+            deadline = time.perf_counter() + 30
+            seen: set = set()
+            while time.perf_counter() < deadline and not want <= seen:
+                await probe.send(Message(
+                    instruction=Instruction.RECORD_READ,
+                    world_name=world, position=pos,
+                ))
+                try:
+                    reply = await probe.recv_until(
+                        Instruction.RECORD_REPLY, 5
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                seen |= {r.uuid for r in reply.records}
+            return len(want & seen)
+
+        found = 0
+        for world, want in created.items():
+            found += await readable(world, want)
+        offered = sum(len(want) for want in created.values())
+
+        return {
+            "xfer": xfer,
+            "reshard_done": moved_ok and migration_state == "done",
+            "placement_epoch": epoch,
+            "owner_after_roll": placement.shard_of_world(moved),
+            "shard_deaths": roll["deaths"],
+            "shard_revivals": roll["revivals"],
+            "placement_reconverged": roll["converged"],
+            "restarts": supervisor.stats()["restarts"],
+            "attempts_during_roll": attempts_during_roll,
+            "records_offered": offered,
+            "records_found": found,
+            "delivered_after_roll": delivered_after,
+            "broker_answers": await ctx.heartbeat_ok(probe),
+        }
+
+    def checks(self, ctx: ScenarioContext, slo: dict) -> list[Check]:
+        n = 2
+        return [
+            Check("reshard_done_before_roll", slo["reshard_done"],
+                  slo["reshard_done"], True),
+            Check("every_shard_died_and_revived",
+                  slo["shard_deaths"] == n
+                  and slo["shard_revivals"] == n,
+                  (slo["shard_deaths"], slo["shard_revivals"]), (n, n)),
+            Check("supervised_restarts_counted",
+                  slo["restarts"] >= n, slo["restarts"], f">= {n}"),
+            Check("placement_replayed_to_every_restart",
+                  slo["placement_reconverged"] == n,
+                  slo["placement_reconverged"], n,
+                  "each restarted shard re-reported the post-move "
+                  "epoch via its control-state packets"),
+            Check("migrated_world_stays_moved",
+                  slo["owner_after_roll"] == 1,
+                  slo["owner_after_roll"], 1,
+                  "the roll did not undo the live reshard"),
+            Check("traffic_hit_the_roll",
+                  slo["attempts_during_roll"] > 0,
+                  slo["attempts_during_roll"], "> 0"),
+            Check("zero_record_loss_through_roll",
+                  slo["records_found"] == slo["records_offered"],
+                  slo["records_found"], slo["records_offered"],
+                  "WAL replay recovered every record, the migrated "
+                  "capsule from the destination's OWN WAL"),
+            Check("delivery_after_roll", slo["delivered_after_roll"],
+                  slo["delivered_after_roll"], True),
+            Check("broker_answers_after_roll", slo["broker_answers"],
+                  slo["broker_answers"], True),
+        ]

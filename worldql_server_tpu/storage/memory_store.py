@@ -75,6 +75,15 @@ class MemoryRecordStore(RecordStore):
             self._regions[key] = keep
         return deleted
 
+    async def export_world_records(self, world_name: str) -> list[StoredRecord]:
+        world = sanitize_world_name(world_name)
+        out = []
+        for (key_world, _region), rows in self._regions.items():
+            if key_world != world:
+                continue
+            out.extend(sr for _, sr in rows)
+        return out
+
     async def dedupe_records(self, ops: list[DedupeOp]) -> int:
         deleted = 0
         for rec_uuid, keep_ts, world_name, position in ops:

@@ -303,6 +303,38 @@ class PostgresRecordStore(RecordStore):
             ))
         return out
 
+    async def export_world_records(self, world_name: str) -> list[StoredRecord]:
+        world = world_key(world_name)
+        suffix_rows = await self._fetch(
+            "SELECT table_suffix FROM navigation.tables WHERE world_name=$1",
+            world,
+        )
+        out: list[StoredRecord] = []
+        for (suffix,) in suffix_rows:
+            try:
+                rows = await self._fetch(
+                    f'SELECT last_modified, x, y, z, uuid, data, flex '
+                    f'FROM "w_{world}".t_{suffix}'
+                )
+            except Exception as exc:
+                if self._is_undefined_table(exc):
+                    continue
+                raise
+            for ts, x, y, z, u, data, flex in rows:
+                if ts.tzinfo is None:
+                    ts = ts.replace(tzinfo=timezone.utc)
+                out.append(StoredRecord(
+                    timestamp=ts,
+                    record=Record(
+                        uuid=uuid_mod.UUID(u),
+                        position=Vector3(x, y, z),
+                        world_name=world_name,
+                        data=data,
+                        flex=bytes(flex) if flex is not None else None,
+                    ),
+                ))
+        return out
+
     async def delete_records(self, records: list[Record]) -> int:
         deleted = 0
         for record in records:

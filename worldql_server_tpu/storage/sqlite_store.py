@@ -253,6 +253,46 @@ class SqliteRecordStore(RecordStore):
             for ts, x, y, z, u, data, flex in rows
         ]
 
+    async def export_world_records(self, world_name: str) -> list[StoredRecord]:
+        async with self._lock:
+            return await asyncio.to_thread(self._export_world_sync, world_name)
+
+    def _export_world_sync(self, world_name: str) -> list[StoredRecord]:
+        conn = self._conn
+        world = world_key(world_name)
+        suffixes = [
+            row[0] for row in conn.execute(
+                "SELECT table_suffix FROM navigation_tables "
+                "WHERE world_name=?", (world,),
+            ).fetchall()
+        ]
+        out: list[StoredRecord] = []
+        for suffix in suffixes:
+            table = _data_table(world, suffix)
+            try:
+                rows = conn.execute(
+                    f"SELECT last_modified, x, y, z, uuid, data, flex "
+                    f"FROM {table}"
+                ).fetchall()
+            except sqlite3.OperationalError as exc:
+                if "no such table" in str(exc):
+                    continue  # navigation row without a data table yet
+                raise
+            out.extend(
+                StoredRecord(
+                    timestamp=datetime.fromtimestamp(ts, timezone.utc),
+                    record=Record(
+                        uuid=uuid_mod.UUID(u),
+                        position=Vector3(x, y, z),
+                        world_name=world_name,
+                        data=data,
+                        flex=flex,
+                    ),
+                )
+                for ts, x, y, z, u, data, flex in rows
+            )
+        return out
+
     async def delete_records(self, records: list[Record]) -> int:
         async with self._lock:
             return await asyncio.to_thread(self._delete_sync, records)

@@ -68,6 +68,16 @@ class RecordStore(abc.ABC):
         """Read-repair: delete rows older than the kept timestamp for
         each record uuid; returns rows deleted."""
 
+    async def export_world_records(self, world_name: str) -> list[StoredRecord]:
+        """Every row belonging to ``world_name``, across all regions —
+        the live-resharding capsule read (one world migrates between
+        shards as a unit). Duplicate append rows are returned as-is;
+        the importer re-appends them, preserving dedupe-on-read
+        semantics on the new owner."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support world export"
+        )
+
     async def init(self) -> None:
         """Idempotent schema/bootstrap (database/init.rs:10-26)."""
 

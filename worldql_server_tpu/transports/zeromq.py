@@ -177,11 +177,9 @@ class ZmqTransport:
             unwrapped = 0
             data = self._flatten(parts, limit)
             if data is not None:
-                if cluster is not None:
-                    trace_id, t_ctx, data = cluster.unwrap(data)
-                    ctxs.append((trace_id, t_ctx))  # wql: allow(unbounded-ingest) — lockstep with datas, same RECV_DRAIN_MAX bound
-                    unwrapped += 1 if trace_id else 0
-                datas.append(data)  # wql: allow(unbounded-ingest) — one message; the drain below is bounded by RECV_DRAIN_MAX
+                unwrapped += await self._absorb_inbound(
+                    cluster, data, datas, ctxs
+                )
             while len(datas) < RECV_DRAIN_MAX:
                 try:
                     parts = await self._pull.recv_multipart(zmq.NOBLOCK)
@@ -189,11 +187,9 @@ class ZmqTransport:
                     break
                 data = self._flatten(parts, limit)
                 if data is not None:
-                    if cluster is not None:
-                        trace_id, t_ctx, data = cluster.unwrap(data)
-                        ctxs.append((trace_id, t_ctx))  # wql: allow(unbounded-ingest) — lockstep with datas, same RECV_DRAIN_MAX bound
-                        unwrapped += 1 if trace_id else 0
-                    datas.append(data)  # wql: allow(unbounded-ingest) — bounded by RECV_DRAIN_MAX; admission happens in ColumnarIngest/router
+                    unwrapped += await self._absorb_inbound(
+                        cluster, data, datas, ctxs
+                    )
             if unwrapped:
                 # the fast-path-through-router proof: router-framed
                 # messages reaching the columnar batch pre-unwrapped
@@ -202,6 +198,33 @@ class ZmqTransport:
                 # contains per message internally; never raises
                 await fast.process_batch(datas, self._route_data,
                                          ctxs=ctxs)
+
+    async def _absorb_inbound(self, cluster, data: bytes, datas: list,
+                              ctxs: list | None) -> int:
+        """Classify one inbound frame for the columnar batch. Live
+        resharding (cluster/resharding) adds two diverts ahead of the
+        fast path: freeze FENCE frames ack over control instead of
+        decoding, and STALE-EPOCH frames (stamped under an older
+        placement than this shard holds) take the full decode +
+        ownership check — a stale entity frame must never reach the
+        SoA columns directly, it may belong to a world this shard just
+        lost. Everything else joins the batch with its trace ctx in
+        lockstep. Returns 1 when a live trace ctx was stripped."""
+        if cluster is None:
+            datas.append(data)  # wql: allow(unbounded-ingest) — bounded by RECV_DRAIN_MAX in the caller
+            return 0
+        trace_id, t_ctx, epoch, data = cluster.unwrap(data)
+        if data[:4] == cluster.FENCE_MAGIC:
+            cluster.on_fence(data)
+            return 0
+        if cluster.frame_stale(epoch):
+            await self._route_data(
+                data, ctx=(trace_id, t_ctx), epoch=epoch
+            )
+            return 0
+        ctxs.append((trace_id, t_ctx))  # wql: allow(unbounded-ingest) — lockstep with datas, same RECV_DRAIN_MAX bound
+        datas.append(data)  # wql: allow(unbounded-ingest) — bounded by RECV_DRAIN_MAX; admission happens in ColumnarIngest/router
+        return 1 if trace_id else 0
 
     def _flatten(self, parts: list[bytes], limit: int) -> bytes | None:
         """Bound + join one multipart message (None = dropped).
@@ -225,19 +248,22 @@ class ZmqTransport:
             await self._route_data(data)
 
     async def _route_data(self, data: bytes,
-                          ctx: tuple[int, int] | None = None) -> None:
+                          ctx: tuple[int, int] | None = None,
+                          epoch: int = 0) -> None:
         tracer = getattr(self.server, "tracer", None)
         if tracer is not None and tracer.enabled:
             # recv→decode→route under one span tree: the decode and the
             # router's handle span nest inside "zmq.recv", so a slow
             # inbound message shows WHERE it spent its wall time
             with tracer.span("zmq.recv", bytes=len(data)) as rspan:
-                await self._decode_route(data, tracer, rspan, ctx=ctx)
+                await self._decode_route(data, tracer, rspan, ctx=ctx,
+                                         epoch=epoch)
         else:
-            await self._decode_route(data, None, ctx=ctx)
+            await self._decode_route(data, None, ctx=ctx, epoch=epoch)
 
     async def _decode_route(self, data: bytes, tracer, rspan=None,
-                            ctx: tuple[int, int] | None = None) -> None:
+                            ctx: tuple[int, int] | None = None,
+                            epoch: int = 0) -> None:
         # Cluster shards receive every message through the router,
         # which frames a trace context on (cluster/tracectx.py):
         # strip it BEFORE the codec (fan-out re-broadcasts the
@@ -253,7 +279,12 @@ class ZmqTransport:
             cluster = getattr(self.server, "cluster", None)
             trace_id = t_ctx = 0
             if cluster is not None:
-                trace_id, t_ctx, data = cluster.unwrap(data)
+                trace_id, t_ctx, epoch, data = cluster.unwrap(data)
+                if data[:4] == cluster.FENCE_MAGIC:
+                    # freeze fence on the per-message path (no columnar
+                    # fast path armed): ack over control, never decode
+                    cluster.on_fence(data)
+                    return
         try:
             failpoints.fire("codec.decode")
             if tracer is not None:
@@ -264,6 +295,18 @@ class ZmqTransport:
         except DeserializeError:
             logger.debug("dropping invalid zmq message: deserialize error")
             return
+        if epoch:
+            # live resharding: a frame stamped under an older placement
+            # epoch, for a world/peer this shard no longer owns, bounces
+            # back to the router as a re-route hint instead of mutating
+            # state the placement already moved away
+            cluster = getattr(self.server, "cluster", None)
+            if (
+                cluster is not None
+                and cluster.frame_stale(epoch)
+                and cluster.frame_misrouted(message, epoch)
+            ):
+                return
         if trace_id:
             message.trace_ctx = (trace_id, t_ctx)
             if rspan is not None:
