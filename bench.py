@@ -45,6 +45,7 @@ import argparse
 import asyncio
 import contextlib
 import json
+import os
 import sys
 import time
 import uuid as uuid_mod
@@ -645,6 +646,23 @@ def bench_delivery(args, *, delivery_workers: int = 0,
             )
             for p in range(n_procs)
         ]
+        # per-core efficiency (ROADMAP item 1): deliveries ÷ CPU-seconds
+        # actually burned by the server-side processes (this process +
+        # sender workers) over the measured window — the same
+        # /proc-based accounting behind the router's live
+        # deliveries_per_s_per_core gauge, so the gate floor and the
+        # fleet gauge speak one unit
+        from worldql_server_tpu.cluster.federation import _proc_cpu_s
+
+        def server_cpu_s() -> float:
+            total = _proc_cpu_s(os.getpid())
+            plane_ = server.delivery_plane
+            if plane_ is not None:
+                for shard in plane_._shards:
+                    if shard.proc is not None and shard.proc.pid:
+                        total += _proc_cpu_s(shard.proc.pid)
+            return total
+
         try:
             for p in procs:
                 p.start()
@@ -654,10 +672,12 @@ def bench_delivery(args, *, delivery_workers: int = 0,
             # child would strand the barrier — bounded wait + liveness
             # check instead of hanging the whole bench.
             await asyncio.to_thread(barrier.wait, 120)
+            cpu0 = server_cpu_s()
             results = [
                 await asyncio.to_thread(out_q.get, True, 180)
                 for _ in procs
             ]
+            cpu_used_s = max(server_cpu_s() - cpu0, 0.0)
             for p in procs:
                 p.join(timeout=30)
             ticker = server.ticker
@@ -683,6 +703,7 @@ def bench_delivery(args, *, delivery_workers: int = 0,
             }
             return results, e2e, {
                 "ticks": ticker.ticks if ticker else 0,
+                "server_cpu_s": cpu_used_s,
                 # outbound frame bytes at the delivery boundary
                 # (PeerMap.bytes_delivered, ISSUE 18) — the volume the
                 # interest manager exists to shrink
@@ -746,6 +767,15 @@ def bench_delivery(args, *, delivery_workers: int = 0,
             / max(elapsed, 1e-9), 1
         ),
         "frame_delta_ratio": tick_stats["delta_ratio"] or 0.0,
+        # per-core efficiency floor (ROADMAP item 1): deliveries per
+        # CPU-second burned server-side over the measured window —
+        # tools/bench_diff treats this higher-is-better and the CI
+        # gate holds an absolute floor on it, so a change that keeps
+        # raw throughput by burning proportionally more CPU still fails
+        "server_cpu_s": round(tick_stats["server_cpu_s"], 3),
+        "deliveries_per_s_per_core": round(
+            received / tick_stats["server_cpu_s"], 1
+        ) if tick_stats["server_cpu_s"] > 0 else 0.0,
     }
     if plane_stats is not None:
         out["n_workers"] = delivery_workers
@@ -4441,6 +4471,237 @@ def bench_config14(args) -> dict:
     }
 
 
+def bench_config15(args) -> dict:
+    """SLO compliance under the game-tick shape (ISSUE 20): boot the
+    REAL server with the burn-rate engine ON — the DEFAULT objective
+    set (frame e2e p99, ring drops, interest resyncs, …) at
+    bench-tight windows so a few seconds of load fills both burn
+    windows the way a minute fills production's — and drive the
+    config-13 game_tick shape over real ZMQ: a static co-located
+    majority plus velocity-integrated movers with interest-managed
+    fan-out. Reported per objective: compliance (fraction of
+    evaluations spent at OK, as a percentage so the perf gate's
+    --min-abs floor can't mute it) and the worst burn rate either
+    window saw. ``--smoke`` asserts the supervised slo-eval task
+    judged every objective, the frame clock closed real frames (the
+    e2e objective must not be grading an empty series), and nothing
+    entered BURNING at the quick shape — then the compliance_pct
+    leaves diff against the baseline (higher is better): a latency
+    regression that starts torching the error budget fails CI even
+    while every raw *_per_s leaf holds."""
+    import struct
+    import tempfile
+    import uuid as _uuid
+
+    from tests.client_util import ZmqClient, free_port
+    from worldql_server_tpu.engine.config import Config
+    from worldql_server_tpu.engine.server import WorldQLServer
+    from worldql_server_tpu.observability.slo import (
+        BURNING, DEFAULT_OBJECTIVES, OK,
+    )
+    from worldql_server_tpu.protocol import Instruction, Message
+    from worldql_server_tpu.protocol.types import Entity, Vector3
+    from worldql_server_tpu.utils.retrace import GUARD
+
+    quick = args.quick
+    n_watchers = 4 if quick else 8
+    ents_per_watcher = 4 if quick else 12
+    n_movers = 2 if quick else 8
+    measure_s = 3.0 if quick else 8.0
+    tick = 0.05
+    fast_s, slow_s, eval_s = 1.0, 3.0, 0.2
+    rng = np.random.default_rng(2013)
+
+    # the DEFAULT objective set at bench-tight windows — except the
+    # frame-clock target, which is re-quoted at the tick budget: the
+    # production 5 ms p99 belongs to hardware (ROADMAP item 1), while
+    # this 1-core box time-shares the device tick with every client
+    # and honestly lands most frames past 5 ms. Judging against the
+    # 50 ms tick budget keeps the baseline at 100% compliance, so a
+    # latency regression (frames creeping past a tick) flags instead
+    # of drowning in an always-burning leaf. 50 is a bucket edge, so
+    # the burn accounting stays exact.
+    objectives = []
+    for obj in DEFAULT_OBJECTIVES:
+        obj = dict(obj, fast_s=fast_s, slow_s=slow_s)
+        if obj["name"] == "frame_e2e_p99":
+            obj["target_ms"] = TICK_BUDGET_MS
+        objectives.append(obj)
+    slo_spec = {"eval_interval_s": eval_s, "objectives": objectives}
+
+    async def run() -> tuple[dict, dict, int]:
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        ) as fh:
+            json.dump(slo_spec, fh)
+            slo_file = fh.name
+        config = Config()
+        config.store_url = "memory://"
+        config.http_enabled = False
+        config.ws_enabled = False
+        config.zmq_server_port = free_port()
+        config.zmq_server_host = "127.0.0.1"
+        config.spatial_backend = "tpu"
+        config.tick_interval = tick
+        config.entity_sim = True
+        config.entity_k = 8
+        config.interest = "on"
+        config.slo_file = slo_file
+        server = WorldQLServer(config)
+        await server.start()
+        try:
+            clients = [
+                await ZmqClient.connect(config.zmq_server_port)
+                for _ in range(n_watchers)
+            ]
+            for c in clients:
+                await c.send(Message(
+                    instruction=Instruction.LOCAL_MESSAGE,
+                    world_name="bench",
+                    entities=[Entity(
+                        uuid=_uuid.uuid4(),
+                        position=Vector3(*rng.uniform(4, 12, 3)),
+                        world_name="bench",
+                    ) for _ in range(ents_per_watcher)],
+                ))
+            # moving minority: velocity-integrated by the device tick
+            await clients[0].send(Message(
+                instruction=Instruction.LOCAL_MESSAGE,
+                world_name="bench",
+                entities=[Entity(
+                    uuid=_uuid.uuid4(),
+                    position=Vector3(*rng.uniform(6, 10, 3)),
+                    world_name="bench",
+                    flex=struct.pack("<3f", 1.0, 0.5, 0.0),
+                ) for _ in range(n_movers)],
+            ))
+
+            async def drain(client):
+                try:
+                    while True:
+                        await client.recv(timeout=0.5)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    pass
+
+            drains = [asyncio.ensure_future(drain(c)) for c in clients]
+            # warmup: past the jit walls, ticking at rate (config 8's
+            # bounded stability loop)
+            plane_ = server.entity_plane
+            expect = max(3, int(0.5 / tick) - 3)
+            prev_ticks, prev_compiles, stable = -1, -1, 0
+            for _ in range(60):
+                await asyncio.sleep(0.5)
+                ticks_now = plane_.applied_ticks
+                compiles = sum(GUARD.counts().values())
+                if (prev_ticks >= 0
+                        and ticks_now - prev_ticks >= expect
+                        and compiles == prev_compiles):
+                    stable += 1
+                    if stable >= 2:
+                        break
+                else:
+                    stable = 0
+                prev_ticks, prev_compiles = ticks_now, compiles
+            # age the warmup (jit-wall latencies included) out of the
+            # slow burn window before judging — the engine's ring only
+            # looks back slow_s, so after this sleep every window the
+            # measured evaluations see is pure steady-state load
+            await asyncio.sleep(slow_s + 2 * eval_s)
+            t0 = time.monotonic()
+            await asyncio.sleep(measure_s)
+            status = server.slo.status()
+            frame_hist = server.metrics.export_histograms(
+                ("frame.e2e_ms",)
+            ).get("frame.e2e_ms")
+            frames = frame_hist["total"] if frame_hist else 0
+            trajs = {
+                name: [
+                    e for e in server.slo.trajectory(name)
+                    if e["t"] >= t0
+                ]
+                for name in status["objectives"]
+            }
+            for d in drains:
+                d.cancel()
+            await asyncio.gather(*drains, return_exceptions=True)
+            for c in clients:
+                await c.close()
+            return status, trajs, frames
+        finally:
+            await server.stop()
+            os.unlink(slo_file)
+
+    log(f"slo_compliance: game_tick shape, {n_watchers} watchers, "
+        f"{n_movers} movers, windows {fast_s}/{slow_s}s at "
+        f"{eval_s}s evals, {measure_s}s judged window...")
+    status, trajs, frames = asyncio.run(run())
+
+    objectives = {}
+    breaches = 0
+    worst_level = 0
+    for name, entries in trajs.items():
+        ok = sum(1 for e in entries if e["level"] == OK)
+        burning = sum(1 for e in entries if e["level"] == BURNING)
+        breaches += burning
+        worst_level = max(
+            worst_level, max((e["level"] for e in entries), default=0)
+        )
+        objectives[name] = {
+            "compliance_pct": round(
+                100.0 * ok / max(len(entries), 1), 1
+            ),
+            "worst_burn_fast": max(
+                (e["burn_fast"] for e in entries), default=0.0
+            ),
+            "worst_burn_slow": max(
+                (e["burn_slow"] for e in entries), default=0.0
+            ),
+            "evals": len(entries),
+            "final_state": status["objectives"][name]["state"],
+        }
+        log(f"  {name}: {objectives[name]['compliance_pct']}% ok "
+            f"({len(entries)} evals, worst burn "
+            f"{objectives[name]['worst_burn_slow']}x slow), final "
+            f"{objectives[name]['final_state']}")
+
+    if args.smoke:
+        assert set(objectives) == {o["name"] for o in DEFAULT_OBJECTIVES}, (
+            f"smoke: objective set drifted: {sorted(objectives)}"
+        )
+        assert all(o["evals"] >= 5 for o in objectives.values()), (
+            f"smoke: the slo-eval task barely ran inside the judged "
+            f"window: {objectives}"
+        )
+        assert frames > 0, (
+            "smoke: the frame clock never closed a frame — "
+            "frame_e2e_p99 judged an empty series (burn 0 would be a "
+            "dead green light, not compliance)"
+        )
+        assert breaches == 0, (
+            f"smoke: an objective entered BURNING at the quick "
+            f"shape: {objectives}"
+        )
+        log(f"smoke: all {len(objectives)} objectives judged on live "
+            f"series ({frames} frames closed), zero breach evals")
+
+    return {
+        "metric": "slo_breach_evals",
+        "value": breaches,
+        "unit": "count",
+        "slo_breach_evals": breaches,
+        "worst_level": worst_level,
+        # volatile (wall-clock frame count) — pruned from the gate
+        # baseline; the bench keeps reporting it
+        "frames_judged": frames,
+        "windows": {
+            "fast_s": fast_s, "slow_s": slow_s,
+            "eval_interval_s": eval_s,
+        },
+        "objectives": objectives,
+        "config": 15,
+    }
+
+
 # --------------------------------------------------------------------
 
 
@@ -4448,7 +4709,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int,
                     choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
-                             14],
+                             14, 15],
                     help="BASELINE config to run (default: 5); 6 = "
                          "record-op durability workload; 7 = sharded-"
                          "backend 1→8-device scaling curve "
@@ -4476,7 +4737,10 @@ def main() -> None:
                          "between shard processes under load: "
                          "per-state wall times, freeze-window "
                          "delivery pause, park/replay/shed books, "
-                         "zero-loss audit)")
+                         "zero-loss audit); 15 = slo_compliance (the "
+                         "burn-rate engine judging the game_tick "
+                         "shape live: per-objective compliance "
+                         "fractions + worst burn rate)")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--subs", type=int, default=None)
@@ -4516,14 +4780,14 @@ def main() -> None:
         4: bench_config4, 5: bench_config5, 6: bench_config6,
         7: bench_config7, 8: bench_config8, 9: bench_config9,
         10: bench_config10, 11: bench_config11, 12: bench_config12,
-        13: bench_config13, 14: bench_config14,
+        13: bench_config13, 14: bench_config14, 15: bench_config15,
     }
     if args.all:
         # config 7 is EXCLUDED from --all on purpose: it re-execs with
         # a forced 8-device host topology (where needed), which cannot
         # compose with the other configs' already-initialized runtime —
         # run it standalone like the multichip bench.
-        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12, 13, 14]
+        selected = [1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 12, 13, 14, 15]
     else:
         selected = [args.config or 5]
     for n in selected:

@@ -23,10 +23,16 @@ def test_direction_heuristics():
     assert direction("delivery.ring_full_drops") == -1
     assert direction("workers.lost_frames") == -1
     assert direction("deliveries_per_s") == 1
-    # the ISSUE 15 per-core efficiency leaf gates higher-is-better
+    # the ISSUE 15/20 per-core efficiency leaf gates higher-is-better
+    # (explicit "per_core" token — the floor must not depend on the
+    # incidental "per_s" substring surviving a rename)
     assert direction("deliveries_per_s_per_core") == 1
     assert direction("points.1.cluster_e2e_p99_ms") == -1
     assert direction("vs_baseline") == 1
+    # the ISSUE 20 SLO leaves: compliance is higher-better, breach
+    # evals lower-better
+    assert direction("objectives.frame_e2e_p99.compliance_pct") == 1
+    assert direction("slo_breach_evals") == -1
     assert direction("zipf.occupied_cubes") == 0
 
 
@@ -122,13 +128,13 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     assert main([str(baseline), str(baseline), *gate]) == 0
 
     # JSON-lines baseline: one record per smoke config
-    # (5+8+9+10+11+12+13+14)
+    # (5+8+9+10+11+12+13+14+15)
     records = [
         json.loads(line)
         for line in baseline.read_text().splitlines() if line.strip()
     ]
     by_config = {rec["config"]: rec for rec in records}
-    assert set(by_config) == {5, 8, 9, 10, 11, 12, 13, 14}
+    assert set(by_config) == {5, 8, 9, 10, 11, 12, 13, 14, 15}
     # config 14's gate leaves are the loss/abort COUNTS; the whole
     # "reshard" block (state wall times, freeze-window pause, traffic-
     # dependent park/replay counts) is 1-core-box volatile and pruned
@@ -230,12 +236,12 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
     # lower-is-better; 0 -> 1 crosses the --min-abs floor)
     assert by_config[11]["audit_failures"] == 0
     no_timing_leaves(by_config[11])
-    # the ISSUE 15 observability leaves are runner-bound too: the
-    # bench reports cluster_e2e_p99_ms / xshard_p99_ms (live federated
-    # histograms) and deliveries_per_s_per_core per round, but none of
-    # them belong in the checked-in gate record ("per_core" dodges the
-    # *_s suffix check above, so pin it by name)
-    assert "deliveries_per_s_per_core" not in by_config[11]
+    # the ISSUE 15 latency points are runner-bound and stay pruned —
+    # but the ISSUE 20 per-core efficiency FLOOR (ROADMAP item 1) now
+    # lives in the gate record: "per_core" dodges the *_s suffix check
+    # above on purpose, classifies higher-is-better, and its magnitude
+    # clears --min-abs, so a collapsed per-core rate fails CI
+    assert by_config[11]["deliveries_per_s_per_core"] > 1.0
     assert "points" not in by_config[11]
     bad = copy.deepcopy(records)
     for rec in bad:
@@ -247,6 +253,46 @@ def test_perf_gate_fails_on_regression_against_checked_in_baseline(
         "\n".join(json.dumps(rec) for rec in bad) + "\n"
     )
     assert main([str(baseline), str(broken_audit), *gate]) == 1
+
+    # the ISSUE 20 per-core red case: a change that keeps the shed
+    # audit green but burns >2x the CPU per delivery flags ON ITS OWN
+    # under the same invocation (drop ratio measured vs the new value)
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 11:
+            rec["deliveries_per_s_per_core"] = (
+                rec["deliveries_per_s_per_core"] / 3.0
+            )
+    cpu_burn = tmp_path / "cpu_burn.json"
+    cpu_burn.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(cpu_burn), *gate]) == 1
+
+    # the ISSUE 20 SLO-compliance gate: the config-15 baseline pins
+    # zero breach evals and 100% compliance for every default
+    # objective (percent, not fraction, so --min-abs 1.0 can't mute
+    # it); the volatile leaves (frame counts, burn peaks, eval counts)
+    # are pruned — the bench still reports them
+    slo_rec = by_config[15]
+    assert slo_rec["slo_breach_evals"] == 0
+    assert "frames_judged" not in slo_rec
+    for obj in slo_rec["objectives"].values():
+        assert obj["compliance_pct"] == 100.0
+        for key in ("worst_burn_fast", "worst_burn_slow", "evals"):
+            assert key not in obj, key
+    # red case: an objective starts torching its error budget — the
+    # compliance_pct leaf collapses and flags on its own, even while
+    # every raw throughput leaf holds
+    bad = copy.deepcopy(records)
+    for rec in bad:
+        if rec["config"] == 15:
+            rec["objectives"]["frame_e2e_p99"]["compliance_pct"] = 40.0
+    burning = tmp_path / "burning_slo.json"
+    burning.write_text(
+        "\n".join(json.dumps(rec) for rec in bad) + "\n"
+    )
+    assert main([str(baseline), str(burning), *gate]) == 1
 
     # the ISSUE 17 query-library gate: the config-12 baseline keeps
     # ONLY the parity/retrace counts (per-kind device_queries_per_s and

@@ -846,6 +846,7 @@ def test_rule_catalog_has_at_least_seven_distinct_rules():
         "unsequenced-frame",
         "host-sync-in-sim-tick",
         "store-on-loop",
+        "unexported-slo-series",
         "unspanned-stage",
         "wire-mutable-buffer",
         "worker-unsafe-delivery",
@@ -2303,3 +2304,120 @@ def test_epochless_forward_honors_pragma_and_scope():
         src2, relpath="worldql_server_tpu/cluster/shard.py",
         select="epochless-forward",
     ) == []
+
+
+# region: unexported-slo-series
+
+SLO_PATH = "worldql_server_tpu/observability/slo.py"
+
+SLO_SRC = """
+DEFAULT_OBJECTIVES = (
+    {"name": "frame_e2e_p99", "series": "frame.e2e_ms",
+     "kind": "latency_p99", "target_ms": 5.0},
+    {"name": "drop_rate", "series": "delivery.ring_full_drops",
+     "kind": "rate", "max_per_s": 1.0},
+)
+"""
+
+
+def _fake_package(tmp_path, slo_src, siblings=()):
+    """A minimal package tree the rule's producer scan walks: the
+    registry at <pkg>/observability/slo.py plus sibling modules."""
+    pkg = tmp_path / "worldql_server_tpu"
+    (pkg / "observability").mkdir(parents=True)
+    slo_file = pkg / "observability" / "slo.py"
+    slo_file.write_text(textwrap.dedent(slo_src), encoding="utf-8")
+    for relname, src in siblings:
+        f = pkg / relname
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src), encoding="utf-8")
+    return slo_file
+
+
+def _slo_violations(slo_file, slo_src):
+    out = check_source(
+        textwrap.dedent(slo_src), str(slo_file), SLO_PATH,
+        select={"unexported-slo-series"},
+    )
+    return [(v.rule, v.line) for v in out]
+
+
+def test_unexported_slo_series_fires_on_phantom_series(tmp_path):
+    # no sibling emits either series — both objectives are dead config
+    slo_file = _fake_package(tmp_path, SLO_SRC)
+    fired = _slo_violations(slo_file, SLO_SRC)
+    assert [r for r, _ in fired] == ["unexported-slo-series"] * 2
+
+
+def test_unexported_slo_series_quiet_with_producers(tmp_path):
+    # one histogram observe + one counter inc cover the registry; the
+    # producer may live anywhere in the package
+    slo_file = _fake_package(tmp_path, SLO_SRC, siblings=[
+        ("engine/ticker.py", """
+         class T:
+             def flush(self, metrics, ms):
+                 metrics.observe_ms("frame.e2e_ms", ms)
+         """),
+        ("delivery/plane.py", """
+         class P:
+             def drop(self, metrics, n):
+                 metrics.inc("delivery.ring_full_drops", n)
+         """),
+    ])
+    assert _slo_violations(slo_file, SLO_SRC) == []
+
+
+def test_unexported_slo_series_sees_gauge_registrations(tmp_path):
+    # gauge_floor objectives are produced by gauge()/set_gauge() calls
+    src = """
+    DEFAULT_OBJECTIVES = (
+        {"name": "per_core", "series": "deliveries_per_s_per_core",
+         "kind": "gauge_floor", "floor": 1.0},
+    )
+    """
+    slo_file = _fake_package(tmp_path, src, siblings=[
+        ("cluster/router.py", """
+         class R:
+             def __init__(self, metrics):
+                 metrics.gauge("deliveries_per_s_per_core", lambda: 0.0)
+         """),
+    ])
+    assert _slo_violations(slo_file, src) == []
+    # ... but only an EXACT name match counts
+    src2 = src.replace("deliveries_per_s_per_core\",\n", "deliveries_per_core\",\n")
+    slo_file2 = _fake_package(tmp_path / "b", src2, siblings=[
+        ("cluster/router.py", """
+         class R:
+             def __init__(self, metrics):
+                 metrics.gauge("deliveries_per_s_per_core", lambda: 0.0)
+         """),
+    ])
+    assert [r for r, _ in _slo_violations(slo_file2, src2)] == [
+        "unexported-slo-series"
+    ]
+
+
+def test_unexported_slo_series_honors_pragma_and_scope(tmp_path):
+    src = """
+    DEFAULT_OBJECTIVES = (
+        {"name": "ext", "kind": "rate", "max_per_s": 1.0,
+         "series": "external.series"},  # wql: allow(unexported-slo-series)
+    )
+    """
+    slo_file = _fake_package(tmp_path, src)
+    assert _slo_violations(slo_file, src) == []
+    # out of scope: the same literal anywhere else is not a registry
+    assert violations(SLO_SRC, relpath="worldql_server_tpu/engine/x.py",
+                      select="unexported-slo-series") == []
+
+
+def test_unexported_slo_series_green_on_real_registry():
+    # the shipped defaults must all be producible in the real package
+    import pathlib
+
+    real = pathlib.Path("worldql_server_tpu/observability/slo.py")
+    out = check_source(
+        real.read_text(encoding="utf-8"), str(real), SLO_PATH,
+        select={"unexported-slo-series"},
+    )
+    assert out == []

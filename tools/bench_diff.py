@@ -29,17 +29,24 @@ import sys
 #: substrings (suffix-ish) that mark a metric lower-is-better
 _LOWER_BETTER = (
     "_ms", "_s", "drops", "errors", "lost", "retraces", "failures",
-    "evictions", "slow_ticks",
+    "evictions", "slow_ticks", "breach",
 )
 #: byte-volume metrics are lower-is-better and must be classified
 #: BEFORE the higher-better pass: ``bytes_per_recipient_per_s``
 #: contains "per_s" and would otherwise read as a throughput win when
 #: the interest manager ships MORE bytes (ISSUE 18)
 _BYTES_LOWER = ("bytes_per", "bytes_shed")
-#: substrings that mark a metric higher-is-better
+#: substrings that mark a metric higher-is-better.  ``per_core`` is
+#: listed explicitly (ROADMAP item 1 / ISSUE 20): the perf gate holds
+#: an efficiency floor on ``deliveries_per_s_per_core``, so a change
+#: that keeps raw throughput by burning proportionally more CPU still
+#: flags.  ``compliance`` covers the config-15 SLO leaves — a latency
+#: regression that starts torching the error budget shows up as a
+#: compliance_pct drop even while every *_per_s leaf holds.
 _HIGHER_BETTER = (
     "per_s", "vs_baseline", "speedup", "deliveries", "sends_ok",
-    "queries_per_s", "reuse_pct", "reuse_fraction",
+    "queries_per_s", "reuse_pct", "reuse_fraction", "per_core",
+    "compliance",
 )
 
 

@@ -1,4 +1,5 @@
-"""Observability-coverage rule: tick stages must be spanned.
+"""Observability-coverage rules: tick stages must be spanned, and
+declared SLO series must be producible.
 
 The flight recorder (ISSUE 5) can only attribute a slow tick to the
 stages that were actually spanned — a new tick-stage timer added to
@@ -89,4 +90,121 @@ UNSPANNED_STAGE = Rule(
     _check_unspanned_stage,
 )
 
-RULES = [UNSPANNED_STAGE]
+
+# region: unexported-slo-series
+
+# An SLO objective judges a metric series — but nothing ties the name
+# in observability/slo.py's DEFAULT_OBJECTIVES to an actual emission
+# site. Rename `frame.e2e_ms` at the observe_ms call (or delete the
+# subsystem) and the objective silently evaluates an empty series
+# forever: burn 0, state OK, dead config wearing a green light. This
+# rule re-scans the package for every call that can mint a series —
+# observe_ms/observe_ms_n/inc (counters + histograms) and
+# set_gauge/gauge (gauges) — and fails any declared series no call
+# site can produce.
+
+#: the registry whose declared series must be producible
+_SLO_SCOPED = ("observability/slo.py",)
+
+#: Metrics methods whose first string argument mints a series name
+_PRODUCER_METHODS = (
+    "observe_ms", "observe_ms_n", "inc", "set_gauge", "gauge",
+)
+
+
+def _declared_series(tree: ast.Module) -> list[tuple[str, ast.AST]]:
+    """(series, node-to-flag) for each objective in the module-level
+    ``DEFAULT_OBJECTIVES`` literal."""
+    out: list[tuple[str, ast.AST]] = []
+    for stmt in tree.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "DEFAULT_OBJECTIVES"
+                for t in stmt.targets
+            )
+        ):
+            continue
+        for obj in ast.walk(stmt.value):
+            if not isinstance(obj, ast.Dict):
+                continue
+            for key, value in zip(obj.keys, obj.values):
+                if (
+                    isinstance(key, ast.Constant) and key.value == "series"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                ):
+                    out.append((value.value, value))
+    return out
+
+
+def _producer_names(tree: ast.Module) -> set[str]:
+    """Every series name a file's Metrics calls can mint."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _PRODUCER_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def _package_producers(slo_path: str) -> set[str]:
+    """Scan the package containing ``observability/slo.py`` (its
+    grandparent directory) for every producible series name. Unparsable
+    or unreadable files are skipped — absence of evidence there must
+    not fail the whole registry."""
+    from pathlib import Path
+
+    root = Path(slo_path).resolve().parent.parent
+    names: set[str] = set()
+    for file in sorted(root.rglob("*.py")):
+        if "__pycache__" in file.parts:
+            continue
+        try:
+            tree = ast.parse(
+                file.read_text(encoding="utf-8"), filename=str(file)
+            )
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        names |= _producer_names(tree)
+    return names
+
+
+def _check_unexported_slo_series(ctx: FileContext) -> Iterator[Violation]:
+    if not ctx.relpath.endswith(_SLO_SCOPED):
+        return
+    declared = _declared_series(ctx.tree)
+    if not declared:
+        return
+    producers = _package_producers(ctx.path)
+    for series, node in declared:
+        if series not in producers:
+            yield from ctx.flag(
+                UNEXPORTED_SLO_SERIES,
+                node,
+                f"SLO objective series {series!r} has no producer — no "
+                "observe_ms/observe_ms_n/inc/set_gauge/gauge call site "
+                "in the package can mint it, so the objective would "
+                "judge an empty series forever (burn 0, state OK: dead "
+                "config). Point it at a real series or mark an "
+                "intentionally-external one with "
+                "`# wql: allow(unexported-slo-series)`",
+            )
+
+
+UNEXPORTED_SLO_SERIES = Rule(
+    "unexported-slo-series",
+    "SLO objective over a series no metrics call site in the package "
+    "can produce — the objective is dead config",
+    _check_unexported_slo_series,
+)
+
+# endregion
+
+RULES = [UNSPANNED_STAGE, UNEXPORTED_SLO_SERIES]

@@ -333,6 +333,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "then sheds whole keyframes "
                         "(delivery.bytes_shed) — deltas are never "
                         "truncated (default 0 = off)")
+    p.add_argument("--slo", choices=["off", "on"],
+                   help="SLO engine: evaluate the objective registry "
+                        "(frame/cluster e2e p99, drop/resync rates, "
+                        "per-core delivery floor, WAL fsync p99) with "
+                        "fast/slow-window burn-rate alerting — the slo "
+                        "gauge, a /healthz block, and GET /debug/slo "
+                        "(default off = no SLO surface at all)")
+    p.add_argument("--slo-file", dest="slo_file",
+                   help="JSON objective registry replacing the "
+                        "built-in defaults (per-objective targets and "
+                        "burn windows); implies --slo on")
+    p.add_argument("--incident-dir", dest="incident_dir",
+                   help="write one correlated incident capsule (JSON) "
+                        "here on each SLO BURNING transition; bounded "
+                        "ring of --incident-keep files, listed at "
+                        "GET /debug/incidents (requires the SLO "
+                        "engine)")
+    p.add_argument("--incident-cooldown", type=float,
+                   dest="incident_cooldown",
+                   help="minimum seconds between incident capsules — "
+                        "a flapping objective yields exactly one "
+                        "capsule per window (default 60)")
+    p.add_argument("--incident-keep", type=int, dest="incident_keep",
+                   help="newest N incident capsules retained on disk "
+                        "(default 16)")
     p.add_argument("--no-device-telemetry", action="store_true",
                    help="disable device telemetry (jit compile/retrace "
                         "counters + loose spans, per-tick encode/h2d/"
@@ -367,6 +392,7 @@ _OVERRIDES = [
     "reshard_buffer_bytes",
     "interest", "lod_near_radius", "lod_far_every_k",
     "peer_bandwidth_bytes",
+    "slo", "slo_file", "incident_dir", "incident_cooldown", "incident_keep",
 ]
 
 

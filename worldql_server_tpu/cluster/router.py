@@ -43,7 +43,6 @@ it; scenarios, bench config 11 and the e2e suite embed it.
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
 import os
 import random
@@ -64,6 +63,7 @@ from ..protocol import (
 )
 from ..utils.names import GLOBAL_WORLD  # noqa: F401  (routing contract doc)
 from . import tracectx
+from .dump_client import ChunkedDumpClient
 from .federation import MetricsFederation
 from .resharding import (
     AutoshardController,
@@ -154,9 +154,10 @@ class ClusterRouter:
         # registry (aggregates + cluster.shard.<i>.* series), so the
         # router's /metrics is the one scrape for the whole fleet
         self.federation = MetricsFederation(self.metrics, self.n_shards)
-        #: in-flight /debug/cluster dump collections: req_id → slot
-        self._dump_reqs: dict[int, dict] = {}
-        self._dump_seq = 0
+        # ONE chunked-dump pull path for /debug/cluster AND incident
+        # capture — shared slots, reassembly and timeout-degrade
+        # semantics, so a capsule can't drift from the debug endpoint
+        self.dumps = ChunkedDumpClient(supervisor)
         # Live resharding (ISSUE 19): at most one migration in flight;
         # its coordinator intercepts the moving world's traffic into a
         # bounded transfer buffer until the epoch flips.
@@ -178,6 +179,35 @@ class ClusterRouter:
             "deliveries_per_s_per_core",
             self.federation.deliveries_per_s_per_core,
         )
+        # Fleet SLO state: the router's engine judges THIS registry —
+        # federation already folds every shard's series in — and the
+        # shards additionally piggyback their local compliance on the
+        # ~1s state packets (note_remote below). Incidents captured
+        # here pull every process's sections over the shared dump
+        # client, so one capsule holds the whole fleet's causal state.
+        self.slo = None
+        self.incidents = None
+        self._slo_task: asyncio.Task | None = None
+        if config.slo_enabled:
+            from ..observability.slo import SloEngine, load_objectives
+
+            interval, objectives = load_objectives(config.slo_file)
+            self.slo = SloEngine(
+                self.metrics, objectives, eval_interval_s=interval
+            )
+            self.metrics.gauge("slo", self.slo.gauge)
+            if config.incident_dir is not None:
+                from ..observability.incidents import IncidentRecorder
+
+                self.incidents = IncidentRecorder(
+                    config.incident_dir,
+                    cooldown_s=config.incident_cooldown,
+                    keep=config.incident_keep,
+                    metrics=self.metrics,
+                )
+                self.incidents.collect = self._collect_incident_body
+                self.slo.on_burning = self._on_slo_burning
+                self.metrics.gauge("incidents", self.incidents.stats)
 
     # region: lifecycle
 
@@ -207,20 +237,30 @@ class ClusterRouter:
             self._autoshard_task = asyncio.create_task(  # wql: allow(unsupervised-task) — poll loop contains its own errors; cancelled in stop()
                 self.autoshard.run(), name="cluster-autoshard"
             )
+        if self.slo is not None:
+            self._slo_task = asyncio.create_task(  # wql: allow(unsupervised-task) — eval loop contains its own errors; cancelled in stop()
+                self.slo.run(), name="cluster-slo-eval"
+            )
         logger.info(
             "cluster router listening on %s:%s, %d shards behind it",
             config.zmq_server_host, config.zmq_server_port, self.n_shards,
         )
 
     async def stop(self) -> None:
-        for task in (self._autoshard_task, self._migration_task):
+        for task in (
+            self._slo_task, self._autoshard_task, self._migration_task
+        ):
             if task is not None:
                 task.cancel()
                 try:
                     await task
                 except (asyncio.CancelledError, Exception):
                     pass
-        self._autoshard_task = self._migration_task = None
+        self._slo_task = self._autoshard_task = self._migration_task = None
+        if self.incidents is not None:
+            # after slo-eval stops (no new triggers) — let any
+            # in-flight fleet capsule finish before the sockets close
+            await self.incidents.drain()
         if self._recv_task is not None:
             self._recv_task.cancel()
             try:
@@ -250,6 +290,11 @@ class ClusterRouter:
         if op == "state":
             self.mirror.note_state(shard, msg)
             self.federation.ingest(shard, msg)
+            if self.slo is not None:
+                # shard-local compliance piggybacks the state packet —
+                # the fleet report shows WHICH process burns, not just
+                # that the aggregate does
+                self.slo.note_remote(shard, msg.get("slo"))
             # placement convergence via the ~1s state packets: a shard
             # reporting an older epoch (missed a flip broadcast, or
             # restarted) gets the current document re-pushed — every
@@ -263,7 +308,7 @@ class ClusterRouter:
                     "op": "placement", "spec": self.world_map.to_spec(),
                 })
         elif op == "dump_chunk":
-            self._note_dump_chunk(msg)
+            self.dumps.note_chunk(msg)
         elif op == "reroute":
             self._note_reroute(shard, msg)
         elif op == "fence_ack":
@@ -304,6 +349,10 @@ class ClusterRouter:
         # restart-monotone federation: the fresh shard's cumulatives
         # re-baseline from zero, so merged series only ever grow
         self.federation.reset(shard)
+        if self.slo is not None:
+            # stale pre-restart compliance must not hold the fleet
+            # report degraded — the fresh shard re-reports within ~1s
+            self.slo.drop_remote(shard)
         self.federation.note_pid(shard, self.supervisor.shard_pid(shard))
         # placement replay: a restarted shard boots at epoch 0 — it
         # must learn every override BEFORE serving, or it would apply
@@ -722,6 +771,10 @@ class ClusterRouter:
         app.router.add_get("/healthz", self._get_healthz)
         app.router.add_get("/metrics", self._get_metrics)
         app.router.add_get("/debug/cluster", self._get_debug_cluster)
+        if self.slo is not None:
+            app.router.add_get("/debug/slo", self._get_debug_slo)
+        if self.incidents is not None:
+            app.router.add_get("/debug/incidents", self._get_debug_incidents)
         app.router.add_post("/global_message", self._post_global_message)
         app.router.add_post("/reshard", self._post_reshard)
         self._http_runner = web.AppRunner(app)
@@ -745,6 +798,13 @@ class ClusterRouter:
             )
         ):
             body["status"] = "degraded"
+        if self.slo is not None:
+            # fleet burn state: the router's own engine (judging the
+            # federated registry) plus every shard's piggybacked worst
+            slo = self.slo.healthz()
+            body["slo"] = slo
+            if slo["state"] == "burning":
+                body["status"] = "degraded"
         return web.json_response(body)
 
     async def _get_metrics(self, request):
@@ -759,54 +819,14 @@ class ClusterRouter:
 
     # region: cluster flight recorder (GET /debug/cluster)
 
-    def _note_dump_chunk(self, msg: dict) -> None:
-        """Control-channel reader hook: reassemble one shard's chunked
-        flight-recorder dump."""
-        slot = self._dump_reqs.get(msg.get("req_id"))
-        if slot is None:
-            return  # late chunk for a timed-out request — dropped
-        try:
-            slot["parts"][int(msg["seq"])] = str(msg.get("data", ""))
-            slot["n"] = int(msg["n"])
-        except (KeyError, TypeError, ValueError):
-            return
-        if len(slot["parts"]) >= slot["n"]:
-            slot["event"].set()
-
     async def collect_shard_dump(
         self, shard: int, timeout: float = 8.0
     ) -> dict | None:
-        """Pull one shard's flight-recorder snapshot over the control
-        channel (request → chunked response). None on a dead shard or
-        a timeout — the cluster dump degrades to the processes that
-        answered, never errors."""
-        if not self.supervisor.shard_alive(shard):
-            return None
-        self._dump_seq += 1
-        req_id = self._dump_seq
-        slot = {"parts": {}, "n": 1 << 30, "event": asyncio.Event()}
-        self._dump_reqs[req_id] = slot
-        try:
-            if not self.supervisor.ctl_send(
-                shard, {"op": "dump", "req_id": req_id}
-            ):
-                return None
-            try:
-                await asyncio.wait_for(slot["event"].wait(), timeout)
-            except asyncio.TimeoutError:
-                logger.warning(
-                    "shard %d flight-recorder dump timed out", shard
-                )
-                return None
-            blob = "".join(
-                slot["parts"][i] for i in range(slot["n"])
-            )
-            return json.loads(blob)
-        except Exception:
-            logger.exception("shard %d dump collection failed", shard)
-            return None
-        finally:
-            self._dump_reqs.pop(req_id, None)
+        """Pull one shard's flight-recorder + subsystem-section dump
+        over the shared :class:`ChunkedDumpClient` (request → chunked
+        response). None on a dead shard or a timeout — the caller
+        degrades to the processes that answered, never errors."""
+        return await self.dumps.collect(shard, timeout)
 
     async def _get_debug_cluster(self, request):
         """ONE flight recorder for the fleet: every shard's snapshot
@@ -848,6 +868,85 @@ class ClusterRouter:
                 if dump is not None
             },
         })
+
+    # endregion
+
+    # region: fleet SLO surface (GET /debug/slo, /debug/incidents)
+
+    async def _get_debug_slo(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.slo.status())
+
+    async def _get_debug_incidents(self, request):
+        from aiohttp import web
+
+        incident_id = request.query.get("id")
+        if incident_id is None:
+            return web.json_response({
+                "incidents": self.incidents.list(),
+                "stats": self.incidents.stats(),
+            })
+        capsule = self.incidents.load(incident_id)
+        if capsule is None:
+            return web.Response(status=404)
+        return web.json_response(capsule)
+
+    def _on_slo_burning(self, objective) -> None:
+        """SLO eval hook: a fleet objective transitioned into BURNING.
+        The recorder debounces and pulls the capsule asynchronously."""
+        if self.incidents is not None:
+            self.incidents.trigger(objective, self.slo.status())
+
+    def _router_sections(self) -> dict:
+        """The router process's own capsule sections (its subsystems
+        differ from an engine process: no governor/interest/device —
+        instead placement, federation and the shed mirror)."""
+        from ..observability.incidents import top_stage_attribution
+        from ..robustness import failpoints
+
+        sections: dict = {
+            "placement": {
+                "epoch": self.world_map.epoch,
+                "world_overrides": len(self.world_map.world_overrides),
+                "migration": (
+                    self.migration.describe()
+                    if self.migration is not None else None
+                ),
+            },
+            "federation": self.federation.stats(),
+            "shed_mirror": {
+                str(i): self.mirror.level(i) for i in range(self.n_shards)
+            },
+            "cluster": self.status(),
+            "failpoints": dict(failpoints.registry.fired_counts()),
+        }
+        if self.recorder is not None:
+            sections["flight_recorder"] = {
+                "stats": self.recorder.stats(),
+                "ticks": self.recorder.snapshot(),
+                "loose": self.recorder.loose_snapshot(),
+                "top_stages": top_stage_attribution(self.recorder),
+            }
+        else:
+            sections["flight_recorder"] = {"enabled": False}
+        return sections
+
+    async def _collect_incident_body(self) -> dict:
+        """Fleet capsule body: the router's sections plus EVERY shard's
+        dump (flight recorder + its subsystem sections) pulled over the
+        same chunked control path /debug/cluster uses."""
+        dumps = await asyncio.gather(
+            *(self.collect_shard_dump(i) for i in range(self.n_shards))
+        )
+        return {
+            "pid": os.getpid(),
+            "sections": self._router_sections(),
+            "shards": {
+                str(i): dump for i, dump in enumerate(dumps)
+                if dump is not None
+            },
+        }
 
     # endregion
 

@@ -731,6 +731,10 @@ class ClusterShardExtension:
         if gov is not None:
             packet.update(gov.export_state())
             packet["op"] = "state"  # export_state must not shadow it
+        if self.server.slo is not None:
+            # local compliance piggybacks the ~1s state clock — the
+            # router's fleet SLO report names the burning process
+            packet["slo"] = self.server.slo.compliance()
         return packet
 
     def _maybe_push_state(self) -> None:
@@ -827,11 +831,17 @@ class ClusterShardExtension:
             await self.server.router.handle_message(message)
 
     async def _send_dump(self, req_id: int) -> None:
-        """Chunk the flight-recorder snapshot to the router (the
-        control channel's 64 KiB datagrams can't carry a whole
-        Chrome-trace worth of spans in one packet). Tracing off sends
-        an empty-but-well-formed dump so the router never times out on
-        a healthy shard."""
+        """Chunk the flight-recorder snapshot + this process's
+        subsystem sections to the router (the control channel's 64 KiB
+        datagrams can't carry a whole Chrome-trace worth of spans in
+        one packet). The SAME dump serves ``GET /debug/cluster`` (which
+        reads ticks/loose) and the router's incident capture (which
+        additionally embeds the sections), so the capsule can never see
+        a different shard state than the debug endpoint. Tracing off
+        sends an empty-but-well-formed dump so the router never times
+        out on a healthy shard."""
+        from ..observability.incidents import capsule_sections
+
         recorder = getattr(self.server, "recorder", None)
         payload = {
             "shard": self.shard_id,
@@ -840,6 +850,7 @@ class ClusterShardExtension:
             "loose": (
                 recorder.loose_snapshot() if recorder is not None else []
             ),
+            "sections": capsule_sections(self.server),
         }
         try:
             blob = json.dumps(payload)

@@ -155,6 +155,14 @@ def shard_argv(config, shard_id: int) -> list[str]:
     if config.index_snapshot:
         argv += ["--index-snapshot",
                  f"{config.index_snapshot}.shard{shard_id}"]
+    if config.slo_enabled:
+        # shards judge their LOCAL objectives and piggyback compliance
+        # on the state packets; incidents stay router-side (the fleet
+        # capsule pulls every shard's sections over the dump channel),
+        # so --incident-dir deliberately does NOT propagate
+        argv += ["--slo", "on"]
+        if config.slo_file:
+            argv += ["--slo-file", config.slo_file]
     if config.failpoints:
         argv += ["--failpoints", config.failpoints]
     if config.failpoints_seed is not None:

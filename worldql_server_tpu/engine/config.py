@@ -16,6 +16,7 @@ self-contained).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from dataclasses import dataclass, field
@@ -505,6 +506,29 @@ class Config:
     peer_bandwidth_bytes: int = field(
         default_factory=lambda: int(_env("WQL_PEER_BANDWIDTH_BYTES", "0"))
     )
+    # SLO engine: 'off' (default) constructs nothing — no slo gauge,
+    # no /debug/slo route, no healthz block, no slo-eval task; the
+    # observable surface is byte for byte the pre-SLO server. 'on'
+    # evaluates the built-in objective registry; --slo-file (JSON)
+    # replaces the registry with per-objective targets/windows and
+    # implies 'on'.
+    slo: str = field(default_factory=lambda: _env("WQL_SLO", "off"))
+    slo_file: str | None = field(
+        default_factory=lambda: os.environ.get("WQL_SLO_FILE") or None
+    )
+    # Incident capsules: written only when incident_dir is set (and the
+    # SLO engine is on). One correlated JSON bundle per BURNING
+    # transition, debounced by incident_cooldown seconds, newest
+    # incident_keep capsules retained.
+    incident_dir: str | None = field(
+        default_factory=lambda: os.environ.get("WQL_INCIDENT_DIR") or None
+    )
+    incident_cooldown: float = field(
+        default_factory=lambda: float(_env("WQL_INCIDENT_COOLDOWN", "60"))
+    )
+    incident_keep: int = field(
+        default_factory=lambda: int(_env("WQL_INCIDENT_KEEP", "16"))
+    )
 
     def validate(self) -> None:
         """Cross-field validation; raises ValueError on any violation
@@ -754,6 +778,25 @@ class Config:
         if self.entity_max < 1:
             errors.append("entity_max must be >= 1")
 
+        if self.slo not in ("off", "on"):
+            errors.append("slo must be 'off' or 'on'")
+        if self.slo_file is not None:
+            try:
+                from ..observability.slo import load_objectives
+
+                load_objectives(self.slo_file)
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                errors.append(f"slo_file: {exc}")
+        if self.incident_cooldown < 0:
+            errors.append("incident_cooldown must be >= 0")
+        if self.incident_keep < 1:
+            errors.append("incident_keep must be >= 1")
+        if self.incident_dir is not None and not self.slo_enabled:
+            errors.append(
+                "incident_dir requires the SLO engine (--slo on or "
+                "--slo-file) — capsules trigger off burn transitions"
+            )
+
         if errors:
             raise ValueError("; ".join(errors))
 
@@ -763,6 +806,13 @@ class Config:
         slow-tick threshold — an auto-dump without spans would be an
         empty tree."""
         return self.trace or self.slow_tick_ms is not None
+
+    @property
+    def slo_enabled(self) -> bool:
+        """The SLO engine runs when asked for explicitly OR implied by
+        an objective file — a registry override with the engine off
+        would be dead config."""
+        return self.slo == "on" or self.slo_file is not None
 
 
 #: device nodes whose presence means a non-CPU jax backend will attach

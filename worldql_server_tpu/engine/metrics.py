@@ -205,6 +205,18 @@ class Metrics:
         snapshots of a moment, not a live view."""
         self._gauges[name] = lambda v=value: v
 
+    def gauge_value(self, name: str):
+        """Evaluate ONE registered gauge by name (``None`` when absent
+        or broken).  The SLO engine samples floor objectives through
+        this instead of rendering the whole registry every tick."""
+        fn = self._gauges.get(name)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # a broken gauge must not kill slo-eval
+            return None
+
     def _eval_gauges(self) -> dict:
         gauges = {}
         for name, fn in self._gauges.items():

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..robustness import failpoints
 from ..robustness.supervisor import Supervisor
@@ -401,6 +402,31 @@ class WorldQLServer:
             query_limits=self.query_limits,
             heatmap=self.heatmap,
         )
+        # SLO engine + incident recorder (observability/slo.py,
+        # incidents.py): declared objectives over the series this
+        # registry already records, judged by a supervised slo-eval
+        # task with fast/slow burn windows. Off (default) constructs
+        # nothing — no gauge, no routes, no healthz block.
+        self.slo = None
+        self.incidents = None
+        if config.slo_enabled:
+            from ..observability.slo import SloEngine, load_objectives
+
+            interval, objectives = load_objectives(config.slo_file)
+            self.slo = SloEngine(
+                self.metrics, objectives, eval_interval_s=interval
+            )
+            if config.incident_dir is not None:
+                from ..observability.incidents import IncidentRecorder
+
+                self.incidents = IncidentRecorder(
+                    config.incident_dir,
+                    cooldown_s=config.incident_cooldown,
+                    keep=config.incident_keep,
+                    metrics=self.metrics,
+                )
+                self.incidents.collect = self._collect_incident_body
+                self.slo.on_burning = self._on_slo_burning
         self._register_gauges()
         self._tasks: list[asyncio.Task] = []
         self._transports: list = []
@@ -506,6 +532,12 @@ class WorldQLServer:
             self.metrics.gauge("device", self.device_telemetry.stats)
         if self.recorder is not None:
             self.metrics.gauge("flight_recorder", self.recorder.stats)
+        if self.slo is not None:
+            # per-objective burn state: numeric levels flatten to
+            # wql_slo_<objective> (0 ok / 1 warn / 2 burning) + worst
+            self.metrics.gauge("slo", self.slo.gauge)
+        if self.incidents is not None:
+            self.metrics.gauge("incidents", self.incidents.stats)
         if self.loop_monitor is not None:
             self.metrics.gauge("loop_health", self.loop_monitor.snapshot)
         if hasattr(self.backend, "status") and hasattr(
@@ -566,6 +598,27 @@ class WorldQLServer:
         if self.governor is None:
             return None
         return self.governor.status()
+
+    def slo_status(self) -> dict | None:
+        """Compact burn-state block for /healthz; None with --slo off
+        (the reference-shaped body stays untouched)."""
+        if self.slo is None:
+            return None
+        return self.slo.healthz()
+
+    def _on_slo_burning(self, objective) -> None:
+        """SLO eval hook: an objective just transitioned into BURNING.
+        Hand it to the incident recorder (debounce lives there)."""
+        if self.incidents is not None:
+            self.incidents.trigger(objective, self.slo.status())
+
+    async def _collect_incident_body(self) -> dict:
+        """Capsule body for a standalone/shard process: this process's
+        subsystem sections (the router overrides this with the fleet
+        pull over the shared chunked-dump client)."""
+        from ..observability.incidents import capsule_sections
+
+        return {"pid": os.getpid(), "sections": capsule_sections(self)}
 
     def _delta_status(self) -> dict:
         """Temporal-coherence accounting (the ``delta`` gauge):
@@ -770,6 +823,13 @@ class WorldQLServer:
 
         if self.ticker is not None:
             self.ticker.start()
+
+        if self.slo is not None:
+            # SLO sentinel: judges the burn windows every eval tick
+            # after the transports are up (so /metrics and the slo
+            # gauge agree on what it sees). Supervised — a crashed
+            # evaluator restarts and its absence shows in /healthz.
+            self.supervisor.spawn("slo-eval", self.slo.run)
 
         if self.governor is not None and self.ticker is None:
             # immediate-mode servers have no tick clock — a supervised
@@ -995,11 +1055,15 @@ class WorldQLServer:
         for name in (
             "checkpoint", "stale-sweep", "restored-peer-sweep",
             "session-sweep", "loop-monitor", "overload-governor",
-            "cluster-control", "cluster-drain",
+            "slo-eval", "cluster-control", "cluster-drain",
         ):
             handle = self.supervisor.get(name)
             if handle is not None:
                 await handle.stop()
+        if self.incidents is not None:
+            # after slo-eval stops (no new triggers) — let any
+            # in-flight capsule finish writing
+            await self.incidents.drain()
         if self.loop_monitor is not None:
             self.loop_monitor.uninstall()
         if self.device_telemetry is not None:

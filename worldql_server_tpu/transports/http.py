@@ -52,6 +52,13 @@ class HttpTransport:
             app.router.add_get("/debug/ticks", self._get_debug_ticks)
             app.router.add_post("/debug/profile", self._post_debug_profile)
             app.router.add_get("/debug/profile", self._get_debug_profile)
+        if getattr(self.server, "slo", None) is not None:
+            # SLO burn-state report — exists only with --slo on /
+            # --slo-file, 404s otherwise
+            app.router.add_get("/debug/slo", self._get_debug_slo)
+        if getattr(self.server, "incidents", None) is not None:
+            # incident capsule ring — exists only with --incident-dir
+            app.router.add_get("/debug/incidents", self._get_debug_incidents)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, config.http_host, config.http_port)
@@ -131,6 +138,16 @@ class HttpTransport:
             body["overload"] = overload
             if overload["state_level"] >= 2:
                 body["status"] = "degraded"
+        # SLO burn state (worst objective + who is burning): BURNING
+        # means the node is violating a declared objective RIGHT NOW —
+        # degraded, even though it is serving. Absent with --slo off
+        # (reference-shaped body).
+        slo_fn = getattr(self.server, "slo_status", None)
+        slo = slo_fn() if slo_fn is not None else None
+        if slo is not None:
+            body["slo"] = slo
+            if slo["burning"]:
+                body["status"] = "degraded"
         # Flight-recorder state (slow-tick count front and center): an
         # operator probing a limping node sees HOW MANY ticks blew the
         # threshold before scraping anything. Absent when tracing is
@@ -169,6 +186,32 @@ class HttpTransport:
             "ticks": ticks,
             "loose": recorder.loose_snapshot(),
         })
+
+    async def _get_debug_slo(self, request: web.Request) -> web.Response:
+        """Full SLO report: per-objective state, fast/slow burn rates,
+        budget-remaining, transition counts, and (on a router) every
+        shard's piggybacked compliance summary."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        return web.json_response(self.server.slo.status())
+
+    async def _get_debug_incidents(self, request: web.Request) -> web.Response:
+        """Incident capsule ring: no query = the index (id, seq,
+        objective, size); ``?id=incident-NNNN-<objective>`` = the full
+        capsule JSON."""
+        if not self._authorized(request):
+            return web.Response(status=401)
+        incidents = self.server.incidents
+        incident_id = request.query.get("id")
+        if incident_id is None:
+            return web.json_response({
+                "incidents": incidents.list(),
+                "stats": incidents.stats(),
+            })
+        capsule = incidents.load(incident_id)
+        if capsule is None:
+            return web.Response(status=404)
+        return web.json_response(capsule)
 
     async def _get_debug_heatmap(self, request: web.Request) -> web.Response:
         """Region-density snapshot: the decayed per-cube counts feeding
